@@ -1,0 +1,264 @@
+"""ray_tpu: a TPU-native distributed computing and ML framework.
+
+Public core API parity with the reference (`python/ray/_private/worker.py`):
+`init`, `shutdown`, `remote`, `get`, `put`, `wait`, `get_actor`, `kill`,
+`cancel`, `nodes`, `cluster_resources`, `available_resources`, plus the ML
+libraries under `ray_tpu.train`, `ray_tpu.tune`, `ray_tpu.data`,
+`ray_tpu.serve`, `ray_tpu.rllib` and the TPU parallelism layer under
+`ray_tpu.parallel`.
+
+The compute path is JAX/XLA/Pallas; this package deliberately avoids
+importing jax at `import ray_tpu` time so CPU-only control-plane processes
+stay light.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._version import __version__
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.runtime import CoreRuntime
+from ray_tpu.exceptions import (  # noqa: F401 (re-exported)
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RaySystemError,
+    RayTaskError,
+    RayTpuError,
+    TaskCancelledError,
+)
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu.remote_function import RemoteFunction
+
+logger = logging.getLogger(__name__)
+
+_global_runtime: Optional[CoreRuntime] = None
+_global_node = None
+_init_lock = threading.RLock()
+
+
+def is_initialized() -> bool:
+    return _global_runtime is not None
+
+
+def _require_runtime() -> CoreRuntime:
+    global _global_runtime
+    if _global_runtime is None:
+        init()
+    return _global_runtime
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: int = 0,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    labels: Optional[Dict[str, str]] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Start a head node in-process (address=None) or connect a driver to an
+    existing cluster (address="host:port" of the GCS)."""
+    global _global_runtime, _global_node
+    with _init_lock:
+        if _global_runtime is not None:
+            if ignore_reinit_error:
+                return _context_info()
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(pass ignore_reinit_error=True to allow)")
+        GLOBAL_CONFIG.initialize(_system_config)
+        from ray_tpu.core.node import Node
+
+        if address is None or address == "local":
+            _global_node = Node(
+                head=True,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                object_store_memory=object_store_memory,
+            )
+            gcs_address = _global_node.gcs_address
+            raylet_address = _global_node.raylet_address
+            session_suffix = _global_node.session_suffix
+            node_id = _global_node.node_id
+        else:
+            gcs_address = address
+            # Attach to a raylet on this machine (prefer the head node's).
+            from ray_tpu.core.rpc import RpcClient
+
+            probe = RpcClient(gcs_address, name="init-probe")
+            try:
+                nodes_ = probe.call("get_nodes")
+            finally:
+                probe.close()
+            alive = [n for n in nodes_ if n["Alive"]]
+            if not alive:
+                raise RaySystemError("no alive nodes in cluster")
+            head = next((n for n in alive if n.get("IsHead")), alive[0])
+            raylet_address = head["RayletAddress"]
+            from ray_tpu.core.ids import NodeID
+
+            node_id = NodeID.from_hex(head["NodeID"])
+            probe2 = RpcClient(raylet_address, name="init-probe2")
+            try:
+                session_suffix = probe2.call("get_session_suffix")["session_suffix"]
+            finally:
+                probe2.close()
+        _global_runtime = CoreRuntime(
+            gcs_address=gcs_address,
+            raylet_address=raylet_address,
+            session_suffix=session_suffix,
+            node_id=node_id,
+            is_driver=True,
+            namespace=namespace,
+        )
+        atexit.register(shutdown)
+        return _context_info()
+
+
+def _context_info() -> Dict[str, Any]:
+    return {
+        "gcs_address": _global_runtime.gcs.address,
+        "raylet_address": _global_runtime.raylet.address,
+        "node_id": _global_runtime.node_id.hex() if _global_runtime.node_id else None,
+        "job_id": _global_runtime.job_id.hex(),
+        "session_dir": getattr(_global_node, "session_dir", None),
+    }
+
+
+def shutdown():
+    global _global_runtime, _global_node
+    with _init_lock:
+        if _global_runtime is not None:
+            try:
+                _global_runtime.shutdown()
+            except Exception:
+                pass
+            _global_runtime = None
+        if _global_node is not None:
+            try:
+                _global_node.shutdown()
+            except Exception:
+                pass
+            _global_node = None
+
+
+# ----------------------------------------------------------------- decorator
+
+
+def remote(*args, **kwargs):
+    """`@ray_tpu.remote` on a function -> RemoteFunction; on a class ->
+    ActorClass. With arguments: `@ray_tpu.remote(num_cpus=2, num_tpus=4)`."""
+
+    def make(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return make
+
+
+def method(num_returns: int = 1, **_ignored):
+    """Decorator to annotate actor methods with num_returns."""
+
+    def wrap(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+
+    return wrap
+
+
+# ----------------------------------------------------------------- data ops
+
+
+def put(value: Any) -> ObjectRef:
+    runtime = _require_runtime()
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed")
+    return ObjectRef(runtime.put(value))
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    runtime = _require_runtime()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = runtime.get([r.object_id for r in ref_list], timeout=timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    runtime = _require_runtime()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    ids = [r.object_id for r in refs]
+    ready_ids, pending_ids = runtime.wait(ids, num_returns=num_returns,
+                                          timeout=timeout)
+    by_bin = {r.object_id.binary(): r for r in refs}
+    return ([by_bin[o.binary()] for o in ready_ids],
+            [by_bin[o.binary()] for o in pending_ids])
+
+
+# ----------------------------------------------------------------- actors
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    runtime = _require_runtime()
+    actor_id, spec = runtime.get_named_actor(name, namespace)
+    return ActorHandle(actor_id, spec.name if spec else "Actor")
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    runtime = _require_runtime()
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle; use cancel() for tasks")
+    runtime.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    # Round-1 semantics: best-effort, queued tasks only (see raylet TODO).
+    logger.warning("cancel() is currently best-effort; running tasks are not "
+                   "interrupted")
+
+
+# ----------------------------------------------------------------- cluster
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _require_runtime().gcs.call("get_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _require_runtime().gcs.call("cluster_resources")["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return _require_runtime().gcs.call("cluster_resources")["available"]
+
+
+def timeline() -> List[Dict[str, Any]]:
+    return _require_runtime().gcs.call("get_task_events", {})["events"]
+
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "method",
+    "put", "get", "wait", "get_actor", "kill", "cancel", "nodes",
+    "cluster_resources", "available_resources", "timeline", "ObjectRef",
+    "ActorHandle", "ActorClass", "RemoteFunction",
+]

@@ -1,0 +1,174 @@
+"""Actor API: `@ray_tpu.remote` on a class.
+
+Equivalent of `python/ray/actor.py` (`ActorClass._remote` :660, `ActorHandle`,
+`ActorMethod`): creation registers the actor with the GCS, which schedules a
+dedicated worker; method calls go over the direct worker transport with
+per-caller ordering. Handles are picklable and resolvable by name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import TaskSpec, normalize_resources
+from ray_tpu.core.ids import ActorID, TaskID
+from ray_tpu.object_ref import ObjectRef
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "memory", "resources", "max_restarts",
+    "max_task_retries", "max_concurrency", "name", "namespace", "lifetime",
+    "get_if_exists", "scheduling_strategy", "runtime_env", "_metadata",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method_name, args, kwargs,
+                                    self._num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "Actor",
+                 method_num_returns: Optional[Dict[str, int]] = None):
+        self._ray_actor_id = actor_id
+        self._class_name = class_name
+        self._method_num_returns = method_num_returns or {}
+
+    @property
+    def _actor_id(self) -> ActorID:
+        return self._ray_actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_num_returns.get(name, 1))
+
+    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+        import ray_tpu
+
+        runtime = ray_tpu._require_runtime()
+        ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(self._ray_actor_id),
+            job_id=runtime.job_id,
+            name=f"{self._class_name}.{method_name}",
+            function_id=None,
+            function_blob=None,
+            args=ser_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=num_returns,
+            actor_id=self._ray_actor_id,
+            method_name=method_name,
+            owner_address=runtime.worker_id.hex(),
+        )
+        return_ids = runtime.submit_actor_task(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if num_returns == 1:
+            return refs[0]
+        return refs if num_returns else None
+
+    def __ray_terminate__(self):
+        return self._invoke("__ray_terminate__", (), {}, 0)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._ray_actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._ray_actor_id, self._class_name,
+                              self._method_num_returns))
+
+    def __hash__(self):
+        return hash(self._ray_actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and \
+            other._ray_actor_id == self._ray_actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        bad = set(self._options) - _VALID_ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid actor options: {bad}")
+        self._class_blob: Optional[bytes] = None
+
+    def options(self, **kwargs) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(kwargs)
+        return ActorClass(self._cls, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote().")
+
+    @property
+    def cls(self):
+        return self._cls
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        import ray_tpu
+
+        runtime = ray_tpu._require_runtime()
+        opts = self._options
+        name = opts.get("name")
+        namespace = opts.get("namespace") or runtime.namespace
+        if name and opts.get("get_if_exists"):
+            try:
+                actor_id, spec = runtime.get_named_actor(name, namespace)
+                return ActorHandle(actor_id, self._cls.__name__)
+            except ValueError:
+                pass
+        if self._class_blob is None:
+            self._class_blob = serialization.dumps(self._cls)
+        resources = normalize_resources(
+            num_cpus=opts.get("num_cpus"),
+            num_gpus=opts.get("num_gpus"),
+            num_tpus=opts.get("num_tpus"),
+            memory=opts.get("memory"),
+            resources=opts.get("resources"),
+            default_cpus=1.0,
+        )
+        from ray_tpu.remote_function import _resolve_pg_strategy
+
+        resources, strategy, pg_id, bundle_idx = _resolve_pg_strategy(opts, resources)
+        ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
+        actor_id = ActorID.of(runtime.job_id)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=runtime.job_id,
+            name=self._cls.__name__,
+            function_id=None,
+            function_blob=None,
+            args=ser_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=0,
+            resources=resources,
+            actor_id=actor_id,
+            actor_creation=True,
+            actor_class_blob=self._class_blob,
+            actor_max_restarts=opts.get("max_restarts", 0),
+            actor_max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=name,
+            actor_namespace=namespace,
+            actor_lifetime=opts.get("lifetime"),
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_idx,
+            owner_address=runtime.worker_id.hex(),
+            runtime_env=opts.get("runtime_env"),
+        )
+        runtime.create_actor(spec)
+        return ActorHandle(actor_id, self._cls.__name__)

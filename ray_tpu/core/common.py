@@ -1,0 +1,214 @@
+"""Shared control-plane data types: task specs, actor specs, node info.
+
+Equivalent of the reference's `src/ray/common/task/task_spec.h` and
+`gcs.proto` node/actor table entries, as plain picklable dataclasses.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+# Resource names. TPU is first-class (the reference only has CPU/GPU/custom:
+# `python/ray/util/accelerators/accelerators.py` has no TPU entry).
+CPU = "CPU"
+GPU = "GPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+
+def normalize_resources(
+    num_cpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    memory: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    default_cpus: float = 1.0,
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    out[CPU] = float(num_cpus) if num_cpus is not None else default_cpus
+    if num_gpus:
+        out[GPU] = float(num_gpus)
+    if num_tpus:
+        out[TPU] = float(num_tpus)
+    if memory:
+        out[MEMORY] = float(memory)
+    if resources:
+        for k, v in resources.items():
+            if k in (CPU, GPU, TPU, MEMORY):
+                raise ValueError(f"Use num_cpus/num_gpus/num_tpus/memory instead of resources[{k!r}]")
+            out[k] = float(v)
+    return {k: v for k, v in out.items() if v != 0}
+
+
+class ActorState(str, Enum):
+    # Mirrors the GCS-owned actor lifecycle state machine
+    # (reference `gcs_actor_manager.h:240-281`).
+    DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class TaskState(str, Enum):
+    PENDING_ARGS_AVAIL = "PENDING_ARGS_AVAIL"
+    PENDING_NODE_ASSIGNMENT = "PENDING_NODE_ASSIGNMENT"
+    PENDING_ARGS_FETCH = "PENDING_ARGS_FETCH"
+    SUBMITTED_TO_WORKER = "SUBMITTED_TO_WORKER"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+
+
+class SchedulingStrategy:
+    """Base marker; see ray_tpu.util.scheduling_strategies for concrete ones."""
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # Function is either inline pickled bytes (small closures) or a function_id
+    # key into the GCS function table (exported once per driver).
+    function_id: Optional[str]
+    function_blob: Optional[bytes]
+    # Args: list of ("v", pickled bytes) inline values or ("r", ObjectID) refs.
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs_keys: List[str] = field(default_factory=list)  # last len(kwargs_keys) args are kwargs
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None          # actor task target
+    actor_creation: bool = False                # this task creates an actor
+    actor_class_blob: Optional[bytes] = None
+    actor_max_restarts: int = 0
+    actor_max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    actor_namespace: Optional[str] = None
+    actor_lifetime: Optional[str] = None        # None | "detached"
+    method_name: Optional[str] = None
+    seq_no: int = 0
+    # Scheduling
+    scheduling_strategy: Optional[Any] = None   # SchedulingStrategy instance
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    owner_address: Optional[str] = None         # submitter's callback address (raylet conn)
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Provenance for state API / timeline
+    submitted_at: float = field(default_factory=time.time)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    def dependencies(self) -> List[ObjectID]:
+        return [a[1] for a in self.args if a[0] == "r"]
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str                    # raylet RPC address
+    object_manager_address: str     # raylet's object transfer address (same server)
+    session_suffix: str             # shm namespace for the node's store
+    hostname: str = ""
+    ip: str = "127.0.0.1"
+    resources_total: Dict[str, float] = field(default_factory=dict)
+    resources_available: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    state: str = "ALIVE"            # ALIVE | DEAD
+    last_heartbeat: float = field(default_factory=time.time)
+    is_head: bool = False
+
+    def to_public(self) -> Dict[str, Any]:
+        return {
+            "NodeID": self.node_id.hex(),
+            "Alive": self.state == "ALIVE",
+            "NodeManagerAddress": self.ip,
+            "NodeManagerHostname": self.hostname,
+            "RayletAddress": self.address,
+            "Resources": dict(self.resources_total),
+            "Available": dict(self.resources_available),
+            "Labels": dict(self.labels),
+            "IsHead": self.is_head,
+        }
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    job_id: JobID
+    class_name: str
+    state: ActorState = ActorState.DEPENDENCIES_UNREADY
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    direct_address: Optional[str] = None   # worker's direct-call RPC server
+    name: Optional[str] = None
+    namespace: str = "default"
+    max_restarts: int = 0
+    num_restarts: int = 0
+    lifetime: Optional[str] = None
+    death_cause: Optional[str] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    creation_spec: Optional[TaskSpec] = None
+    owner_worker_id: Optional[WorkerID] = None
+
+    def to_public(self) -> Dict[str, Any]:
+        return {
+            "ActorID": self.actor_id.hex(),
+            "ClassName": self.class_name,
+            "State": self.state.value,
+            "Name": self.name or "",
+            "Namespace": self.namespace,
+            "NodeID": self.node_id.hex() if self.node_id else None,
+            "Address": self.direct_address,
+            "NumRestarts": self.num_restarts,
+            "DeathCause": self.death_cause,
+        }
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    driver_pid: int
+    entrypoint: str = ""
+    state: str = "RUNNING"           # RUNNING | SUCCEEDED | FAILED
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    namespace: str = "default"
+
+
+class PlacementStrategy(str, Enum):
+    PACK = "PACK"
+    SPREAD = "SPREAD"
+    STRICT_PACK = "STRICT_PACK"
+    STRICT_SPREAD = "STRICT_SPREAD"
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: PlacementStrategy
+    name: Optional[str] = None
+    state: str = "PENDING"           # PENDING | CREATED | REMOVED | RESCHEDULING
+    # bundle index -> node id, filled at commit time
+    bundle_locations: Dict[int, NodeID] = field(default_factory=dict)
+    job_id: Optional[JobID] = None
+    lifetime: Optional[str] = None
+
+    def bundle_resource_name(self, base: str, index: int) -> str:
+        # `CPU_group_0_<pgid>` style wildcard/indexed names as in the reference
+        # (`src/ray/common/placement_group.h` BundleSpec resource formatting).
+        return f"{base}_group_{index}_{self.pg_id.hex()}"
+
+    def wildcard_resource_name(self, base: str) -> str:
+        return f"{base}_group_{self.pg_id.hex()}"

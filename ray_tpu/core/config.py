@@ -1,0 +1,114 @@
+"""Global configuration flag table.
+
+Equivalent of the reference's X-macro flag system (`src/ray/common/ray_config_def.h`:
+199 `RAY_CONFIG(type, name, default)` entries, overridable via `RAY_<name>` env vars
+and the `_system_config` dict passed to init). Here: a declarative table, overridable
+via `RAY_TPU_<NAME>` environment variables and `init(_system_config=...)`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: Callable
+    default: Any
+    doc: str
+
+
+_FLAG_TABLE: Dict[str, _Flag] = {}
+
+
+def _flag(name: str, type_: Callable, default: Any, doc: str = ""):
+    _FLAG_TABLE[name] = _Flag(name, type_, default, doc)
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+# --- Core runtime -----------------------------------------------------------
+_flag("raylet_heartbeat_period_ms", int, 1000, "Raylet -> GCS resource report period")
+_flag("health_check_period_ms", int, 2000, "GCS node health check period")
+_flag("health_check_failure_threshold", int, 5, "Missed health checks before a node is marked dead")
+_flag("worker_lease_timeout_ms", int, 30000, "Max time waiting for a worker lease")
+_flag("worker_pool_prestart", int, 0, "Number of workers to prestart per node")
+_flag("worker_idle_timeout_ms", int, 60000, "Idle worker reap timeout")
+_flag("max_pending_lease_requests", int, 10, "In-flight lease requests per scheduling key")
+_flag("object_inline_max_bytes", int, 100 * 1024, "Objects at or below this size travel inline through the control plane")
+_flag("object_store_memory_bytes", int, 0, "Shared-memory store capacity; 0 = auto (30% of system RAM)")
+_flag("object_spill_threshold", float, 0.8, "Store fullness fraction that triggers spilling")
+_flag("object_spill_dir", str, "", "Directory for spilled objects; empty = <session>/spill")
+_flag("task_max_retries", int, 3, "Default retries for normal tasks")
+_flag("actor_max_restarts", int, 0, "Default actor restarts")
+_flag("scheduler_top_k_fraction", float, 0.2, "Hybrid policy: random choice among top-k fraction of nodes")
+_flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: utilization below which packing is preferred")
+_flag("rpc_connect_timeout_s", float, 10.0, "TCP connect timeout for internal RPC")
+_flag("rpc_call_timeout_s", float, 120.0, "Default RPC call timeout")
+_flag("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for pubsub subscribers")
+_flag("event_stats", bool, False, "Record per-handler event loop stats")
+_flag("task_events_max_buffer", int, 100000, "Max task events retained by the GCS task manager")
+_flag("memory_usage_threshold", float, 0.95, "Node memory fraction that triggers the OOM killer")
+_flag("memory_monitor_refresh_ms", int, 0, "Memory monitor period; 0 disables")
+_flag("gcs_storage", str, "memory", "GCS table storage backend: memory | file")
+_flag("gcs_storage_path", str, "", "Persistence path for the file storage backend")
+_flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
+_flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
+
+# --- TPU / JAX specifics ----------------------------------------------------
+_flag("tpu_chips_per_host", int, 4, "Default chips per TPU host when not detected")
+_flag("jax_coordinator_port", int, 0, "Port for jax.distributed coordinator; 0 = auto")
+_flag("mesh_default_axes", str, "dp,fsdp,tp", "Default logical mesh axis order")
+
+
+class RayTpuConfig:
+    """Process-wide config instance; values resolved lazily from env."""
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        flag = _FLAG_TABLE.get(name)
+        if flag is None:
+            raise AttributeError(f"Unknown config flag: {name}")
+        if name in self._overrides:
+            return self._overrides[name]
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            return _parse_bool(env) if flag.type is bool else flag.type(env)
+        return flag.default
+
+    def initialize(self, system_config: Dict[str, Any] | None):
+        """Apply a `_system_config` dict (propagated cluster-wide via env)."""
+        if not system_config:
+            return
+        for k, v in system_config.items():
+            if k not in _FLAG_TABLE:
+                raise ValueError(f"Unknown system config key: {k}")
+            flag = _FLAG_TABLE[k]
+            self._overrides[k] = _parse_bool(v) if flag.type is bool else flag.type(v)
+
+    def to_env(self) -> Dict[str, str]:
+        """Serialize overrides as env vars for child processes."""
+        out = {}
+        for k, v in self._overrides.items():
+            out[_ENV_PREFIX + k.upper()] = json.dumps(v) if not isinstance(v, str) else v
+        return out
+
+    def dump(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _FLAG_TABLE}
+
+
+GLOBAL_CONFIG = RayTpuConfig()

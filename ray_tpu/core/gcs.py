@@ -1,0 +1,821 @@
+"""GCS: the cluster-global control plane.
+
+Equivalent of the reference's GCS server (`src/ray/gcs/gcs_server/`): node
+membership + health checks (`gcs_health_check_manager.h`), the actor directory
+and lifecycle state machine (`gcs_actor_manager.h:240-281`), jobs, an internal
+KV store (function table, library state), pubsub (`pubsub_handler.h`), the
+global object directory (the reference spreads this across owners +
+`ownership_based_object_directory.h`; we centralize it — the owner metadata is
+still recorded so fate-sharing semantics hold), placement groups with the
+prepare/commit 2PC (`gcs_placement_group_scheduler.h:104-106`), and bounded
+task-event storage (`gcs_task_manager.h:61`).
+
+Runs as a thread inside the head process (default) or standalone via
+`python -m ray_tpu.core.gcs`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict, deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import (
+    ActorInfo,
+    ActorState,
+    JobInfo,
+    NodeInfo,
+    PlacementGroupInfo,
+    PlacementStrategy,
+)
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.rpc import Connection, RpcClient, RpcServer
+from ray_tpu.exceptions import RaySystemError
+
+logger = logging.getLogger(__name__)
+
+# Pubsub channels
+CH_ACTOR = "ACTOR"
+CH_NODE = "NODE"
+CH_OBJECT = "OBJECT"
+CH_RESOURCES = "RESOURCES"
+CH_ERROR = "ERROR"
+CH_LOG = "LOG"
+CH_PG = "PG"
+
+
+class Pubsub:
+    """Connection-push based pub/sub (reference: `src/ray/pubsub/publisher.h`).
+
+    Subscribers register (channel, key) on their GCS connection; publishes are
+    pushed down those connections as `pubsub` messages. key=b"*" subscribes to
+    the whole channel.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[Tuple[str, bytes], Set[Connection]] = defaultdict(set)
+
+    def subscribe(self, conn: Connection, channel: str, key: bytes):
+        with self._lock:
+            self._subs[(channel, key)].add(conn)
+
+    def unsubscribe(self, conn: Connection, channel: str, key: bytes):
+        with self._lock:
+            self._subs[(channel, key)].discard(conn)
+
+    def drop_connection(self, conn: Connection):
+        with self._lock:
+            for subs in self._subs.values():
+                subs.discard(conn)
+
+    def publish(self, channel: str, key: bytes, message: Any):
+        with self._lock:
+            targets = list(self._subs.get((channel, key), ())) + list(
+                self._subs.get((channel, b"*"), ())
+            )
+        dead = []
+        for conn in targets:
+            try:
+                conn.push("pubsub", {"channel": channel, "key": key, "message": message})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self.drop_connection(conn)
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(host=host, port=port, name="gcs")
+        self.server.register_instance(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.pubsub = Pubsub()
+        self._lock = threading.RLock()
+        self._exec = ThreadPoolExecutor(max_workers=8, thread_name_prefix="gcs-bg")
+
+        # Tables
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.kv: Dict[Tuple[str, bytes], bytes] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        # Object directory: object_id -> {nodes: set[NodeID], size, inline: bytes|None, owner}
+        self.objects: Dict[ObjectID, Dict[str, Any]] = {}
+        # Task events ring buffer for the state API / timeline
+        self.task_events: deque = deque(maxlen=GLOBAL_CONFIG.task_events_max_buffer)
+
+        # Raylet clients for GCS-initiated RPCs (actor creation, 2PC, deletes)
+        self._raylet_clients: Dict[NodeID, RpcClient] = {}
+        # Connection -> metadata for cleanup (drivers register jobs; raylets nodes)
+        self._job_counter = 1
+        self._stopped = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ util
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self):
+        self.server.start()
+        self._health_thread = threading.Thread(
+            target=self._health_check_loop, name="gcs-health", daemon=True
+        )
+        self._health_thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        self.server.stop()
+        for c in self._raylet_clients.values():
+            c.close()
+        self._exec.shutdown(wait=False)
+
+    def _raylet(self, node_id: NodeID) -> RpcClient:
+        with self._lock:
+            client = self._raylet_clients.get(node_id)
+            if client is not None and not client.is_closed:
+                return client
+            info = self.nodes.get(node_id)
+            if info is None or info.state != "ALIVE":
+                raise RaySystemError(f"Node {node_id} is not alive")
+            client = RpcClient(info.address, name=f"gcs->raylet-{node_id.hex()[:8]}")
+            self._raylet_clients[node_id] = client
+            return client
+
+    # ------------------------------------------------------- node management
+
+    def handle_register_node(self, conn: Connection, data: Dict[str, Any]):
+        info: NodeInfo = data["info"]
+        with self._lock:
+            self.nodes[info.node_id] = info
+            conn.meta["node_id"] = info.node_id
+        logger.info("Node %s registered at %s, resources=%s", info.node_id.hex()[:12],
+                    info.address, info.resources_total)
+        self.pubsub.publish(CH_NODE, b"*", {"event": "alive", "node": info.to_public()})
+        self._broadcast_resource_view()
+        return {"node_count": len(self.nodes)}
+
+    def handle_heartbeat(self, conn: Connection, data: Dict[str, Any]):
+        node_id: NodeID = data["node_id"]
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None:
+                return {"registered": False}
+            info.last_heartbeat = time.time()
+            info.resources_available = data["resources_available"]
+            info.resources_total = data.get("resources_total", info.resources_total)
+        if data.get("broadcast", True):
+            self._broadcast_resource_view()
+        return {"registered": True}
+
+    def handle_drain_node(self, conn: Connection, data: Dict[str, Any]):
+        self._mark_node_dead(data["node_id"], reason="drained")
+        return {}
+
+    def handle_get_nodes(self, conn: Connection, data=None):
+        with self._lock:
+            return [n.to_public() for n in self.nodes.values()]
+
+    def handle_get_resource_view(self, conn: Connection, data=None):
+        return self._resource_view()
+
+    def _resource_view(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                n.node_id.hex(): {
+                    "address": n.address,
+                    "total": dict(n.resources_total),
+                    "available": dict(n.resources_available),
+                    "alive": n.state == "ALIVE",
+                    "labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            }
+
+    def _broadcast_resource_view(self):
+        self.pubsub.publish(CH_RESOURCES, b"*", self._resource_view())
+
+    def _health_check_loop(self):
+        period = GLOBAL_CONFIG.health_check_period_ms / 1000.0
+        threshold = GLOBAL_CONFIG.health_check_failure_threshold
+        while not self._stopped.wait(period):
+            now = time.time()
+            dead = []
+            with self._lock:
+                for info in self.nodes.values():
+                    if info.state == "ALIVE" and now - info.last_heartbeat > period * threshold:
+                        dead.append(info.node_id)
+            for node_id in dead:
+                self._mark_node_dead(node_id, reason="missed heartbeats")
+
+    def _mark_node_dead(self, node_id: NodeID, reason: str):
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return
+            info.state = "DEAD"
+            client = self._raylet_clients.pop(node_id, None)
+        if client:
+            client.close()
+        logger.warning("Node %s marked DEAD (%s)", node_id.hex()[:12], reason)
+        self.pubsub.publish(CH_NODE, b"*", {"event": "dead", "node_id": node_id.hex()})
+        # Objects whose only copy was there are lost; actors there die/restart.
+        with self._lock:
+            for oid, entry in list(self.objects.items()):
+                entry["nodes"].discard(node_id)
+            affected = [a for a in self.actors.values() if a.node_id == node_id
+                        and a.state in (ActorState.ALIVE, ActorState.PENDING_CREATION,
+                                        ActorState.RESTARTING)]
+        for actor in affected:
+            self._on_actor_failure(actor, f"node {node_id.hex()[:12]} died: {reason}")
+        self._broadcast_resource_view()
+
+    # -------------------------------------------------------- job management
+
+    def handle_register_job(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            job_id = JobID.from_int(self._job_counter)
+            self._job_counter += 1
+            info = JobInfo(job_id=job_id, driver_pid=data.get("pid", 0),
+                           entrypoint=data.get("entrypoint", ""),
+                           namespace=data.get("namespace", "default"))
+            self.jobs[job_id] = info
+            conn.meta["job_id"] = job_id
+        return {"job_id": job_id}
+
+    def handle_get_jobs(self, conn: Connection, data=None):
+        with self._lock:
+            return [
+                {"JobID": j.job_id.hex(), "State": j.state, "StartTime": j.start_time,
+                 "EndTime": j.end_time, "Entrypoint": j.entrypoint}
+                for j in self.jobs.values()
+            ]
+
+    def _finish_job(self, job_id: JobID, state: str = "SUCCEEDED"):
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None or job.state != "RUNNING":
+                return
+            job.state = state
+            job.end_time = time.time()
+            doomed = [a for a in self.actors.values()
+                      if a.job_id == job_id and a.lifetime != "detached"
+                      and a.state not in (ActorState.DEAD,)]
+            doomed_pgs = [pg for pg in self.placement_groups.values()
+                          if pg.job_id == job_id and pg.lifetime != "detached"
+                          and pg.state != "REMOVED"]
+        try:
+            for actor in doomed:
+                self._exec.submit(self._kill_actor, actor.actor_id,
+                                  "owner job finished", True)
+            for pg in doomed_pgs:
+                self._exec.submit(self._remove_placement_group, pg.pg_id)
+        except RuntimeError:
+            pass  # executor already shut down
+
+    def _on_disconnect(self, conn: Connection):
+        self.pubsub.drop_connection(conn)
+        job_id = conn.meta.get("job_id")
+        if job_id is not None:
+            self._finish_job(job_id)
+        node_id = conn.meta.get("node_id")
+        if node_id is not None:
+            self._mark_node_dead(node_id, reason="raylet disconnected")
+
+    # ----------------------------------------------------------------- pubsub
+
+    def handle_subscribe(self, conn: Connection, data: Dict[str, Any]):
+        self.pubsub.subscribe(conn, data["channel"], data.get("key", b"*"))
+        return {}
+
+    def handle_unsubscribe(self, conn: Connection, data: Dict[str, Any]):
+        self.pubsub.unsubscribe(conn, data["channel"], data.get("key", b"*"))
+        return {}
+
+    def handle_publish(self, conn: Connection, data: Dict[str, Any]):
+        self.pubsub.publish(data["channel"], data.get("key", b"*"), data["message"])
+        return {}
+
+    # --------------------------------------------------------------- KV store
+
+    def handle_kv_put(self, conn: Connection, data: Dict[str, Any]):
+        ns, key = data.get("namespace", ""), data["key"]
+        overwrite = data.get("overwrite", True)
+        with self._lock:
+            exists = (ns, key) in self.kv
+            if exists and not overwrite:
+                return {"added": False}
+            self.kv[(ns, key)] = data["value"]
+        return {"added": True}
+
+    def handle_kv_get(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            return {"value": self.kv.get((data.get("namespace", ""), data["key"]))}
+
+    def handle_kv_del(self, conn: Connection, data: Dict[str, Any]):
+        ns, key = data.get("namespace", ""), data["key"]
+        with self._lock:
+            if data.get("prefix"):
+                doomed = [k for k in self.kv if k[0] == ns and k[1].startswith(key)]
+                for k in doomed:
+                    del self.kv[k]
+                return {"deleted": len(doomed)}
+            return {"deleted": int(self.kv.pop((ns, key), None) is not None)}
+
+    def handle_kv_keys(self, conn: Connection, data: Dict[str, Any]):
+        ns, prefix = data.get("namespace", ""), data.get("prefix", b"")
+        with self._lock:
+            return {"keys": [k[1] for k in self.kv if k[0] == ns and k[1].startswith(prefix)]}
+
+    def handle_kv_exists(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            return {"exists": (data.get("namespace", ""), data["key"]) in self.kv}
+
+    # ------------------------------------------------------- object directory
+
+    def handle_object_location_add(self, conn: Connection, data: Dict[str, Any]):
+        oid: ObjectID = data["object_id"]
+        with self._lock:
+            entry = self.objects.setdefault(
+                oid, {"nodes": set(), "size": 0, "inline": None, "owner": None})
+            if data.get("node_id") is not None:
+                entry["nodes"].add(data["node_id"])
+            entry["size"] = data.get("size", entry["size"])
+            if data.get("inline") is not None:
+                entry["inline"] = data["inline"]
+            if data.get("owner") is not None:
+                entry["owner"] = data["owner"]
+        self.pubsub.publish(CH_OBJECT, oid.binary(), self._object_entry_public(oid))
+        return {}
+
+    def handle_object_location_remove(self, conn: Connection, data: Dict[str, Any]):
+        oid: ObjectID = data["object_id"]
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry:
+                entry["nodes"].discard(data["node_id"])
+        return {}
+
+    def handle_object_locations_get(self, conn: Connection, data: Dict[str, Any]):
+        return self._object_entry_public(data["object_id"])
+
+    def _object_entry_public(self, oid: ObjectID) -> Dict[str, Any]:
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is None:
+                return {"known": False}
+            return {
+                "known": True,
+                "nodes": [n for n in entry["nodes"]],
+                "size": entry["size"],
+                "inline": entry["inline"],
+                "owner": entry["owner"],
+            }
+
+    def handle_free_objects(self, conn: Connection, data: Dict[str, Any]):
+        oids: List[ObjectID] = data["object_ids"]
+        by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
+        with self._lock:
+            for oid in oids:
+                entry = self.objects.pop(oid, None)
+                if entry:
+                    for node_id in entry["nodes"]:
+                        by_node[node_id].append(oid)
+        for node_id, node_oids in by_node.items():
+            try:
+                self._raylet(node_id).call("delete_objects", {"object_ids": node_oids}, timeout=5)
+            except Exception:
+                pass
+        return {}
+
+    # ------------------------------------------------------- actor management
+
+    def handle_register_actor(self, conn: Connection, data: Dict[str, Any]):
+        """Async actor creation: record, schedule in background, publish state."""
+        spec = data["spec"]  # TaskSpec with actor_creation=True
+        actor_id = spec.actor_id
+        info = ActorInfo(
+            actor_id=actor_id,
+            job_id=spec.job_id,
+            class_name=spec.name,
+            state=ActorState.PENDING_CREATION,
+            name=spec.actor_name,
+            namespace=spec.actor_namespace or "default",
+            max_restarts=spec.actor_max_restarts,
+            lifetime=spec.actor_lifetime,
+            resources=dict(spec.resources),
+            creation_spec=spec,
+        )
+        with self._lock:
+            if spec.actor_name:
+                key = (info.namespace, spec.actor_name)
+                if key in self.named_actors:
+                    existing = self.actors.get(self.named_actors[key])
+                    if existing is not None and existing.state != ActorState.DEAD:
+                        raise RaySystemError(
+                            f"Actor name '{spec.actor_name}' already taken in "
+                            f"namespace '{info.namespace}'")
+                self.named_actors[key] = actor_id
+            self.actors[actor_id] = info
+        self._exec.submit(self._schedule_actor, actor_id)
+        return {}
+
+    def _schedule_actor(self, actor_id: ActorID):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            spec = info.creation_spec
+        deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0 * 10
+        while not self._stopped.is_set():
+            node_id = self._pick_node_for(spec)
+            if node_id is None:
+                if time.monotonic() > deadline:
+                    self._actor_dead(actor_id, "no node with required resources "
+                                               f"{spec.resources} became available")
+                    return
+                time.sleep(0.2)
+                continue
+            try:
+                # Dedicated connection: create_actor blocks for the whole
+                # worker spawn + __init__, and RPC connections process
+                # requests serially — don't head-of-line-block the shared
+                # GCS->raylet client (kill_worker, bundle 2PC, deletes).
+                with self._lock:
+                    info = self.nodes.get(node_id)
+                if info is None or info.state != "ALIVE":
+                    time.sleep(0.2)
+                    continue
+                create_client = RpcClient(
+                    info.address, name=f"gcs-create-actor-{actor_id.hex()[:8]}")
+                try:
+                    resp = create_client.call(
+                        "create_actor", {"spec": spec},
+                        timeout=GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0 * 2)
+                finally:
+                    create_client.close()
+            except Exception as e:
+                logger.warning("actor %s creation on %s failed: %s",
+                               actor_id.hex()[:12], node_id.hex()[:12], e)
+                time.sleep(0.2)
+                continue
+            if resp.get("status") == "ok":
+                with self._lock:
+                    info = self.actors[actor_id]
+                    info.state = ActorState.ALIVE
+                    info.node_id = node_id
+                    info.worker_id = resp["worker_id"]
+                    info.direct_address = resp["direct_address"]
+                self.pubsub.publish(CH_ACTOR, actor_id.binary(),
+                                    {"state": "ALIVE", "address": resp["direct_address"]})
+                return
+            elif resp.get("status") == "error":
+                # Creation task itself failed (user __init__ raised): actor dead.
+                self._actor_dead(actor_id, resp.get("error", "creation failed"),
+                                 error_blob=resp.get("error_blob"))
+                return
+            # status == "retry": node couldn't take it (resources raced); loop.
+            time.sleep(0.1)
+
+    def _pick_node_for(self, spec) -> Optional[NodeID]:
+        """Resource-feasibility + packing score over the cluster view."""
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        strategy = spec.scheduling_strategy
+        with self._lock:
+            candidates = []
+            for info in self.nodes.values():
+                if info.state != "ALIVE":
+                    continue
+                avail = info.resources_available
+                if all(avail.get(r, 0.0) >= amt for r, amt in spec.resources.items()):
+                    candidates.append(info)
+            if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                target = next((c for c in candidates
+                               if c.node_id.hex() == strategy.node_id), None)
+                if target is None and not strategy.soft:
+                    return None
+                if target is not None:
+                    return target.node_id
+            if not candidates:
+                return None
+            # Pack: most-utilized feasible node first (binpacking friendly).
+            def score(n: NodeInfo):
+                total = sum(n.resources_total.values()) or 1.0
+                avail = sum(n.resources_available.values())
+                return avail / total
+            candidates.sort(key=score)
+            return candidates[0].node_id
+
+    def _on_actor_failure(self, info: ActorInfo, reason: str):
+        with self._lock:
+            if info.state == ActorState.DEAD:
+                return
+            restarts_left = (info.max_restarts == -1
+                             or info.num_restarts < info.max_restarts)
+            if restarts_left:
+                info.num_restarts += 1
+                info.state = ActorState.RESTARTING
+                info.direct_address = None
+                actor_id = info.actor_id
+            else:
+                actor_id = None
+        if actor_id is not None:
+            self.pubsub.publish(CH_ACTOR, info.actor_id.binary(), {"state": "RESTARTING"})
+            self._exec.submit(self._schedule_actor, info.actor_id)
+        else:
+            self._actor_dead(info.actor_id, reason)
+
+    def _actor_dead(self, actor_id: ActorID, reason: str, error_blob: Optional[bytes] = None):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            info.state = ActorState.DEAD
+            info.death_cause = reason
+            info.direct_address = None
+            if info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+        self.pubsub.publish(CH_ACTOR, actor_id.binary(),
+                            {"state": "DEAD", "reason": reason, "error_blob": error_blob})
+
+    def handle_actor_died(self, conn: Connection, data: Dict[str, Any]):
+        """Raylet reports a dedicated actor worker exited."""
+        actor_id: ActorID = data["actor_id"]
+        with self._lock:
+            info = self.actors.get(actor_id)
+        if info is None:
+            return {}
+        if data.get("intended"):
+            self._actor_dead(actor_id, data.get("reason", "killed"))
+        else:
+            self._on_actor_failure(info, data.get("reason", "worker died"))
+        return {}
+
+    def handle_kill_actor(self, conn: Connection, data: Dict[str, Any]):
+        self._kill_actor(data["actor_id"], data.get("reason", "ray_tpu.kill"),
+                         data.get("no_restart", True))
+        return {}
+
+    def _kill_actor(self, actor_id: ActorID, reason: str, no_restart: bool):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            node_id, worker_id = info.node_id, info.worker_id
+            if no_restart:
+                info.max_restarts = info.num_restarts  # exhaust restarts
+        if node_id is not None:
+            try:
+                self._raylet(node_id).call(
+                    "kill_worker", {"worker_id": worker_id, "actor_id": actor_id,
+                                    "reason": reason, "intended": True,
+                                    "suppress_report": no_restart}, timeout=10)
+            except Exception:
+                pass
+        if no_restart:
+            self._actor_dead(actor_id, reason)
+
+    def handle_get_actor_info(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            info = self.actors.get(data["actor_id"])
+            if info is None:
+                return {"known": False}
+            return {"known": True, "state": info.state.value,
+                    "address": info.direct_address, "death_cause": info.death_cause,
+                    "class_name": info.class_name}
+
+    def handle_get_named_actor(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            actor_id = self.named_actors.get((data.get("namespace", "default"), data["name"]))
+            if actor_id is None:
+                return {"found": False}
+            info = self.actors[actor_id]
+            return {"found": True, "actor_id": actor_id,
+                    "creation_spec": info.creation_spec, "state": info.state.value}
+
+    def handle_list_named_actors(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            if data.get("all_namespaces"):
+                return {"names": [{"namespace": ns, "name": n}
+                                  for (ns, n) in self.named_actors]}
+            ns = data.get("namespace", "default")
+            return {"names": [{"namespace": k[0], "name": k[1]}
+                              for k in self.named_actors if k[0] == ns]}
+
+    def handle_get_actors(self, conn: Connection, data=None):
+        with self._lock:
+            return [a.to_public() for a in self.actors.values()]
+
+    # ---------------------------------------------------- placement groups
+
+    def handle_create_placement_group(self, conn: Connection, data: Dict[str, Any]):
+        pg: PlacementGroupInfo = data["pg"]
+        with self._lock:
+            self.placement_groups[pg.pg_id] = pg
+        self._exec.submit(self._schedule_placement_group, pg.pg_id)
+        return {}
+
+    def _schedule_placement_group(self, pg_id: PlacementGroupID):
+        """Two-phase commit of bundle reservations across raylets
+        (reference `gcs_placement_group_scheduler.h` Prepare/Commit)."""
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                return
+        deadline = time.monotonic() + 60.0
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            placement = self._plan_bundles(pg)
+            if placement is None:
+                time.sleep(0.2)
+                continue
+            prepared: List[Tuple[NodeID, int]] = []
+            ok = True
+            for bundle_index, node_id in placement.items():
+                try:
+                    resp = self._raylet(node_id).call(
+                        "prepare_bundle",
+                        {"pg": pg, "bundle_index": bundle_index}, timeout=15)
+                    if not resp.get("ok"):
+                        ok = False
+                        break
+                    prepared.append((node_id, bundle_index))
+                except Exception:
+                    ok = False
+                    break
+            if not ok:
+                for node_id, bundle_index in prepared:
+                    try:
+                        self._raylet(node_id).call(
+                            "cancel_bundle", {"pg_id": pg.pg_id,
+                                              "bundle_index": bundle_index}, timeout=15)
+                    except Exception:
+                        pass
+                time.sleep(0.2)
+                continue
+            for node_id, bundle_index in prepared:
+                self._raylet(node_id).call(
+                    "commit_bundle", {"pg_id": pg.pg_id, "bundle_index": bundle_index},
+                    timeout=15)
+            with self._lock:
+                pg.state = "CREATED"
+                pg.bundle_locations = dict(placement)
+            self.pubsub.publish(CH_PG, pg.pg_id.binary(), {"state": "CREATED"})
+            return
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg.state == "PENDING":
+                pg.state = "INFEASIBLE"
+        self.pubsub.publish(CH_PG, pg_id.binary(), {"state": "INFEASIBLE"})
+
+    def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, NodeID]]:
+        with self._lock:
+            nodes = [n for n in self.nodes.values() if n.state == "ALIVE"]
+            avail = {n.node_id: dict(n.resources_available) for n in nodes}
+
+        def fits(node_id, bundle):
+            return all(avail[node_id].get(r, 0) >= amt for r, amt in bundle.items())
+
+        def take(node_id, bundle):
+            for r, amt in bundle.items():
+                avail[node_id][r] = avail[node_id].get(r, 0) - amt
+
+        placement: Dict[int, NodeID] = {}
+        order = list(range(len(pg.bundles)))
+        if pg.strategy in (PlacementStrategy.STRICT_PACK,):
+            for n in nodes:
+                trial = {r: v for r, v in avail[n.node_id].items()}
+                if all(all(trial.get(r, 0) >= amt for r, amt in b.items()) or True
+                       for b in pg.bundles):
+                    # check cumulative fit
+                    ok = True
+                    for b in pg.bundles:
+                        if all(trial.get(r, 0) >= amt for r, amt in b.items()):
+                            for r, amt in b.items():
+                                trial[r] -= amt
+                        else:
+                            ok = False
+                            break
+                    if ok:
+                        return {i: n.node_id for i in order}
+            return None
+        if pg.strategy == PlacementStrategy.STRICT_SPREAD:
+            if len(pg.bundles) > len(nodes):
+                return None
+            used: Set[NodeID] = set()
+            for i in order:
+                chosen = next((n.node_id for n in nodes
+                               if n.node_id not in used and fits(n.node_id, pg.bundles[i])),
+                              None)
+                if chosen is None:
+                    return None
+                used.add(chosen)
+                take(chosen, pg.bundles[i])
+                placement[i] = chosen
+            return placement
+        # PACK / SPREAD: best effort
+        prefer_spread = pg.strategy == PlacementStrategy.SPREAD
+        last: Optional[NodeID] = None
+        for i in order:
+            cands = [n.node_id for n in nodes if fits(n.node_id, pg.bundles[i])]
+            if not cands:
+                return None
+            if prefer_spread:
+                fresh = [c for c in cands if c != last]
+                chosen = (fresh or cands)[0]
+            else:
+                chosen = cands[0]
+            take(chosen, pg.bundles[i])
+            placement[i] = chosen
+            last = chosen
+        return placement
+
+    def handle_remove_placement_group(self, conn: Connection, data: Dict[str, Any]):
+        self._remove_placement_group(data["pg_id"])
+        return {}
+
+    def _remove_placement_group(self, pg_id: PlacementGroupID):
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state == "REMOVED":
+                return
+            pg.state = "REMOVED"
+            locations = dict(pg.bundle_locations)
+        for bundle_index, node_id in locations.items():
+            try:
+                self._raylet(node_id).call(
+                    "return_bundle", {"pg_id": pg_id, "bundle_index": bundle_index},
+                    timeout=15)
+            except Exception:
+                pass
+        self.pubsub.publish(CH_PG, pg_id.binary(), {"state": "REMOVED"})
+
+    def handle_get_placement_group(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            pg = self.placement_groups.get(data["pg_id"])
+            if pg is None:
+                return {"known": False}
+            return {"known": True, "state": pg.state,
+                    "bundle_locations": {i: n for i, n in pg.bundle_locations.items()},
+                    "bundles": pg.bundles, "strategy": pg.strategy.value,
+                    "name": pg.name}
+
+    # --------------------------------------------------------- task events
+
+    def handle_add_task_events(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            self.task_events.extend(data["events"])
+        return {}
+
+    def handle_get_task_events(self, conn: Connection, data: Dict[str, Any]):
+        limit = (data or {}).get("limit", 10000)
+        with self._lock:
+            events = list(self.task_events)[-limit:]
+        return {"events": events}
+
+    # --------------------------------------------------------------- misc
+
+    def handle_cluster_resources(self, conn: Connection, data=None):
+        totals: Dict[str, float] = defaultdict(float)
+        avail: Dict[str, float] = defaultdict(float)
+        with self._lock:
+            for n in self.nodes.values():
+                if n.state != "ALIVE":
+                    continue
+                for r, v in n.resources_total.items():
+                    totals[r] += v
+                for r, v in n.resources_available.items():
+                    avail[r] += v
+        return {"total": dict(totals), "available": dict(avail)}
+
+    def handle_ping(self, conn: Connection, data=None):
+        return {"ok": True, "time": time.time()}
+
+
+def main():  # standalone GCS for multi-host deployments
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=6379)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    gcs = GcsServer(host=args.host, port=args.port)
+    gcs.start()
+    logger.info("GCS listening on %s", gcs.address)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gcs.stop()
+
+
+if __name__ == "__main__":
+    main()

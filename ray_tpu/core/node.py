@@ -1,0 +1,98 @@
+"""Node bring-up: session directory, embedded GCS (head), raylet.
+
+Equivalent of `python/ray/_private/node.py` (`Node.start_ray_processes`) —
+but the GCS and raylet run as threads of the head process instead of separate
+native processes (workers are real subprocesses). `cluster_utils.Cluster`
+adds more raylets (in-process or subprocess) for multi-node simulation.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from ray_tpu.core.common import CPU, TPU
+from ray_tpu.core.gcs import GcsServer
+from ray_tpu.core.raylet import Raylet
+
+
+def default_session_dir() -> str:
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    path = os.path.join(base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    return path
+
+
+def detect_tpu_chips() -> int:
+    """Best-effort local TPU chip count without importing jax."""
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env:
+        return int(env)
+    try:
+        import glob
+
+        accels = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+        if accels:
+            return len(accels)
+    except Exception:
+        pass
+    return 0
+
+
+class Node:
+    def __init__(
+        self,
+        head: bool = True,
+        gcs_address: Optional[str] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: int = 0,
+        session_dir: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.head = head
+        self.session_dir = session_dir or default_session_dir()
+        self.gcs: Optional[GcsServer] = None
+        if head:
+            self.gcs = GcsServer()
+            self.gcs.start()
+            self.gcs_address = self.gcs.address
+        else:
+            assert gcs_address, "non-head node requires gcs_address"
+            self.gcs_address = gcs_address
+        total: Dict[str, float] = {}
+        total[CPU] = float(num_cpus) if num_cpus is not None else float(os.cpu_count() or 1)
+        tpus = float(num_tpus) if num_tpus is not None else float(detect_tpu_chips())
+        if tpus:
+            total[TPU] = tpus
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        total[f"node:{'127.0.0.1'}"] = 1.0
+        self.raylet = Raylet(
+            gcs_address=self.gcs_address,
+            resources=total,
+            session_dir=self.session_dir,
+            is_head=head,
+            labels=labels,
+            object_store_memory=object_store_memory,
+        )
+        self.raylet.start()
+
+    @property
+    def raylet_address(self) -> str:
+        return self.raylet.server.address
+
+    @property
+    def session_suffix(self) -> str:
+        return self.raylet.session_suffix
+
+    @property
+    def node_id(self):
+        return self.raylet.node_id
+
+    def shutdown(self):
+        self.raylet.stop()
+        if self.gcs is not None:
+            self.gcs.stop()

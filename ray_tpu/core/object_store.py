@@ -1,0 +1,343 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+The reference runs a plasma store inside each raylet: an mmap'd shared-memory
+arena with create/seal/get, LRU eviction, and disk spilling
+(`src/ray/object_manager/plasma/*`, `store_runner.h:56`,
+`object_lifecycle_manager.h`, `eviction_policy.h`). Here each sealed object
+lives in its own POSIX shm segment (`/dev/shm`), named by object id, giving
+zero-copy cross-process reads via pickle-5 out-of-band buffers. Small objects
+bypass shm and travel inline through the control plane (the reference's
+in-process memory store, `store_provider/memory_store/memory_store.h:43`).
+
+TPU note: device arrays never transit this store — only host-RAM data
+(batches, checkpont metadata, numpy). jax.Array values are pulled to host
+before put; `get` returns numpy views that jax can device_put cheaply.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import RaySystemError
+
+
+# The store owns segment lifetimes (delete() unlinks; shutdown sweeps).
+# Python's resource_tracker assumes one register/unregister pair per name per
+# process; our create/attach/adopt patterns break that (its cache is a set),
+# producing daemon-side KeyErrors. Exclude rtpu segments from tracking.
+_orig_register = resource_tracker.register
+_orig_unregister = resource_tracker.unregister
+
+
+def _filtered_register(name, rtype):
+    if rtype == "shared_memory" and "rtpu_" in name:
+        return
+    _orig_register(name, rtype)
+
+
+def _filtered_unregister(name, rtype):
+    if rtype == "shared_memory" and "rtpu_" in name:
+        return
+    _orig_unregister(name, rtype)
+
+
+resource_tracker.register = _filtered_register
+resource_tracker.unregister = _filtered_unregister
+
+
+class _AttachedSharedMemory(shared_memory.SharedMemory):
+    """Reader-side attachment whose close() tolerates live zero-copy views.
+
+    Values deserialized zero-copy (numpy arrays aliasing shm pages) may
+    outlive the client; closing the mmap then raises BufferError. Readers may
+    safely leave the mapping open — the kernel reclaims it at process exit.
+    """
+
+    def close(self):
+        try:
+            super().close()
+        except BufferError:
+            pass
+
+
+def _untrack(shm: shared_memory.SharedMemory):
+    """Detach this handle from the resource tracker: the creating store owns
+    the segment's lifetime; attaching processes must not unlink it at exit."""
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+def _segment_name(session_suffix: str, object_id: ObjectID) -> str:
+    # Full 28-byte id in the name keeps segments collision-free per session.
+    return f"rtpu_{session_suffix}_{object_id.hex()}"
+
+
+@dataclass
+class _LocalObject:
+    object_id: ObjectID
+    size: int
+    sealed: bool = False
+    shm: Optional[shared_memory.SharedMemory] = None
+    spilled_path: Optional[str] = None
+    pin_count: int = 0
+    last_access: float = field(default_factory=time.monotonic)
+
+
+class ObjectStoreFullError(RaySystemError):
+    pass
+
+
+class SharedMemoryStore:
+    """Create/seal/get over per-object shm segments with LRU spill-to-disk.
+
+    One instance runs inside each raylet process; clients (workers/driver on
+    the same node) use `ObjectStoreClient` which attaches segments by name —
+    reads never involve the raylet once the location is known.
+    """
+
+    def __init__(self, session_suffix: str, capacity_bytes: int = 0, spill_dir: str | None = None):
+        self._session = session_suffix
+        if capacity_bytes <= 0:
+            capacity_bytes = GLOBAL_CONFIG.object_store_memory_bytes
+        if capacity_bytes <= 0:
+            try:
+                import psutil
+
+                capacity_bytes = int(psutil.virtual_memory().total * 0.3)
+            except Exception:
+                capacity_bytes = 2 << 30
+        self.capacity = capacity_bytes
+        self._spill_dir = spill_dir or GLOBAL_CONFIG.object_spill_dir or "/tmp/ray_tpu_spill"
+        self._lock = threading.RLock()
+        self._objects: "OrderedDict[ObjectID, _LocalObject]" = OrderedDict()
+        self._used = 0
+
+    # -- creation ------------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        with self._lock:
+            if object_id in self._objects:
+                raise RaySystemError(f"Object {object_id} already exists in store")
+            self._ensure_capacity(size)
+            try:
+                shm = shared_memory.SharedMemory(
+                    name=_segment_name(self._session, object_id), create=True, size=max(size, 1)
+                )
+            except FileExistsError:
+                raise RaySystemError(f"shm segment for {object_id} already exists")
+            entry = _LocalObject(object_id, size, sealed=False, shm=shm)
+            self._objects[object_id] = entry
+            self._used += size
+            return shm.buf[:size]
+
+    def adopt(self, object_id: ObjectID, size: int):
+        """Track a segment created and sealed by another local process
+        (driver/worker `put`): attach it and account for its memory."""
+        with self._lock:
+            if object_id in self._objects:
+                return
+            # Attach registers with the resource tracker (3.12 behavior); the
+            # eventual unlink() in delete() unregisters — keep them balanced.
+            shm = shared_memory.SharedMemory(name=_segment_name(self._session, object_id))
+            self._ensure_capacity(size)
+            self._objects[object_id] = _LocalObject(object_id, size, sealed=True, shm=shm)
+            self._used += size
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._objects.get(object_id)
+            if entry is None:
+                raise RaySystemError(f"seal of unknown object {object_id}")
+            entry.sealed = True
+
+    def put_serialized(self, object_id: ObjectID, parts: List[memoryview | bytes]) -> int:
+        total = serialization.serialized_size(parts)
+        buf = self.create(object_id, total)
+        pos = 0
+        for p in parts:
+            n = p.nbytes if isinstance(p, memoryview) else len(p)
+            buf[pos : pos + n] = p
+            pos += n
+        self.seal(object_id)
+        return total
+
+    def put_value(self, object_id: ObjectID, value: Any) -> int:
+        return self.put_serialized(object_id, serialization.serialize(value))
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        with self._lock:
+            entry = self._objects.get(object_id)
+            if entry is None or not entry.sealed:
+                return None
+            entry.last_access = time.monotonic()
+            self._objects.move_to_end(object_id)
+            if entry.shm is not None:
+                return entry.shm.buf[: entry.size]
+            if entry.spilled_path is not None:
+                return self._restore(entry)
+            return None
+
+    def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        buf = self.get_buffer(object_id)
+        return bytes(buf) if buf is not None else None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._objects.get(object_id)
+            return e is not None and e.sealed
+
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e:
+                e.pin_count += 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e and e.pin_count > 0:
+                e.pin_count -= 1
+
+    # -- deletion / eviction / spilling -------------------------------------
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._objects.pop(object_id, None)
+            if entry is None:
+                return
+            self._used -= entry.size
+            if entry.shm is not None:
+                try:
+                    entry.shm.close()
+                    entry.shm.unlink()
+                except Exception:
+                    pass
+            if entry.spilled_path:
+                try:
+                    os.unlink(entry.spilled_path)
+                except OSError:
+                    pass
+
+    def _ensure_capacity(self, size: int):
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"Object of {size} bytes exceeds store capacity {self.capacity}"
+            )
+        # LRU spill of sealed, unpinned objects until the new object fits.
+        while self._used + size > self.capacity:
+            victim = None
+            for oid, e in self._objects.items():
+                if e.sealed and e.pin_count == 0 and e.shm is not None:
+                    victim = e
+                    break
+            if victim is None:
+                raise ObjectStoreFullError(
+                    f"Store full ({self._used}/{self.capacity} bytes) and no spillable objects"
+                )
+            self._spill(victim)
+
+    def _spill(self, entry: _LocalObject):
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, f"{self._session}_{entry.object_id.hex()}")
+        with open(path, "wb") as f:
+            f.write(entry.shm.buf[: entry.size])
+        entry.shm.close()
+        entry.shm.unlink()
+        entry.shm = None
+        entry.spilled_path = path
+        self._used -= entry.size
+
+    def _restore(self, entry: _LocalObject) -> memoryview:
+        self._ensure_capacity(entry.size)
+        shm = shared_memory.SharedMemory(
+            name=_segment_name(self._session, entry.object_id), create=True, size=max(entry.size, 1)
+        )
+        with open(entry.spilled_path, "rb") as f:
+            f.readinto(shm.buf[: entry.size])
+        os.unlink(entry.spilled_path)
+        entry.spilled_path = None
+        entry.shm = shm
+        self._used += entry.size
+        return shm.buf[: entry.size]
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "used_bytes": self._used,
+                "capacity_bytes": self.capacity,
+                "num_spilled": sum(1 for e in self._objects.values() if e.spilled_path),
+            }
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._objects):
+                self.delete(oid)
+
+
+class ObjectStoreClient:
+    """Same-node client: attach sealed segments by name, zero-copy deserialize.
+
+    Keeps attached segments open for the lifetime of any values deserialized
+    from them (numpy arrays may alias the shm pages).
+    """
+
+    def __init__(self, session_suffix: str):
+        self._session = session_suffix
+        self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def get_value(self, object_id: ObjectID, zero_copy: bool = True) -> Any:
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            raise KeyError(object_id)
+        return serialization.deserialize(buf, zero_copy=zero_copy)
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        with self._lock:
+            shm = self._attached.get(object_id)
+            if shm is None:
+                try:
+                    shm = _AttachedSharedMemory(
+                        name=_segment_name(self._session, object_id))
+                except FileNotFoundError:
+                    return None
+                _untrack(shm)
+                self._attached[object_id] = shm
+            return shm.buf
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self.get_buffer(object_id) is not None
+
+    def release(self, object_id: ObjectID):
+        with self._lock:
+            shm = self._attached.pop(object_id, None)
+            if shm is not None:
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+
+    def close(self):
+        with self._lock:
+            for shm in self._attached.values():
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+            self._attached.clear()

@@ -1,0 +1,996 @@
+"""Raylet: per-node manager — local scheduler, worker pool, object manager.
+
+Equivalent of the reference's raylet process (`src/ray/raylet/node_manager.h`):
+the worker lease/dispatch protocol (`HandleRequestWorkerLease`), two-level
+scheduling with spillback (`cluster_task_manager.h`, hybrid policy in
+`policy/hybrid_scheduling_policy.h`), the worker pool (`worker_pool.h:156`),
+dependency management (`dependency_manager.h`), placement-group bundle
+2PC resources (`placement_group_resource_manager.h`), and the node's
+shared-memory object store + node-to-node transfer (`object_manager.h`).
+
+Differences from the reference, deliberate for the TPU design:
+- Tasks are submitted to a raylet and dispatched to workers by the raylet
+  (one hop) instead of the lease-then-direct-push protocol; actor calls are
+  direct client->worker (matching the reference's direct actor transport).
+- TPU chips are node resources; a worker granted TPU resources gets
+  `TPU_VISIBLE_CHIPS`/`JAX_PLATFORMS` env so exactly one JAX process per
+  host owns the local chips (see SURVEY.md §7 "TPU process model").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import CPU, TPU, NodeInfo, TaskSpec
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.rpc import Connection, RpcClient, RpcServer
+from ray_tpu.exceptions import RaySystemError
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------- #
+# Resource accounting
+# --------------------------------------------------------------------------- #
+
+
+class ResourceManager:
+    """Local resource ledger (reference `local_resource_manager.h`), including
+    dynamically added placement-group bundle resources."""
+
+    def __init__(self, total: Dict[str, float]):
+        self._lock = threading.Lock()
+        self.total: Dict[str, float] = dict(total)
+        self.available: Dict[str, float] = dict(total)
+
+    def try_acquire(self, request: Dict[str, float]) -> bool:
+        with self._lock:
+            if all(self.available.get(r, 0.0) + 1e-9 >= amt for r, amt in request.items()):
+                for r, amt in request.items():
+                    self.available[r] = self.available.get(r, 0.0) - amt
+                return True
+            return False
+
+    def release(self, request: Dict[str, float]):
+        with self._lock:
+            for r, amt in request.items():
+                self.available[r] = self.available.get(r, 0.0) + amt
+
+    def feasible(self, request: Dict[str, float]) -> bool:
+        with self._lock:
+            return all(self.total.get(r, 0.0) >= amt for r, amt in request.items())
+
+    def add_resources(self, resources: Dict[str, float]):
+        with self._lock:
+            for r, amt in resources.items():
+                self.total[r] = self.total.get(r, 0.0) + amt
+                self.available[r] = self.available.get(r, 0.0) + amt
+
+    def remove_resources(self, resources: Dict[str, float]):
+        with self._lock:
+            for r, amt in resources.items():
+                self.total[r] = self.total.get(r, 0.0) - amt
+                self.available[r] = self.available.get(r, 0.0) - amt
+                if abs(self.total[r]) < 1e-9:
+                    self.total.pop(r, None)
+                    self.available.pop(r, None)
+
+    def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        with self._lock:
+            return dict(self.total), dict(self.available)
+
+
+# --------------------------------------------------------------------------- #
+# Worker pool
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    pid: int
+    conn: Optional[Connection] = None
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"          # starting | idle | busy | dead
+    current_task: Optional[TaskSpec] = None
+    is_actor: bool = False
+    actor_id: Optional[ActorID] = None
+    direct_address: Optional[str] = None
+    last_idle: float = field(default_factory=time.monotonic)
+    # env granted at spawn (e.g. TPU chip visibility)
+    granted_env: Dict[str, str] = field(default_factory=dict)
+
+
+class WorkerPool:
+    """Spawns and leases Python worker processes (reference `worker_pool.h`)."""
+
+    def __init__(self, raylet: "Raylet", max_workers: int = 64):
+        self._raylet = raylet
+        self._lock = threading.RLock()
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._starting = 0
+        self.max_workers = max_workers
+        # Crash-loop guard: consecutive startup deaths throttle respawns.
+        self.consecutive_startup_failures = 0
+        self.last_startup_failure = 0.0
+
+    def spawn_worker(self, env_extra: Optional[Dict[str, str]] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(GLOBAL_CONFIG.to_env())
+        env.update(env_extra or {})
+        # Workers must resolve ray_tpu (and the driver's modules) even when
+        # the driver got them via sys.path manipulation rather than an
+        # installed package: propagate package root + cwd on PYTHONPATH.
+        import ray_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
+        extra_paths = [pkg_root, os.getcwd()]
+        existing = env.get("PYTHONPATH", "")
+        parts = [p for p in extra_paths if p] + ([existing] if existing else [])
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_RAYLET_ADDRESS"] = self._raylet.server.address
+        env["RAY_TPU_GCS_ADDRESS"] = self._raylet.gcs_address
+        env["RAY_TPU_NODE_ID"] = self._raylet.node_id.hex()
+        env["RAY_TPU_SESSION"] = self._raylet.session_suffix
+        env["RAY_TPU_SESSION_DIR"] = self._raylet.session_dir
+        log_dir = os.path.join(self._raylet.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            cwd=os.getcwd(),
+        )
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        handle.granted_env = env_extra or {}
+        with self._lock:
+            self._workers[worker_id] = handle
+            self._starting += 1
+        return handle
+
+    def on_worker_registered(self, worker_id: WorkerID, conn: Connection,
+                             direct_address: str) -> Optional[WorkerHandle]:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None:
+                return None
+            handle.conn = conn
+            handle.direct_address = direct_address
+            if handle.state == "starting":
+                self._starting -= 1
+                handle.state = "idle"
+                handle.last_idle = time.monotonic()
+            self.consecutive_startup_failures = 0
+            return handle
+
+    def pop_idle(self) -> Optional[WorkerHandle]:
+        with self._lock:
+            for h in self._workers.values():
+                if h.state == "idle" and not h.is_actor:
+                    h.state = "busy"
+                    return h
+            return None
+
+    def push_idle(self, handle: WorkerHandle):
+        with self._lock:
+            if handle.state != "dead":
+                handle.state = "idle"
+                handle.current_task = None
+                handle.last_idle = time.monotonic()
+
+    def num_starting(self) -> int:
+        with self._lock:
+            return self._starting
+
+    def num_alive(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._workers.values() if h.state != "dead")
+
+    def mark_dead(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None or handle.state == "dead":
+                return None
+            if handle.state == "starting":
+                self._starting -= 1
+                self.consecutive_startup_failures += 1
+                self.last_startup_failure = time.monotonic()
+                if self.consecutive_startup_failures == 3:
+                    log_dir = os.path.join(self._raylet.session_dir, "logs")
+                    logger.error(
+                        "3 consecutive workers died during startup — check "
+                        "worker logs in %s. Respawns are throttled to one "
+                        "per 5s until a worker starts successfully.", log_dir)
+            handle.state = "dead"
+            return handle
+
+    def spawn_allowed(self) -> bool:
+        with self._lock:
+            if self.consecutive_startup_failures < 3:
+                return True
+            return time.monotonic() - self.last_startup_failure > 5.0
+
+    def by_conn(self, conn: Connection) -> Optional[WorkerHandle]:
+        wid = conn.meta.get("worker_id")
+        if wid is None:
+            return None
+        with self._lock:
+            return self._workers.get(wid)
+
+    def get(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def kill_all(self):
+        with self._lock:
+            handles = list(self._workers.values())
+        for h in handles:
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 3
+        for h in handles:
+            if h.proc is not None:
+                try:
+                    h.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except Exception:
+                    try:
+                        h.proc.kill()
+                    except Exception:
+                        pass
+
+
+# --------------------------------------------------------------------------- #
+# Queued task bookkeeping
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class QueuedTask:
+    spec: TaskSpec
+    submitter: Connection
+    deps_remaining: Set[ObjectID] = field(default_factory=set)
+    queued_at: float = field(default_factory=time.monotonic)
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        resources: Dict[str, float],
+        session_dir: str,
+        session_suffix: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: int = 0,
+    ):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.session_suffix = session_suffix or f"{os.getpid()}_{self.node_id.hex()[:8]}"
+        self.is_head = is_head
+        self.server = RpcServer(host=host, port=port, name="raylet")
+        self.server.register_instance(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.resources = ResourceManager(resources)
+        self.store = SharedMemoryStore(
+            self.session_suffix,
+            capacity_bytes=object_store_memory,
+            spill_dir=os.path.join(session_dir, "spill"),
+        )
+        cpus = int(resources.get(CPU, 1) or 1)
+        self.pool = WorkerPool(self, max_workers=max(4, cpus * 4))
+        self._spawn_parallelism = max(1, min(2, cpus // 2))
+        self.labels = labels or {}
+        self._lock = threading.RLock()
+        self._queue: deque[QueuedTask] = deque()
+        self._waiting_deps: Dict[ObjectID, List[QueuedTask]] = defaultdict(list)
+        self._task_submitters: Dict[bytes, Connection] = {}
+        self._running: Dict[bytes, Tuple[TaskSpec, WorkerHandle]] = {}
+        self._released_cpu: Dict[bytes, Dict[str, float]] = {}  # blocked-task releases
+        self._cluster_view: Dict[str, Any] = {}
+        self._spread_rr = 0
+        self._pending_actor_creates: Dict[ActorID, Dict[str, Any]] = {}
+        self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}  # (pgid, idx) -> record
+        self._pulls_inflight: Set[ObjectID] = set()
+        self._stopped = threading.Event()
+        self._dispatch_event = threading.Event()
+        # GCS client with pubsub push handling
+        self.gcs = RpcClient(gcs_address, name=f"raylet-{self.node_id.hex()[:8]}->gcs",
+                             push_handler=self._on_gcs_push)
+        self._peer_clients: Dict[str, RpcClient] = {}
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        self.server.start()
+        info = NodeInfo(
+            node_id=self.node_id,
+            address=self.server.address,
+            object_manager_address=self.server.address,
+            session_suffix=self.session_suffix,
+            hostname=os.uname().nodename,
+            ip=self.server.host,
+            resources_total=self.resources.total,
+            resources_available=dict(self.resources.total),
+            labels=self.labels,
+            is_head=self.is_head,
+        )
+        self.gcs.call("register_node", {"info": info})
+        self.gcs.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
+        self.gcs.call("subscribe", {"channel": "OBJECT", "key": b"*"})
+        for name, target in [
+            ("raylet-dispatch", self._dispatch_loop),
+            ("raylet-heartbeat", self._heartbeat_loop),
+            ("raylet-reaper", self._reaper_loop),
+        ]:
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stopped.set()
+        self._dispatch_event.set()
+        self.pool.kill_all()
+        self.server.stop()
+        self.gcs.close()
+        for c in self._peer_clients.values():
+            c.close()
+        self.store.shutdown()
+
+    def _heartbeat_loop(self):
+        period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
+        while not self._stopped.wait(period):
+            try:
+                total, avail = self.resources.snapshot()
+                self.gcs.call(
+                    "heartbeat",
+                    {"node_id": self.node_id, "resources_available": avail,
+                     "resources_total": total},
+                    timeout=5,
+                )
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                logger.warning("heartbeat to GCS failed", exc_info=True)
+
+    def _reaper_loop(self):
+        # Reap idle workers beyond the prestart target and poll dead processes.
+        while not self._stopped.wait(2.0):
+            with self.pool._lock:
+                handles = list(self.pool._workers.values())
+            for h in handles:
+                if h.proc is not None and h.proc.poll() is not None and h.state != "dead":
+                    self._on_worker_dead(h, f"process exited with code {h.proc.returncode}")
+
+    # ------------------------------------------------------- GCS push events
+
+    def _on_gcs_push(self, method: str, data: Any):
+        if method != "pubsub":
+            return
+        channel = data["channel"]
+        if channel == "RESOURCES":
+            self._cluster_view = data["message"]
+        elif channel == "OBJECT":
+            oid = ObjectID(data["key"])
+            with self._lock:
+                has_waiters = oid in self._waiting_deps or oid in self._pulls_inflight
+            if has_waiters:
+                entry = data["message"]
+                if entry.get("inline") is not None:
+                    self._on_object_local(oid)
+                elif entry.get("nodes"):
+                    self._start_pull(oid)
+
+    # --------------------------------------------------- submission path
+
+    def handle_submit_task(self, conn: Connection, data: Dict[str, Any]):
+        spec: TaskSpec = data["spec"]
+        grant_or_reject = data.get("grant_or_reject", False)
+        target = self._choose_node(spec)
+        if target is not None and target != self.node_id.hex() and not grant_or_reject:
+            addr = self._cluster_view.get(target, {}).get("address")
+            if addr:
+                return {"status": "spillback", "address": addr}
+        self._enqueue(spec, conn)
+        return {"status": "queued"}
+
+    def _choose_node(self, spec: TaskSpec) -> Optional[str]:
+        """Hybrid scheduling policy over the gossiped cluster view
+        (reference `policy/hybrid_scheduling_policy.h`): prefer local while
+        utilization is under threshold, else the best feasible node."""
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+            SpreadSchedulingStrategy,
+        )
+
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            return strategy.node_id
+        view = self._cluster_view
+        if not view:
+            return None  # no view yet: keep it local
+        req = spec.resources
+        my_hex = self.node_id.hex()
+
+        def available_now(entry):
+            return all(entry["available"].get(r, 0.0) + 1e-9 >= a for r, a in req.items())
+
+        def feasible(entry):
+            return all(entry["total"].get(r, 0.0) >= a for r, a in req.items())
+
+        feasible_nodes = [nid for nid, e in view.items() if e.get("alive") and feasible(e)]
+        if isinstance(strategy, SpreadSchedulingStrategy):
+            if not feasible_nodes:
+                return None
+            self._spread_rr += 1
+            ordered = sorted(feasible_nodes)
+            return ordered[self._spread_rr % len(ordered)]
+        local = view.get(my_hex)
+        if local is not None and feasible(local) and available_now(local):
+            return my_hex
+        ready = [nid for nid in feasible_nodes if available_now(view[nid])]
+        if ready:
+            # Prefer local even when queued work exists? No: pick the
+            # most-available ready node for work stealing across the cluster.
+            ready.sort(key=lambda nid: -sum(view[nid]["available"].values()))
+            return ready[0]
+        if local is not None and feasible(local):
+            return my_hex  # queue locally until resources free up
+        if feasible_nodes:
+            return feasible_nodes[0]
+        return my_hex if local is not None else None
+
+    def _enqueue(self, spec: TaskSpec, submitter: Connection):
+        qt = QueuedTask(spec=spec, submitter=submitter)
+        with self._lock:
+            self._task_submitters[spec.task_id.binary()] = submitter
+            for dep in spec.dependencies():
+                if not self._dep_available(dep):
+                    qt.deps_remaining.add(dep)
+                    self._waiting_deps[dep].append(qt)
+            self._queue.append(qt)
+        for dep in list(qt.deps_remaining):
+            self._start_pull(dep)
+        self._dispatch_event.set()
+
+    def _dep_available(self, oid: ObjectID) -> bool:
+        if self.store.contains(oid):
+            return True
+        try:
+            entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=5)
+        except Exception:
+            return False
+        return bool(entry.get("known") and entry.get("inline") is not None)
+
+    # ------------------------------------------------------- dispatch loop
+
+    def _dispatch_loop(self):
+        while not self._stopped.is_set():
+            self._dispatch_event.wait(timeout=0.2)
+            self._dispatch_event.clear()
+            try:
+                self._dispatch_once()
+            except Exception:
+                logger.exception("dispatch loop error")
+
+    def _dispatch_once(self):
+        progressed = True
+        while progressed and not self._stopped.is_set():
+            progressed = False
+            with self._lock:
+                ready_idx = None
+                for i, qt in enumerate(self._queue):
+                    if not qt.deps_remaining:
+                        ready_idx = i
+                        break
+                if ready_idx is None:
+                    return
+                qt = self._queue[ready_idx]
+                if not self.resources.try_acquire(qt.spec.resources):
+                    return  # FIFO head-of-line; resources busy
+                del self._queue[ready_idx]
+            worker = self.pool.pop_idle()
+            if worker is None:
+                # Throttle concurrent spawns: Python worker startup is CPU
+                # bound (~2s of imports); parallel cold starts convoy on small
+                # hosts. Pool size targets the node's CPU count (reference
+                # worker_pool.h:347 prestarts one worker per core).
+                if (self.pool.num_starting() < self._spawn_parallelism
+                        and self.pool.num_alive() < self.pool.max_workers
+                        and self.pool.spawn_allowed()):
+                    self.pool.spawn_worker(env_extra=self._env_for(qt.spec))
+                # keep resources held? No: release and retry when a worker registers.
+                self.resources.release(qt.spec.resources)
+                with self._lock:
+                    self._queue.appendleft(qt)
+                return
+            self._dispatch_to(worker, qt)
+            progressed = True
+
+    def _env_for(self, spec: TaskSpec) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        tpus = spec.resources.get(TPU, 0)
+        if tpus:
+            env["RAY_TPU_GRANTED_TPU"] = str(tpus)
+        return env
+
+    def _dispatch_to(self, worker: WorkerHandle, qt: QueuedTask):
+        spec = qt.spec
+        worker.current_task = spec
+        with self._lock:
+            self._running[spec.task_id.binary()] = (spec, worker)
+        try:
+            worker.conn.push("execute_task", {"spec": spec})
+        except Exception:
+            self._on_worker_dead(worker, "push failed")
+
+    # --------------------------------------------- worker-facing handlers
+
+    def handle_register_worker(self, conn: Connection, data: Dict[str, Any]):
+        worker_id: WorkerID = data["worker_id"]
+        conn.meta["worker_id"] = worker_id
+        handle = self.pool.on_worker_registered(worker_id, conn, data.get("direct_address"))
+        if handle is None:
+            # Worker not spawned by us (e.g. driver-embedded runtime): ignore.
+            return {"ok": False}
+        self._dispatch_event.set()
+        return {"ok": True, "node_id": self.node_id, "session_suffix": self.session_suffix}
+
+    def handle_task_done(self, conn: Connection, data: Dict[str, Any]):
+        """Worker finished a task: register results, notify submitter, recycle."""
+        task_id_b: bytes = data["task_id"].binary()
+        results: List[Dict[str, Any]] = data.get("results", [])
+        error_blob: Optional[bytes] = data.get("error")
+        with self._lock:
+            entry = self._running.pop(task_id_b, None)
+            submitter = self._task_submitters.pop(task_id_b, None)
+            released = self._released_cpu.pop(task_id_b, None)
+        if entry is None:
+            return {}
+        spec, worker = entry
+        # Resource release (handle partial release from blocked state)
+        res = dict(spec.resources)
+        if released:
+            for r, amt in released.items():
+                res[r] = res.get(r, 0) - amt
+        self.resources.release({r: a for r, a in res.items() if a > 0})
+        self._register_results(spec, results)
+        if submitter is not None and submitter.alive:
+            try:
+                submitter.push("task_result",
+                               {"task_id": spec.task_id, "results": results,
+                                "error": error_blob})
+            except Exception:
+                pass
+        if spec.actor_creation:
+            # Dedicated actor worker: stays busy serving direct calls.
+            pending = self._pending_actor_creates.pop(spec.actor_id, None)
+            if pending is not None:
+                pending["result"] = {"error": error_blob, "worker": worker}
+                pending["event"].set()
+        else:
+            self.pool.push_idle(worker)
+        self._dispatch_event.set()
+        return {}
+
+    def _register_results(self, spec: TaskSpec, results: List[Dict[str, Any]]):
+        for r in results:
+            oid: ObjectID = r["object_id"]
+            if r["kind"] == "inline":
+                try:
+                    self.gcs.call("object_location_add",
+                                  {"object_id": oid, "inline": r["data"],
+                                   "size": len(r["data"]),
+                                   "owner": spec.owner_address}, timeout=10)
+                except Exception:
+                    logger.warning("failed to register inline object %s", oid)
+            else:  # sealed into the node store by the worker
+                try:
+                    self.store.adopt(oid, r["size"])
+                except Exception:
+                    logger.warning("failed to adopt %s", oid, exc_info=True)
+                try:
+                    self.gcs.call("object_location_add",
+                                  {"object_id": oid, "node_id": self.node_id,
+                                   "size": r["size"], "owner": spec.owner_address},
+                                  timeout=10)
+                except Exception:
+                    pass
+                self._on_object_local(oid)
+
+    def handle_object_sealed(self, conn: Connection, data: Dict[str, Any]):
+        """A local process (driver/worker put) sealed a segment directly."""
+        oid: ObjectID = data["object_id"]
+        self.store.adopt(oid, data["size"])
+        self.gcs.call("object_location_add",
+                      {"object_id": oid, "node_id": self.node_id, "size": data["size"],
+                       "owner": data.get("owner")}, timeout=10)
+        self._on_object_local(oid)
+        return {}
+
+    def handle_worker_blocked(self, conn: Connection, data: Dict[str, Any]):
+        """Worker blocked in get(): release its CPU so others can run
+        (reference: raylet marks the lease as blocked and can start more)."""
+        handle = self.pool.by_conn(conn)
+        if handle is None or handle.current_task is None:
+            return {}
+        spec = handle.current_task
+        cpus = spec.resources.get(CPU, 0)
+        if cpus:
+            with self._lock:
+                self._released_cpu[spec.task_id.binary()] = {CPU: cpus}
+            self.resources.release({CPU: cpus})
+            self._dispatch_event.set()
+        return {}
+
+    def handle_worker_unblocked(self, conn: Connection, data: Dict[str, Any]):
+        handle = self.pool.by_conn(conn)
+        if handle is None or handle.current_task is None:
+            return {}
+        spec = handle.current_task
+        with self._lock:
+            released = self._released_cpu.pop(spec.task_id.binary(), None)
+        if released:
+            # Oversubscribe rather than deadlock: force re-acquire.
+            with self.resources._lock:
+                for r, amt in released.items():
+                    self.resources.available[r] = self.resources.available.get(r, 0) - amt
+        return {}
+
+    def _on_worker_dead(self, handle: WorkerHandle, reason: str):
+        handle = self.pool.mark_dead(handle.worker_id)
+        if handle is None:
+            return
+        logger.warning("worker %s (pid %s) died: %s", handle.worker_id.hex()[:12],
+                       handle.pid, reason)
+        spec = handle.current_task
+        if spec is not None:
+            task_id_b = spec.task_id.binary()
+            with self._lock:
+                self._running.pop(task_id_b, None)
+                submitter = self._task_submitters.pop(task_id_b, None)
+                released = self._released_cpu.pop(task_id_b, None)
+            res = dict(spec.resources)
+            if released:  # worker was blocked in get(): CPU already released
+                for r, amt in released.items():
+                    res[r] = res.get(r, 0) - amt
+            self.resources.release({r: a for r, a in res.items() if a > 0})
+            if handle.is_actor or spec.actor_creation:
+                pass  # reported below via actor_died
+            elif submitter is not None and submitter.alive:
+                from ray_tpu.exceptions import WorkerCrashedError
+
+                err = serialization.serialize_exception(
+                    WorkerCrashedError(f"Worker died while running {spec.name}: {reason}"),
+                    spec.name)
+                try:
+                    submitter.push("task_result",
+                                   {"task_id": spec.task_id, "results": [],
+                                    "error": err, "crashed": True})
+                except Exception:
+                    pass
+        if handle.is_actor and handle.actor_id is not None:
+            if handle.actor_id in self._pending_actor_creates:
+                pending = self._pending_actor_creates.pop(handle.actor_id)
+                pending["result"] = {"error": serialization.serialize_exception(
+                    RaySystemError(f"actor worker died during creation: {reason}"))}
+                pending["event"].set()
+            try:
+                self.gcs.call("actor_died",
+                              {"actor_id": handle.actor_id, "reason": reason,
+                               "intended": False}, timeout=5)
+            except Exception:
+                pass
+            # actor resources released on death
+            if handle.current_task is None and handle.actor_id is not None:
+                pass
+        self._dispatch_event.set()
+
+    def _on_disconnect(self, conn: Connection):
+        handle = self.pool.by_conn(conn)
+        if handle is not None and handle.state != "dead":
+            self._on_worker_dead(handle, "connection lost")
+        # Submitter connections: drop pending notification targets.
+        with self._lock:
+            doomed = [t for t, c in self._task_submitters.items() if c is conn]
+            for t in doomed:
+                del self._task_submitters[t]
+
+    # ------------------------------------------------------ actor creation
+
+    def handle_create_actor(self, conn: Connection, data: Dict[str, Any]):
+        """GCS asks this node to host an actor (reference
+        `GcsActorScheduler::LeaseWorkerFromNode`)."""
+        spec: TaskSpec = data["spec"]
+        if not self.resources.try_acquire(spec.resources):
+            return {"status": "retry"}
+        env = self._env_for(spec)
+        worker = None
+        if not env:
+            # Reuse an idle pooled worker as the actor host (reference
+            # worker_pool.h lease matching) — saves a cold start.
+            worker = self.pool.pop_idle()
+        if worker is None:
+            worker = self.pool.spawn_worker(env_extra=env)
+        worker.is_actor = True
+        worker.actor_id = spec.actor_id
+        pending = {"event": threading.Event(), "result": None}
+        self._pending_actor_creates[spec.actor_id] = pending
+        # Wait for registration, then dispatch the creation task.
+        deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0
+        while worker.conn is None and time.monotonic() < deadline:
+            if worker.proc.poll() is not None:
+                self.resources.release(spec.resources)
+                self._pending_actor_creates.pop(spec.actor_id, None)
+                return {"status": "error",
+                        "error": f"actor worker exited at startup "
+                                 f"(code {worker.proc.returncode})"}
+            time.sleep(0.01)
+        if worker.conn is None:
+            self.resources.release(spec.resources)
+            self._pending_actor_creates.pop(spec.actor_id, None)
+            return {"status": "error", "error": "actor worker failed to register"}
+        worker.state = "busy"
+        qt = QueuedTask(spec=spec, submitter=conn)
+        with self._lock:
+            self._task_submitters[spec.task_id.binary()] = conn
+        self._dispatch_to(worker, qt)
+        if not pending["event"].wait(GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0):
+            # Hung __init__: kill the worker; _on_worker_dead releases the
+            # resources and cleans up the pending record.
+            self._pending_actor_creates.pop(spec.actor_id, None)
+            if worker.proc is not None and worker.proc.poll() is None:
+                try:
+                    worker.proc.terminate()
+                except Exception:
+                    pass
+            return {"status": "error", "error": "actor creation timed out"}
+        result = pending["result"]
+        if result.get("error") is not None:
+            # Creation-task resources were already released by task_done (or
+            # by _on_worker_dead if the worker died) — don't double-release.
+            return {"status": "error", "error": "actor __init__ raised",
+                    "error_blob": result["error"]}
+        worker.current_task = None  # stays busy (dedicated), serving direct calls
+        return {"status": "ok", "worker_id": worker.worker_id,
+                "direct_address": worker.direct_address}
+
+    def handle_kill_worker(self, conn: Connection, data: Dict[str, Any]):
+        handle = self.pool.get(data["worker_id"])
+        if handle is None:
+            return {}
+        if data.get("suppress_report", True):
+            # GCS marks the actor dead itself (kill with no_restart); a
+            # restartable kill must still report actor_died so the GCS
+            # drives the RESTARTING transition.
+            handle.is_actor = False
+        self.pool.mark_dead(handle.worker_id)
+        if handle.proc is not None and handle.proc.poll() is None:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+        elif handle.proc is None and handle.conn is not None:
+            handle.conn.close()
+        if handle.is_actor and handle.actor_id is not None:
+            try:
+                self.gcs.call("actor_died",
+                              {"actor_id": handle.actor_id,
+                               "reason": data.get("reason", "killed"),
+                               "intended": False}, timeout=5)
+            except Exception:
+                pass
+        return {}
+
+    # ------------------------------------------------------ object transfer
+
+    def _start_pull(self, oid: ObjectID):
+        with self._lock:
+            if oid in self._pulls_inflight or self.store.contains(oid):
+                return
+            self._pulls_inflight.add(oid)
+        threading.Thread(target=self._pull_worker, args=(oid,), daemon=True).start()
+
+    def _pull_worker(self, oid: ObjectID):
+        try:
+            entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=10)
+            if not entry.get("known"):
+                with self._lock:
+                    self._pulls_inflight.discard(oid)
+                return  # OBJECT pubsub push will re-trigger when it appears
+            if entry.get("inline") is not None:
+                with self._lock:
+                    self._pulls_inflight.discard(oid)
+                self._on_object_local(oid)
+                return
+            my_hex = self.node_id.hex()
+            for node_id in entry.get("nodes", []):
+                if node_id.hex() == my_hex:
+                    with self._lock:
+                        self._pulls_inflight.discard(oid)
+                    self._on_object_local(oid)
+                    return
+                addr = self._cluster_view.get(node_id.hex(), {}).get("address")
+                if addr is None:
+                    try:
+                        addr = next(n["RayletAddress"] for n in self.gcs.call("get_nodes")
+                                    if n["NodeID"] == node_id.hex() and n["Alive"])
+                    except StopIteration:
+                        continue
+                try:
+                    peer = self._peer(addr)
+                    resp = peer.call("pull_object", {"object_id": oid},
+                                     timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
+                    if resp.get("data") is not None:
+                        if not self.store.contains(oid):
+                            buf = self.store.create(oid, len(resp["data"]))
+                            buf[:] = resp["data"]
+                            self.store.seal(oid)
+                        self.gcs.call("object_location_add",
+                                      {"object_id": oid, "node_id": self.node_id,
+                                       "size": len(resp["data"])}, timeout=10)
+                        with self._lock:
+                            self._pulls_inflight.discard(oid)
+                        self._on_object_local(oid)
+                        return
+                except Exception:
+                    logger.warning("pull of %s from %s failed", oid, addr, exc_info=True)
+            with self._lock:
+                self._pulls_inflight.discard(oid)
+        except Exception:
+            with self._lock:
+                self._pulls_inflight.discard(oid)
+            logger.exception("pull worker failed for %s", oid)
+
+    def _peer(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._peer_clients.get(address)
+            if client is None or client.is_closed:
+                client = RpcClient(address, name=f"raylet-peer")
+                self._peer_clients[address] = client
+            return client
+
+    def handle_pull_object(self, conn: Connection, data: Dict[str, Any]):
+        oid: ObjectID = data["object_id"]
+        raw = self.store.get_bytes(oid)
+        return {"data": raw}
+
+    def handle_get_or_pull(self, conn: Connection, data: Dict[str, Any]):
+        """Local client wants this object available in the node store."""
+        oid: ObjectID = data["object_id"]
+        timeout = data.get("timeout", 60.0)
+        # get_buffer (not contains) so spilled objects are restored to shm
+        # before we tell the client to attach the segment.
+        if self.store.get_buffer(oid) is not None:
+            return {"status": "local"}
+        entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=10)
+        if entry.get("known") and entry.get("inline") is not None:
+            return {"status": "inline", "data": entry["inline"]}
+        self._start_pull(oid)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.store.get_buffer(oid) is not None:
+                return {"status": "local"}
+            entry = None
+            time.sleep(0.005)
+            with self._lock:
+                inflight = oid in self._pulls_inflight
+            if not inflight and not self.store.contains(oid):
+                # Check for inline that appeared meanwhile, else retry pull.
+                e = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=10)
+                if e.get("known") and e.get("inline") is not None:
+                    return {"status": "inline", "data": e["inline"]}
+                self._start_pull(oid)
+                time.sleep(0.05)
+        return {"status": "timeout"}
+
+    def _on_object_local(self, oid: ObjectID):
+        """Dependency became available locally (or inline): unblock tasks."""
+        with self._lock:
+            waiters = self._waiting_deps.pop(oid, [])
+            for qt in waiters:
+                qt.deps_remaining.discard(oid)
+        if waiters:
+            self._dispatch_event.set()
+
+    def handle_delete_objects(self, conn: Connection, data: Dict[str, Any]):
+        for oid in data["object_ids"]:
+            self.store.delete(oid)
+        return {}
+
+    def handle_contains_object(self, conn: Connection, data: Dict[str, Any]):
+        return {"contains": self.store.contains(data["object_id"])}
+
+    # ------------------------------------------------- placement group 2PC
+
+    def handle_prepare_bundle(self, conn: Connection, data: Dict[str, Any]):
+        pg = data["pg"]
+        idx: int = data["bundle_index"]
+        bundle: Dict[str, float] = pg.bundles[idx]
+        if not self.resources.try_acquire(bundle):
+            return {"ok": False}
+        with self._lock:
+            self._bundles[(pg.pg_id.binary(), idx)] = {
+                "pg": pg, "bundle": bundle, "state": "prepared"}
+        return {"ok": True}
+
+    def handle_commit_bundle(self, conn: Connection, data: Dict[str, Any]):
+        pg_id: PlacementGroupID = data["pg_id"]
+        idx: int = data["bundle_index"]
+        with self._lock:
+            rec = self._bundles.get((pg_id.binary(), idx))
+            if rec is None or rec["state"] != "prepared":
+                return {"ok": False}
+            rec["state"] = "committed"
+        pg = rec["pg"]
+        formatted: Dict[str, float] = {}
+        for base, amt in rec["bundle"].items():
+            formatted[pg.bundle_resource_name(base, idx)] = amt
+            wc = pg.wildcard_resource_name(base)
+            formatted[wc] = formatted.get(wc, 0) + amt
+        rec["formatted"] = formatted
+        self.resources.add_resources(formatted)
+        return {"ok": True}
+
+    def handle_cancel_bundle(self, conn: Connection, data: Dict[str, Any]):
+        pg_id: PlacementGroupID = data["pg_id"]
+        idx: int = data["bundle_index"]
+        with self._lock:
+            rec = self._bundles.pop((pg_id.binary(), idx), None)
+        if rec is not None and rec["state"] == "prepared":
+            self.resources.release(rec["bundle"])
+        return {}
+
+    def handle_return_bundle(self, conn: Connection, data: Dict[str, Any]):
+        pg_id: PlacementGroupID = data["pg_id"]
+        idx: int = data["bundle_index"]
+        with self._lock:
+            rec = self._bundles.pop((pg_id.binary(), idx), None)
+        if rec is None:
+            return {}
+        if rec["state"] == "committed":
+            self.resources.remove_resources(rec.get("formatted", {}))
+            self.resources.release(rec["bundle"])
+        elif rec["state"] == "prepared":
+            self.resources.release(rec["bundle"])
+        return {}
+
+    # --------------------------------------------------------------- debug
+
+    def handle_get_session_suffix(self, conn: Connection, data=None):
+        return {"session_suffix": self.session_suffix,
+                "session_dir": self.session_dir}
+
+    def handle_debug_state(self, conn: Connection, data=None):
+        total, avail = self.resources.snapshot()
+        with self._lock:
+            return {
+                "node_id": self.node_id.hex(),
+                "queued": len(self._queue),
+                "running": len(self._running),
+                "workers": self.pool.num_alive(),
+                "resources_total": total,
+                "resources_available": avail,
+                "store": self.store.stats(),
+            }

@@ -1,0 +1,324 @@
+"""Internal RPC: length-prefixed messages over TCP.
+
+Plays the role of the reference's gRPC layer (`src/ray/rpc/grpc_server.h:73`,
+`client_call.h:181`) for control-plane traffic between driver, GCS, raylets and
+workers. Wire format per message:
+
+    [4B LE length][msgpack envelope {i, k, m, e} ][payload bytes]
+
+where `k` is req|resp|push, `m` the method name, `e` an error string on failed
+responses. Payloads are cloudpickle for control messages; bulk object data is
+raw bytes. Servers are thread-per-connection (connection counts here are tens,
+not thousands); clients have a background reader so servers can push
+unsolicited messages (task dispatch, pubsub) down the same connection.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.exceptions import RaySystemError
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<I")
+
+
+class ConnectionLost(RaySystemError):
+    pass
+
+
+def _send_msg(sock: socket.socket, envelope: dict, payload: bytes, lock: threading.Lock):
+    env = msgpack.packb(envelope)
+    frame = _HDR.pack(len(env) + 4 + len(payload)) + _HDR.pack(len(env)) + env + payload
+    with lock:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionLost("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (total,) = _HDR.unpack(_recv_exact(sock, 4))
+    body = _recv_exact(sock, total)
+    (elen,) = _HDR.unpack(body[:4])
+    envelope = msgpack.unpackb(body[4 : 4 + elen])
+    return envelope, body[4 + elen :]
+
+
+class Connection:
+    """Server-side handle for one client connection; supports pushes."""
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.peer = peer
+        self.send_lock = threading.Lock()
+        self.meta: Dict[str, Any] = {}  # handlers stash identity here (node id, worker id)
+        self.alive = True
+
+    def push(self, method: str, data: Any):
+        payload = serialization.dumps(data)
+        try:
+            _send_msg(self.sock, {"i": 0, "k": "push", "m": method}, payload, self.send_lock)
+        except OSError as e:
+            self.alive = False
+            raise ConnectionLost(str(e))
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RpcServer:
+    """Thread-per-connection RPC server.
+
+    Handlers: fn(conn: Connection, data: Any) -> Any. Raising propagates the
+    error string to the caller, which re-raises RaySystemError.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "rpc"):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(512)
+        self.host, self.port = self._listener.getsockname()
+        self.address = f"{self.host}:{self.port}"
+        self._name = name
+        self._handlers: Dict[str, Callable[[Connection, Any], Any]] = {}
+        self._conns: Dict[int, Connection] = {}
+        self._conn_counter = itertools.count()
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self.on_disconnect: Optional[Callable[[Connection], None]] = None
+
+    def register(self, method: str, handler: Callable[[Connection, Any], Any]):
+        self._handlers[method] = handler
+
+    def register_instance(self, obj: Any, prefix: str = ""):
+        """Register all `handle_*` methods of obj as RPC methods."""
+        for attr in dir(obj):
+            if attr.startswith("handle_"):
+                self.register(prefix + attr[len("handle_") :], getattr(obj, attr))
+
+    def start(self):
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{self._name}-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(sock, f"{addr[0]}:{addr[1]}")
+            cid = next(self._conn_counter)
+            with self._lock:
+                self._conns[cid] = conn
+            t = threading.Thread(
+                target=self._serve_conn, args=(cid, conn), name=f"{self._name}-conn{cid}", daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, cid: int, conn: Connection):
+        close_reason = "server stopping"
+        try:
+            while not self._stopped.is_set():
+                envelope, payload = _recv_msg(conn.sock)
+                if envelope["k"] != "req":
+                    continue
+                method = envelope["m"]
+                handler = self._handlers.get(method)
+                resp_env = {"i": envelope["i"], "k": "resp", "m": method}
+                try:
+                    if handler is None:
+                        raise RaySystemError(f"{self._name}: no handler for '{method}'")
+                    data = serialization.loads(payload) if payload else None
+                    result = handler(conn, data)
+                    out = serialization.dumps(result)
+                except Exception as e:
+                    # Handler failures — including ConnectionLost from the
+                    # handler's own outbound RPCs — must not tear down THIS
+                    # connection; only IO errors on conn.sock do.
+                    logger.debug("%s handler %s failed: %s", self._name, method,
+                                 e, exc_info=True)
+                    resp_env["e"] = f"{type(e).__name__}: {e}"
+                    out = b""
+                _send_msg(conn.sock, resp_env, out, conn.send_lock)
+        except (ConnectionLost, OSError) as e:
+            close_reason = f"{type(e).__name__}: {e}"
+        finally:
+            if not self._stopped.is_set():
+                logger.info("%s: connection from %s closed (%s)", self._name,
+                            conn.peer, close_reason)
+            conn.alive = False
+            with self._lock:
+                self._conns.pop(cid, None)
+            if self.on_disconnect:
+                try:
+                    self.on_disconnect(conn)
+                except Exception:
+                    logger.exception("on_disconnect callback failed")
+            conn.close()
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+
+
+class RpcClient:
+    """Blocking RPC client with a background reader for responses + pushes."""
+
+    def __init__(
+        self,
+        address: str,
+        name: str = "client",
+        push_handler: Optional[Callable[[str, Any], None]] = None,
+        connect_timeout: Optional[float] = None,
+        on_close: Optional[Callable[[], None]] = None,
+    ):
+        self.on_close = on_close
+        host, port = address.rsplit(":", 1)
+        timeout = connect_timeout or GLOBAL_CONFIG.rpc_connect_timeout_s
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, int(port)), timeout=5)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"connect to {address} failed: {e}")
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self.address = address
+        self._name = name
+        self._send_lock = threading.Lock()
+        self._msg_counter = itertools.count(1)
+        self._pending: Dict[int, dict] = {}
+        self._pending_lock = threading.Lock()
+        self._push_handler = push_handler
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, name=f"{name}-reader", daemon=True)
+        self._reader.start()
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed.is_set()
+
+    def _read_loop(self):
+        reason = "reader exited"
+        try:
+            while not self._closed.is_set():
+                envelope, payload = _recv_msg(self._sock)
+                kind = envelope["k"]
+                if kind == "resp":
+                    with self._pending_lock:
+                        slot = self._pending.pop(envelope["i"], None)
+                    if slot is not None:
+                        slot["env"] = envelope
+                        slot["payload"] = payload
+                        slot["event"].set()
+                elif kind == "push":
+                    if self._push_handler is not None:
+                        try:
+                            data = serialization.loads(payload) if payload else None
+                            self._push_handler(envelope["m"], data)
+                        except Exception:
+                            logger.exception("%s push handler failed", self._name)
+        except (ConnectionLost, OSError) as e:
+            reason = f"{type(e).__name__}: {e}"
+        finally:
+            if not self._closed.is_set():
+                logger.info("%s: connection to %s closed (%s)", self._name,
+                            self.address, reason)
+            self._closed.set()
+            with self._pending_lock:
+                for slot in self._pending.values():
+                    slot["env"] = {"e": "connection lost"}
+                    slot["payload"] = b""
+                    slot["event"].set()
+                self._pending.clear()
+            if self.on_close is not None:
+                try:
+                    self.on_close()
+                except Exception:
+                    logger.exception("%s on_close callback failed", self._name)
+
+    def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._closed.is_set():
+            raise ConnectionLost(f"{self._name}: connection to {self.address} is closed")
+        msg_id = next(self._msg_counter)
+        slot = {"event": threading.Event()}
+        with self._pending_lock:
+            self._pending[msg_id] = slot
+        payload = serialization.dumps(data)
+        try:
+            _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method}, payload, self._send_lock)
+        except OSError as e:
+            self._closed.set()
+            raise ConnectionLost(str(e))
+        if not slot["event"].wait(timeout or GLOBAL_CONFIG.rpc_call_timeout_s):
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError(f"{self._name}: RPC '{method}' to {self.address} timed out")
+        env = slot["env"]
+        if env.get("e"):
+            raise RaySystemError(f"RPC '{method}' failed remotely: {env['e']}")
+        return serialization.loads(slot["payload"]) if slot["payload"] else None
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,653 @@
+"""CoreRuntime: the embedded runtime of every driver and worker process.
+
+Equivalent of the reference's CoreWorker (`src/ray/core_worker/core_worker.h:284`):
+task submission with spillback retry (`direct_task_transport.h`), object
+put/get against the node store + inline fast path, `wait`, actor handle
+management and the direct actor transport with per-caller ordering
+(`direct_actor_task_submitter.h`), task retries, and owner-side object
+lifetime (frees propagate to the directory on ref drop).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import TaskSpec
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import ObjectStoreClient, _segment_name
+from ray_tpu.core.rpc import ConnectionLost, RpcClient
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RaySystemError,
+    RayTaskError,
+    TaskCancelledError,
+)
+
+logger = logging.getLogger(__name__)
+
+_PENDING = object()
+
+
+class _TaskRecord:
+    __slots__ = ("event", "results", "error", "crashed", "spec", "attempts")
+
+    def __init__(self, spec: Optional[TaskSpec] = None):
+        self.event = threading.Event()
+        self.results: Optional[List[Dict[str, Any]]] = None
+        self.error: Optional[bytes] = None
+        self.crashed = False
+        self.spec = spec
+        self.attempts = 0
+
+
+class ReconnectingClient:
+    """RPC client that re-dials on connection loss (one retry per call).
+
+    The GCS link must survive transient drops — the reference's GCS fault
+    tolerance lets raylets and workers reconnect after a GCS restart
+    (`gcs_failover_worker_reconnect_timeout`); this is the client half.
+    """
+
+    def __init__(self, address: str, name: str, push_handler=None,
+                 resubscribe=None):
+        self.address = address
+        self._name = name
+        self._push_handler = push_handler
+        self._resubscribe = resubscribe
+        self._lock = threading.Lock()
+        self._client = RpcClient(address, name=name, push_handler=push_handler)
+
+    @property
+    def is_closed(self) -> bool:
+        return self._client.is_closed
+
+    def _reconnect(self) -> RpcClient:
+        with self._lock:
+            if self._client.is_closed:
+                self._client = RpcClient(self.address, name=self._name,
+                                         push_handler=self._push_handler)
+                if self._resubscribe is not None:
+                    try:
+                        self._resubscribe(self._client)
+                    except Exception:
+                        logger.warning("%s: resubscribe failed", self._name)
+            return self._client
+
+    def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
+        try:
+            return self._client.call(method, data, timeout=timeout)
+        except ConnectionLost:
+            client = self._reconnect()
+            return client.call(method, data, timeout=timeout)
+
+    def close(self):
+        self._client.close()
+
+
+class ActorClient:
+    """Direct connection to an actor's worker (per caller, ordered)."""
+
+    def __init__(self, runtime: "CoreRuntime", actor_id: ActorID, address: str):
+        self.actor_id = actor_id
+        self.address = address
+        self.seq = 0
+        self.client = RpcClient(
+            address, name=f"actor-{actor_id.hex()[:8]}",
+            push_handler=runtime._on_raylet_push,
+            on_close=lambda: runtime._on_actor_conn_lost(actor_id))
+
+
+class CoreRuntime:
+    def __init__(
+        self,
+        gcs_address: str,
+        raylet_address: str,
+        session_suffix: str,
+        node_id: Optional[NodeID] = None,
+        job_id: Optional[JobID] = None,
+        worker_id: Optional[WorkerID] = None,
+        is_driver: bool = True,
+        namespace: str = "default",
+    ):
+        self.is_driver = is_driver
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.namespace = namespace
+        self.node_id = node_id
+        self.gcs = ReconnectingClient(gcs_address, name="runtime->gcs",
+                                      push_handler=self._on_gcs_push,
+                                      resubscribe=self._resubscribe_gcs)
+        self.raylet = RpcClient(raylet_address, name="runtime->raylet",
+                                push_handler=self._on_raylet_push)
+        self.store = ObjectStoreClient(session_suffix)
+        self.session_suffix = session_suffix
+        if job_id is None:
+            resp = self.gcs.call("register_job",
+                                 {"pid": os.getpid(), "namespace": namespace,
+                                  "entrypoint": " ".join(os.sys.argv)})
+            job_id = resp["job_id"]
+        self.job_id = job_id
+        # The "driver task" context: puts and submissions hang off this id.
+        self.current_task_id = TaskID.for_task(job_id)
+        self._put_counter = 0
+        self._lock = threading.RLock()
+        self._tasks: Dict[bytes, _TaskRecord] = {}          # task_id -> record
+        self._object_to_task: Dict[bytes, bytes] = {}        # return oid -> task_id
+        self._object_cache: Dict[bytes, Any] = {}            # oid -> deserialized value
+        self._exported_functions: set = set()
+        self._actor_clients: Dict[bytes, ActorClient] = {}
+        self._actor_states: Dict[bytes, Dict[str, Any]] = {}
+        self._actor_events: Dict[bytes, threading.Event] = defaultdict(threading.Event)
+        self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
+        self._free_buffer: List[ObjectID] = []
+        # Owner-side reference counting (reference `reference_count.h`):
+        # local ObjectRef count per object + pins while submitted tasks
+        # depend on the object; frees are deferred until both drop to zero.
+        self._ref_counts: Dict[bytes, int] = defaultdict(int)
+        self._dep_pins: Dict[bytes, int] = defaultdict(int)
+        self._deferred_free: set = set()
+        self._closed = False
+        # Worker-side execution context (set by worker loop while running)
+        self.executing_task: Optional[TaskSpec] = None
+
+    # ----------------------------------------------------------- push events
+
+    def _on_raylet_push(self, method: str, data: Any):
+        if method == "task_result":
+            task_id: TaskID = data["task_id"]
+            with self._lock:
+                rec = self._tasks.get(task_id.binary())
+            if rec is None:
+                return
+            if data.get("crashed") and rec.spec is not None and \
+                    rec.attempts < rec.spec.max_retries:
+                rec.attempts += 1
+                logger.warning("retrying task %s (attempt %d/%d)", rec.spec.name,
+                               rec.attempts, rec.spec.max_retries)
+                threading.Thread(target=self._submit_spec, args=(rec.spec,),
+                                 daemon=True).start()
+                return
+            rec.results = data.get("results") or []
+            rec.error = data.get("error")
+            rec.crashed = bool(data.get("crashed"))
+            if rec.spec is not None:
+                self._unpin_deps(rec.spec)
+            for r in rec.results:
+                if r["kind"] == "inline":
+                    rkey = r["object_id"].binary()
+                    if rkey not in self._object_to_task:
+                        continue  # all refs already dropped; don't cache
+                    try:
+                        self._object_cache[rkey] = \
+                            serialization.deserialize(r["data"])
+                    except Exception as e:
+                        rec.error = serialization.serialize_exception(
+                            RaySystemError(f"result deserialization failed: {e}"))
+            if rec.error is not None and rec.spec is not None:
+                # Materialize the error as the task's return objects so tasks
+                # elsewhere that depend on them get scheduled and re-raise
+                # (reference: error objects stored in the object store).
+                for oid in rec.spec.return_ids():
+                    try:
+                        self.gcs.call("object_location_add",
+                                      {"object_id": oid, "inline": rec.error,
+                                       "size": len(rec.error)}, timeout=10)
+                    except Exception:
+                        pass
+            rec.event.set()
+        elif method == "execute_task":
+            # Only workers receive this; WorkerLoop overrides via subclassing hook.
+            self.on_execute_task(data["spec"])
+
+    def on_execute_task(self, spec: TaskSpec):  # overridden in worker.py
+        raise RaySystemError("driver runtime received execute_task")
+
+    def _resubscribe_gcs(self, client: RpcClient):
+        with self._lock:
+            actor_keys = [k for k in self._actor_clients] + \
+                [k for k in self._actor_states]
+        for key in set(actor_keys):
+            client.call("subscribe", {"channel": "ACTOR", "key": key}, timeout=5)
+
+    def _on_gcs_push(self, method: str, data: Any):
+        if method != "pubsub":
+            return
+        if data["channel"] == "ACTOR":
+            actor_key = data["key"]
+            with self._lock:
+                self._actor_states[actor_key] = data["message"]
+                self._actor_events[actor_key].set()
+                client = self._actor_clients.get(actor_key)
+                if client is not None and data["message"].get("state") != "ALIVE":
+                    self._actor_clients.pop(actor_key, None)
+                    client.client.close()
+
+    # ------------------------------------------------------------------- put
+
+    def put(self, value: Any, _owner: Optional[str] = None) -> ObjectID:
+        with self._lock:
+            self._put_counter += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_counter)
+        self.put_with_id(oid, value)
+        return oid
+
+    def put_with_id(self, oid: ObjectID, value: Any):
+        parts = serialization.serialize(value)
+        size = serialization.serialized_size(parts)
+        if size <= GLOBAL_CONFIG.object_inline_max_bytes:
+            blob = b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
+            self.gcs.call("object_location_add",
+                          {"object_id": oid, "inline": blob, "size": size,
+                           "owner": self.worker_id.hex()})
+            self._object_cache[oid.binary()] = value
+        else:
+            self._write_segment(oid, parts, size)
+            self.raylet.call("object_sealed",
+                             {"object_id": oid, "size": size,
+                              "owner": self.worker_id.hex()})
+
+    def _write_segment(self, oid: ObjectID, parts, size: int):
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(
+            name=_segment_name(self.session_suffix, oid), create=True, size=max(size, 1))
+        try:
+            pos = 0
+            for p in parts:
+                n = p.nbytes if isinstance(p, memoryview) else len(p)
+                shm.buf[pos:pos + n] = p
+                pos += n
+        finally:
+            shm.close()
+            from ray_tpu.core.object_store import _untrack
+            _untrack(shm)
+
+    # ------------------------------------------------------ task submission
+
+    def export_function(self, blob: bytes) -> str:
+        fn_id = hashlib.sha1(blob).hexdigest()
+        if fn_id not in self._exported_functions:
+            self.gcs.call("kv_put", {"namespace": "fn", "key": fn_id.encode(),
+                                     "value": blob, "overwrite": False})
+            self._exported_functions.add(fn_id)
+        return fn_id
+
+    def serialize_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
+                       ) -> Tuple[List[Tuple[str, Any]], List[str]]:
+        """Inline small args; promote large ones to the store; pass refs through."""
+        from ray_tpu.object_ref import ObjectRef
+
+        out: List[Tuple[str, Any]] = []
+        flat = list(args) + list(kwargs.values())
+        for a in flat:
+            if isinstance(a, ObjectRef):
+                out.append(("r", a.object_id))
+            else:
+                blob = serialization.serialize_to_bytes(a)
+                if len(blob) > GLOBAL_CONFIG.object_inline_max_bytes:
+                    out.append(("r", self.put(a)))
+                else:
+                    out.append(("v", blob))
+        return out, list(kwargs.keys())
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        rec = _TaskRecord(spec=spec)
+        with self._lock:
+            self._tasks[spec.task_id.binary()] = rec
+            for oid in spec.return_ids():
+                self._object_to_task[oid.binary()] = spec.task_id.binary()
+        self._pin_deps(spec)
+        self._submit_spec(spec)
+        return spec.return_ids()
+
+    def _submit_spec(self, spec: TaskSpec):
+        target = self.raylet
+        for _hop in range(8):
+            try:
+                resp = target.call("submit_task",
+                                   {"spec": spec,
+                                    "grant_or_reject": _hop > 0})
+            except ConnectionLost:
+                raise RaySystemError("lost connection to raylet")
+            if resp["status"] == "queued":
+                return
+            if resp["status"] == "spillback":
+                target = self._raylet_for(resp["address"])
+                continue
+            raise RaySystemError(f"unexpected submit status {resp}")
+        raise RaySystemError("task spillback loop exceeded 8 hops")
+
+    def _raylet_for(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._raylet_clients.get(address)
+            if client is None or client.is_closed:
+                client = RpcClient(address, name="runtime->raylet-remote",
+                                   push_handler=self._on_raylet_push)
+                self._raylet_clients[address] = client
+            return client
+
+    # -------------------------------------------------------------- actors
+
+    def create_actor(self, spec: TaskSpec) -> ActorID:
+        self.gcs.call("subscribe", {"channel": "ACTOR", "key": spec.actor_id.binary()})
+        self.gcs.call("register_actor", {"spec": spec})
+        return spec.actor_id
+
+    def wait_for_actor(self, actor_id: ActorID, timeout: float = 120.0) -> str:
+        key = actor_id.binary()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                state = self._actor_states.get(key)
+            if state is None:
+                info = self.gcs.call("get_actor_info", {"actor_id": actor_id})
+                if info["known"]:
+                    state = {"state": info["state"], "address": info["address"],
+                             "reason": info.get("death_cause"),
+                             "error_blob": None}
+                    if info["state"] in ("ALIVE", "DEAD"):
+                        with self._lock:
+                            self._actor_states[key] = state
+            if state is not None:
+                if state["state"] == "ALIVE" and state.get("address"):
+                    return state["address"]
+                if state["state"] == "DEAD":
+                    blob = state.get("error_blob")
+                    if blob:
+                        err = serialization.deserialize_exception(blob)
+                        if isinstance(err, RayTaskError):
+                            raise err.as_instanceof_cause()
+                        raise err
+                    raise ActorDiedError(actor_id, f"Actor {actor_id.hex()[:12]} is dead: "
+                                                   f"{state.get('reason')}")
+            ev = self._actor_events[key]
+            ev.wait(timeout=0.5)
+            ev.clear()
+        raise GetTimeoutError(f"Timed out waiting for actor {actor_id.hex()[:12]}")
+
+    def _actor_client(self, actor_id: ActorID) -> ActorClient:
+        key = actor_id.binary()
+        with self._lock:
+            client = self._actor_clients.get(key)
+            if client is not None and not client.client.is_closed:
+                return client
+        address = self.wait_for_actor(actor_id)
+        with self._lock:
+            client = self._actor_clients.get(key)
+            if client is None or client.client.is_closed:
+                client = ActorClient(self, actor_id, address)
+                self._actor_clients[key] = client
+            return client
+
+    def submit_actor_task(self, spec: TaskSpec, retry_on_restart: int = 1
+                          ) -> List[ObjectID]:
+        rec = _TaskRecord(spec=spec)
+        with self._lock:
+            self._tasks[spec.task_id.binary()] = rec
+            for oid in spec.return_ids():
+                self._object_to_task[oid.binary()] = spec.task_id.binary()
+        self._pin_deps(spec)
+        last_err: Optional[Exception] = None
+        for _attempt in range(retry_on_restart + 1):
+            try:
+                client = self._actor_client(spec.actor_id)
+                spec.seq_no = client.seq
+                client.seq += 1
+                client.client.call("actor_call", {"spec": spec})
+                return spec.return_ids()
+            except (ConnectionLost, TimeoutError, RaySystemError) as e:
+                last_err = e
+                with self._lock:
+                    self._actor_clients.pop(spec.actor_id.binary(), None)
+                    self._actor_states.pop(spec.actor_id.binary(), None)
+                time.sleep(0.1)
+        # Mark the pending record failed so gets on its refs raise.
+        rec.error = serialization.serialize_exception(
+            ActorDiedError(spec.actor_id, f"actor call failed: {last_err}"))
+        rec.event.set()
+        return spec.return_ids()
+
+    def _on_actor_conn_lost(self, actor_id: ActorID):
+        """Direct connection to the actor's worker dropped: fail every
+        in-flight task on that actor (the reference resolves them to
+        RayActorError; restarted actors require fresh submissions unless
+        max_task_retries is set)."""
+        key = actor_id.binary()
+        with self._lock:
+            self._actor_clients.pop(key, None)
+            # Force re-resolution of the address on the next call.
+            state = self._actor_states.get(key)
+            if state is not None and state.get("state") == "ALIVE":
+                self._actor_states.pop(key, None)
+            pending = [rec for rec in self._tasks.values()
+                       if rec.spec is not None and rec.spec.actor_id == actor_id
+                       and not rec.event.is_set()]
+        err = serialization.serialize_exception(
+            ActorDiedError(actor_id,
+                           f"The actor {actor_id.hex()[:12]} died while this "
+                           "task was in flight."))
+        for rec in pending:
+            rec.error = err
+            rec.event.set()
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.gcs.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    def get_named_actor(self, name: str, namespace: Optional[str] = None):
+        resp = self.gcs.call("get_named_actor",
+                             {"name": name, "namespace": namespace or self.namespace})
+        if not resp["found"]:
+            raise ValueError(f"Failed to look up actor '{name}'. "
+                             "It was either not created or died.")
+        return resp["actor_id"], resp["creation_spec"]
+
+    # ----------------------------------------------------------------- get
+
+    def get(self, object_ids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        state = {"blocked": False}
+
+        def on_block():
+            if not state["blocked"] and self.executing_task is not None:
+                state["blocked"] = True
+                self._notify_blocked(True)
+
+        try:
+            return [self._get_one(oid, deadline, on_block) for oid in object_ids]
+        finally:
+            if state["blocked"]:
+                self._notify_blocked(False)
+
+    def _notify_blocked(self, blocked: bool):
+        if self.executing_task is None:
+            return
+        try:
+            self.raylet.call("worker_blocked" if blocked else "worker_unblocked", {},
+                             timeout=5)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _maybe_raise(value: Any) -> Any:
+        if isinstance(value, RayTaskError):
+            raise value.as_instanceof_cause()
+        return value
+
+    def _get_one(self, oid: ObjectID, deadline: Optional[float], on_block=None) -> Any:
+        key = oid.binary()
+        cached = self._object_cache.get(key, _PENDING)
+        if cached is not _PENDING:
+            return self._maybe_raise(cached)
+        task_key = self._object_to_task.get(key)
+        if task_key is not None:
+            rec = self._tasks.get(task_key)
+            if rec is not None:
+                if not rec.event.is_set():
+                    if on_block:
+                        on_block()
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError("Get timed out")
+                    if not rec.event.wait(remaining):
+                        raise GetTimeoutError("Get timed out")
+                if rec.error is not None:
+                    err = serialization.deserialize_exception(rec.error)
+                    if isinstance(err, RayTaskError):
+                        raise err.as_instanceof_cause()
+                    raise err
+                cached = self._object_cache.get(key, _PENDING)
+                if cached is not _PENDING:
+                    return self._maybe_raise(cached)
+                # Large result: fall through to store fetch.
+        # Store / directory path
+        value = self.store.get_value(oid) if self.store.contains(oid) else _PENDING
+        if value is not _PENDING:
+            self._object_cache[key] = value
+            return self._maybe_raise(value)
+        if on_block:
+            on_block()
+        remaining = 3600.0 if deadline is None else max(0.0, deadline - time.monotonic())
+        resp = self.raylet.call("get_or_pull", {"object_id": oid, "timeout": remaining},
+                                timeout=remaining + 10)
+        if resp["status"] == "local":
+            value = self.store.get_value(oid)
+        elif resp["status"] == "inline":
+            value = serialization.deserialize(resp["data"])
+        else:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"Timed out getting {oid}")
+            raise ObjectLostError(oid)
+        self._object_cache[key] = value
+        return self._maybe_raise(value)
+
+    # ---------------------------------------------------------------- wait
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectID], List[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectID] = []
+        pending = list(object_ids)
+        sleep = 0.001
+        while True:
+            still = []
+            for oid in pending:
+                if self._is_ready(oid):
+                    ready.append(oid)
+                else:
+                    still.append(oid)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.05)
+        # Preserve input order; cap ready at num_returns (overflow stays
+        # in the pending list, matching the reference wait() contract).
+        ready_set = {r.binary() for r in ready}
+        ordered_ready = [o for o in object_ids if o.binary() in ready_set]
+        capped = ordered_ready[:num_returns]
+        capped_set = {o.binary() for o in capped}
+        return capped, [o for o in object_ids if o.binary() not in capped_set]
+
+    def _is_ready(self, oid: ObjectID) -> bool:
+        key = oid.binary()
+        if key in self._object_cache:
+            return True
+        task_key = self._object_to_task.get(key)
+        if task_key is not None:
+            rec = self._tasks.get(task_key)
+            if rec is not None:
+                return rec.event.is_set()
+        if self.store.contains(oid):
+            return True
+        try:
+            entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=5)
+            return bool(entry.get("known") and
+                        (entry.get("inline") is not None or entry.get("nodes")))
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------- cleanup
+
+    def register_ref(self, oid: ObjectID):
+        with self._lock:
+            self._ref_counts[oid.binary()] += 1
+
+    def deregister_ref(self, oid: ObjectID):
+        if self._closed:
+            return
+        key = oid.binary()
+        with self._lock:
+            self._ref_counts[key] -= 1
+            if self._ref_counts[key] > 0:
+                return
+            self._ref_counts.pop(key, None)
+            # Prune driver-side caches so long-running drivers don't leak
+            # one record per completed task (see reference TaskManager's
+            # completed-task eviction).
+            self._object_cache.pop(key, None)
+            task_key = self._object_to_task.pop(key, None)
+            if task_key is not None:
+                rec = self._tasks.get(task_key)
+                if rec is not None and rec.event.is_set():
+                    returns = rec.spec.return_ids() if rec.spec is not None else []
+                    if not any(r.binary() in self._object_to_task for r in returns):
+                        self._tasks.pop(task_key, None)
+            if self._dep_pins.get(key, 0) > 0:
+                self._deferred_free.add(key)
+                return
+        self.free_ref(oid)
+
+    def _pin_deps(self, spec: TaskSpec):
+        with self._lock:
+            for dep in spec.dependencies():
+                self._dep_pins[dep.binary()] += 1
+
+    def _unpin_deps(self, spec: TaskSpec):
+        to_free = []
+        with self._lock:
+            for dep in spec.dependencies():
+                key = dep.binary()
+                self._dep_pins[key] -= 1
+                if self._dep_pins[key] <= 0:
+                    self._dep_pins.pop(key, None)
+                    if key in self._deferred_free:
+                        self._deferred_free.discard(key)
+                        to_free.append(dep)
+        for dep in to_free:
+            self.free_ref(dep)
+
+    def free_ref(self, oid: ObjectID):
+        """Owner dropped its last reference; batch-free in the directory."""
+        if self._closed:
+            return
+        with self._lock:
+            self._free_buffer.append(oid)
+            flush = len(self._free_buffer) >= 100
+            if flush:
+                batch, self._free_buffer = self._free_buffer, []
+        if flush:
+            try:
+                self.gcs.call("free_objects", {"object_ids": batch}, timeout=5)
+            except Exception:
+                pass
+
+    def shutdown(self):
+        self._closed = True
+        for c in self._actor_clients.values():
+            c.client.close()
+        for c in self._raylet_clients.values():
+            c.close()
+        self.gcs.close()
+        self.store.close()

@@ -1,0 +1,142 @@
+"""Serialization: cloudpickle + pickle protocol 5 out-of-band buffers.
+
+Equivalent of the reference's `SerializationContext`
+(`python/ray/_private/serialization.py:108`) + vendored cloudpickle: values are
+pickled with protocol 5; large contiguous buffers (numpy arrays, jax host
+arrays) are carried out-of-band so readers can map them zero-copy from shared
+memory. Exceptions are wrapped so the remote traceback survives the boundary.
+
+Wire layout of a serialized value:
+
+    [8B magic+version][msgpack header: {p: pickle_len, b: [buffer lengths]}]
+    [pickle bytes][buffer 0 (8B aligned)][buffer 1]...
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+
+_MAGIC = b"RTPU\x01\x00\x00\x00"
+_ALIGN = 8
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(value: Any) -> List[memoryview | bytes]:
+    """Serialize to a list of buffers (header + pickle + OOB buffers).
+
+    Returns a buffer list suitable for vectored writes; total size is
+    sum(len(b) padded to 8) for the OOB region.
+    """
+    oob: List[pickle.PickleBuffer] = []
+
+    def callback(buf: pickle.PickleBuffer):
+        oob.append(buf)
+        return False  # out-of-band
+
+    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=callback)
+    raw_views: List[memoryview] = []
+    lens: List[int] = []
+    for b in oob:
+        m = b.raw()
+        if not m.contiguous:
+            m = memoryview(bytes(b))
+        else:
+            m = m.cast("B")
+        raw_views.append(m)
+        lens.append(m.nbytes)
+    header = msgpack.packb({"p": len(payload), "b": lens})
+    parts: List[memoryview | bytes] = [
+        _MAGIC + struct.pack("<I", len(header)),
+        header,
+        payload,
+    ]
+    # Pad pickle so OOB buffers start aligned.
+    pos = len(_MAGIC) + 4 + len(header) + len(payload)
+    for m in raw_views:
+        pad = _align(pos) - pos
+        if pad:
+            parts.append(b"\x00" * pad)
+            pos += pad
+        parts.append(m)
+        pos += m.nbytes
+    return parts
+
+
+def serialized_size(parts: List[memoryview | bytes]) -> int:
+    return sum(p.nbytes if isinstance(p, memoryview) else len(p) for p in parts)
+
+
+def serialize_to_bytes(value: Any) -> bytes:
+    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in serialize(value))
+
+
+def deserialize(data: memoryview | bytes, zero_copy: bool = True) -> Any:
+    """Deserialize from a contiguous buffer. When ``zero_copy`` and ``data``
+    is a memoryview backed by shared memory, numpy arrays reference the shm
+    pages directly (read-only semantics are the caller's contract)."""
+    view = memoryview(data).cast("B")
+    if bytes(view[:4]) != _MAGIC[:4]:
+        raise ValueError("Corrupt serialized value (bad magic)")
+    (hlen,) = struct.unpack("<I", view[8:12])
+    header = msgpack.unpackb(bytes(view[12 : 12 + hlen]))
+    pos = 12 + hlen
+    payload = view[pos : pos + header["p"]]
+    pos += header["p"]
+    buffers = []
+    for blen in header["b"]:
+        pos = _align(pos)
+        b = view[pos : pos + blen]
+        if not zero_copy:
+            b = memoryview(bytes(b))
+        buffers.append(b)
+        pos += blen
+    return pickle.loads(bytes(payload), buffers=buffers)
+
+
+def dumps(value: Any) -> bytes:
+    """Plain in-band cloudpickle (for control-plane messages)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def serialize_exception(exc: BaseException, function_name: str = "") -> bytes:
+    """Serialize an exception as a framed value (so error blobs can double as
+    object-store values: the reference stores RayTaskError AS the object so
+    dependent tasks schedule and then raise). Falls back when unpicklable."""
+    import traceback
+
+    from ray_tpu.exceptions import RayTaskError
+
+    if isinstance(exc, RayTaskError):
+        # Already wrapped upstream (error object flowed through a dependency):
+        # re-serialize as-is so the original cause's type survives.
+        return serialize_to_bytes(RayTaskError(exc.function_name,
+                                               exc.traceback_str, exc.cause))
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        cause: Exception | None = exc if isinstance(exc, Exception) else None
+        err = RayTaskError(function_name, tb, cause)
+        return serialize_to_bytes(err)
+    except Exception:
+        err = RayTaskError(function_name, tb, None)
+        return serialize_to_bytes(err)
+
+
+def deserialize_exception(data: bytes):
+    try:
+        return deserialize(data, zero_copy=False)
+    except Exception as e:  # unpicklable user exception type on this side
+        from ray_tpu.exceptions import RaySystemError
+
+        return RaySystemError(f"Failed to deserialize remote error: {e}")

@@ -1,0 +1,294 @@
+"""Worker process: executes tasks and hosts actors.
+
+Equivalent of the reference's Python worker (`python/ray/_private/workers/
+default_worker.py` + the execution half of CoreWorker, `core_worker.cc:2529`
+ExecuteTask and the scheduling queues in `core_worker/transport/`):
+
+- Normal tasks arrive as pushes from the raylet over the registration
+  connection and run on a single executor thread.
+- Actor method calls arrive on the worker's *direct* RPC server, one
+  connection per caller. Per-connection handler threads give per-caller FIFO;
+  an executor sized by `max_concurrency` runs them (async `async def` methods
+  run on an asyncio loop, matching the reference's async actors on fibers,
+  `core_worker/fiber.h`).
+- Results: small values returned inline; large values sealed straight into
+  the node's shared-memory store.
+
+TPU note: a worker granted TPU resources receives `RAY_TPU_GRANTED_TPU`;
+jax is imported lazily by user code, so a plain CPU worker never pays the
+jax import or chip-lock cost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import signal
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import TaskSpec
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.rpc import Connection, RpcServer
+from ray_tpu.core.runtime import CoreRuntime
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerRuntime(CoreRuntime):
+    """CoreRuntime + task execution loop."""
+
+    def __init__(self):
+        worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+        self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        # Direct server must exist before registration (address is reported).
+        self.direct_server = RpcServer(name="worker-direct")
+        self.direct_server.register("actor_call", self._handle_actor_call)
+        self.direct_server.register("ping", lambda conn, data: {"ok": True})
+        self.direct_server.start()
+        super().__init__(
+            gcs_address=os.environ["RAY_TPU_GCS_ADDRESS"],
+            raylet_address=os.environ["RAY_TPU_RAYLET_ADDRESS"],
+            session_suffix=os.environ["RAY_TPU_SESSION"],
+            node_id=NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"]),
+            job_id=JobID.nil(),
+            worker_id=worker_id,
+            is_driver=False,
+        )
+        self.current_task_id = TaskID.for_task(JobID.nil())
+        self._fn_cache: Dict[str, Any] = {}
+        # Actor state
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._actor_executor: Optional[Any] = None
+        self._async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------ plumbing
+
+    def register(self):
+        resp = self.raylet.call(
+            "register_worker",
+            {"worker_id": self.worker_id, "pid": os.getpid(),
+             "direct_address": self.direct_server.address})
+        if not resp.get("ok"):
+            raise RuntimeError("raylet refused worker registration")
+
+    def on_execute_task(self, spec: TaskSpec):
+        # Called on the RpcClient reader thread: enqueue only.
+        self._task_queue.put(spec)
+
+    def main_loop(self):
+        while not self._stopping.is_set():
+            try:
+                spec = self._task_queue.get(timeout=1.0)
+            except queue.Empty:
+                if self.raylet.is_closed:
+                    logger.info("raylet connection closed; worker exiting")
+                    return
+                continue
+            self._execute(spec)
+
+    # ----------------------------------------------------------- execution
+
+    def _resolve_function(self, spec: TaskSpec):
+        if spec.function_blob is not None:
+            return serialization.loads(spec.function_blob)
+        fn_id = spec.function_id
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            resp = self.gcs.call("kv_get", {"namespace": "fn", "key": fn_id.encode()})
+            blob = resp["value"]
+            if blob is None:
+                raise RuntimeError(f"function {fn_id} not found in GCS function table")
+            fn = serialization.loads(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        values = []
+        for kind, payload in spec.args:
+            if kind == "v":
+                values.append(serialization.deserialize(payload))
+            else:
+                values.append(self.get([payload])[0])
+        nk = len(spec.kwargs_keys)
+        if nk:
+            pos, kwvals = values[:-nk], values[-nk:]
+            return pos, dict(zip(spec.kwargs_keys, kwvals))
+        return values, {}
+
+    def _execute(self, spec: TaskSpec):
+        self.executing_task = spec
+        results: List[Dict[str, Any]] = []
+        error_blob: Optional[bytes] = None
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.actor_creation:
+                cls = serialization.loads(spec.actor_class_blob)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_spec = spec
+                self._setup_actor_executor(spec.actor_max_concurrency)
+                values = []
+            else:
+                fn = self._resolve_function(spec)
+                out = fn(*args, **kwargs)
+                if asyncio.iscoroutine(out):
+                    out = asyncio.new_event_loop().run_until_complete(out)
+                values = self._pack_returns(spec, out)
+            results = [self._store_result(oid, v)
+                       for oid, v in zip(spec.return_ids(), values)]
+        except BaseException as e:  # noqa: BLE001 - worker must survive user errors
+            error_blob = serialization.serialize_exception(e, spec.name)
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                self._stopping.set()
+        finally:
+            self.executing_task = None
+        try:
+            self.raylet.call("task_done",
+                             {"task_id": spec.task_id, "results": results,
+                              "error": error_blob}, timeout=30)
+        except Exception:
+            logger.exception("failed to report task_done")
+
+    def _pack_returns(self, spec: TaskSpec, out: Any) -> List[Any]:
+        if spec.num_returns == 1:
+            return [out]
+        if spec.num_returns == 0:
+            return []
+        vals = list(out)
+        if len(vals) != spec.num_returns:
+            raise ValueError(
+                f"Task {spec.name} declared num_returns={spec.num_returns} but "
+                f"returned {len(vals)} values")
+        return vals
+
+    def _store_result(self, oid: ObjectID, value: Any) -> Dict[str, Any]:
+        parts = serialization.serialize(value)
+        size = serialization.serialized_size(parts)
+        if size <= GLOBAL_CONFIG.object_inline_max_bytes:
+            blob = b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
+            return {"object_id": oid, "kind": "inline", "data": blob}
+        self._write_segment(oid, parts, size)
+        return {"object_id": oid, "kind": "store", "size": size}
+
+    # -------------------------------------------------------------- actors
+
+    def _setup_actor_executor(self, max_concurrency: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._actor_executor = ThreadPoolExecutor(
+            max_workers=max(1, max_concurrency), thread_name_prefix="actor-exec")
+        loop = asyncio.new_event_loop()
+        self._async_loop = loop
+        threading.Thread(target=loop.run_forever, name="actor-asyncio",
+                         daemon=True).start()
+
+    def _handle_actor_call(self, conn: Connection, data: Dict[str, Any]):
+        spec: TaskSpec = data["spec"]
+        if self.actor_instance is None:
+            raise RuntimeError("actor not initialized")
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if method is None and spec.method_name != "__ray_terminate__":
+            # A task-level error, not a transport error: the caller gets an
+            # AttributeError on get() and the actor stays alive.
+            err = serialization.serialize_exception(
+                AttributeError(f"actor {type(self.actor_instance).__name__!r} "
+                               f"has no method {spec.method_name!r}"), spec.name)
+            self._reply_actor_result(conn, spec, [], err)
+            return {"accepted": True}
+        if spec.method_name == "__ray_terminate__":
+            self._actor_executor.submit(self._run_actor_method, conn, spec,
+                                        method or (lambda: None))
+            return {"accepted": True}
+        if asyncio.iscoroutinefunction(getattr(method, "__func__", method)):
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_method_async(conn, spec, method), self._async_loop)
+        else:
+            self._actor_executor.submit(self._run_actor_method, conn, spec, method)
+        return {"accepted": True}
+
+    def _run_actor_method(self, conn: Connection, spec: TaskSpec, method):
+        results: List[Dict[str, Any]] = []
+        error_blob: Optional[bytes] = None
+        try:
+            if spec.method_name == "__ray_terminate__":
+                self._graceful_exit(conn, spec)
+                return
+            args, kwargs = self._resolve_args(spec)
+            out = method(*args, **kwargs)
+            values = self._pack_returns(spec, out)
+            results = [self._store_result(oid, v)
+                       for oid, v in zip(spec.return_ids(), values)]
+        except BaseException as e:  # noqa: BLE001
+            error_blob = serialization.serialize_exception(e, spec.name)
+        self._reply_actor_result(conn, spec, results, error_blob)
+
+    async def _run_actor_method_async(self, conn: Connection, spec: TaskSpec, method):
+        results: List[Dict[str, Any]] = []
+        error_blob: Optional[bytes] = None
+        try:
+            args, kwargs = self._resolve_args(spec)
+            out = await method(*args, **kwargs)
+            values = self._pack_returns(spec, out)
+            results = [self._store_result(oid, v)
+                       for oid, v in zip(spec.return_ids(), values)]
+        except BaseException as e:  # noqa: BLE001
+            error_blob = serialization.serialize_exception(e, spec.name)
+        self._reply_actor_result(conn, spec, results, error_blob)
+
+    def _reply_actor_result(self, conn: Connection, spec: TaskSpec,
+                            results, error_blob):
+        # Register large results with the raylet so other nodes can pull them.
+        for r in results:
+            if r["kind"] == "store":
+                try:
+                    self.raylet.call("object_sealed",
+                                     {"object_id": r["object_id"], "size": r["size"],
+                                      "owner": self.worker_id.hex()}, timeout=30)
+                except Exception:
+                    logger.exception("failed to register actor result")
+        try:
+            conn.push("task_result",
+                      {"task_id": spec.task_id, "results": results, "error": error_blob})
+        except Exception:
+            logger.warning("actor result push failed (caller gone?)")
+
+    def _graceful_exit(self, conn: Connection, spec: TaskSpec):
+        self._reply_actor_result(conn, spec, [], None)
+        self._stopping.set()
+        threading.Thread(target=lambda: (os._exit(0)), daemon=True).start()
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format=(f"%(asctime)s [worker pid={os.getpid()}] "
+                "%(levelname)s %(name)s: %(message)s"),
+    )
+    runtime = WorkerRuntime()
+
+    def _term(signum, frame):
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    # Bind the process-global runtime so user code calling ray_tpu.get/put/
+    # remote inside tasks routes through this worker's CoreRuntime.
+    import ray_tpu
+
+    ray_tpu._global_runtime = runtime
+    runtime.register()
+    try:
+        runtime.main_loop()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""User-facing exception types.
+
+Parity with the reference's `python/ray/exceptions.py`: task errors wrap the
+remote traceback, actor errors and death causes, object loss/owner-death errors.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception; re-raised on `get` with the remote traceback."""
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        # Always pickle as the base class; the dynamic dual-inheritance class
+        # from as_instanceof_cause() is rebuilt on the receiving side.
+        return (_rebuild_task_error, (self.function_name, self.traceback_str, self.cause))
+
+    def as_instanceof_cause(self):
+        """Return an exception that is both a RayTaskError and the cause's type,
+        so `except UserError` works across the task boundary."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if cause_cls is RayTaskError or not issubclass(cause_cls, Exception):
+            return self
+        name = f"RayTaskError({cause_cls.__name__})"
+        cls = type(name, (RayTaskError, cause_cls), {})
+        err = cls.__new__(cls)
+        RayTaskError.__init__(err, self.function_name, self.traceback_str, self.cause)
+        return err
+
+
+def _rebuild_task_error(function_name, traceback_str, cause):
+    return RayTaskError(function_name, traceback_str, cause)
+
+
+class RayActorError(RayTpuError):
+    """The actor died before or during method execution."""
+
+    def __init__(self, actor_id=None, message: str = "The actor died unexpectedly."):
+        self.actor_id = actor_id
+        super().__init__(message)
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """The actor is temporarily unreachable (restarting or network partition)."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled.")
+
+
+class ObjectLostError(RayTpuError):
+    """The object's value was lost from the store and could not be reconstructed."""
+
+    def __init__(self, object_id=None, message: str | None = None):
+        self.object_id = object_id
+        super().__init__(message or f"Object {object_id} was lost.")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    """The worker that owned this object died, so the value is unrecoverable."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (OOM kill, segfault, ...)."""
+
+
+class OutOfMemoryError(WorkerCrashedError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass
+
+
+class RaySystemError(RayTpuError):
+    """Internal framework failure (control plane / store)."""

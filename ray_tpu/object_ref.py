@@ -1,0 +1,95 @@
+"""ObjectRef: a future for a value in the distributed object store.
+
+Equivalent of `ray.ObjectRef` (`python/ray/_raylet.pyx` ObjectRef): compares
+and hashes by id, picklable (passing one to a task makes that task a
+borrower), supports `future()`-style callbacks via the owning runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("object_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
+        self.object_id = object_id
+        self._owner_hint = owner_hint
+        rt = _current_runtime()
+        if rt is not None:
+            rt.register_ref(object_id)
+
+    def binary(self) -> bytes:
+        return self.object_id.binary()
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def task_id(self):
+        return self.object_id.task_id()
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()})"
+
+    def __reduce__(self):
+        return (_reconstruct_ref, (self.object_id.binary(), self._owner_hint))
+
+    def __del__(self):
+        try:
+            rt = _current_runtime()
+            if rt is not None:
+                rt.deregister_ref(self.object_id)
+        except Exception:
+            pass
+
+    def __await__(self):
+        """Allow `await ref` inside async actors."""
+        import asyncio
+
+        async def _poll():
+            import ray_tpu
+
+            while True:
+                ready, _ = ray_tpu.wait([self], timeout=0)
+                if ready:
+                    return ray_tpu.get(self)
+                await asyncio.sleep(0.002)
+
+        return _poll().__await__()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+
+        import ray_tpu
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(ray_tpu.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+def _current_runtime():
+    import ray_tpu
+
+    return getattr(ray_tpu, "_global_runtime", None)
+
+
+def _reconstruct_ref(binary: bytes, owner_hint):
+    return ObjectRef(ObjectID(binary), owner_hint)

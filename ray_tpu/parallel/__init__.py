@@ -1,0 +1,30 @@
+"""TPU parallelism layer: meshes, shardings, distributed init, collectives.
+
+This is the TPU-native replacement for the reference's NCCL/Gloo collective
+layer (`python/ray/util/collective/`) and Train's `torch.distributed` process
+groups (`python/ray/train/torch/config.py:69-113`): parallelism is expressed
+as a named `jax.sharding.Mesh` + sharding annotations, and XLA compiles the
+collectives onto ICI/DCN (SURVEY.md §5.7-5.8).
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    logical_axis_rules,
+    named_sharding,
+    shard_params,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.distributed import (
+    DistributedContext,
+    initialize_distributed,
+)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_mesh", "logical_axis_rules",
+    "named_sharding", "shard_params", "with_logical_constraint",
+    "DistributedContext", "initialize_distributed",
+]

@@ -1,0 +1,114 @@
+"""Multi-host JAX process-group formation.
+
+This is the seam the reference fills with `torch.distributed.init_process_group`
+over NCCL (`python/ray/train/torch/config.py:69,113`) — here it is coordinator
+election + `jax.distributed.initialize`, after which every host sees the full
+multi-host device set and `pjit` programs compile collectives over ICI/DCN.
+
+Protocol (driven by train.JaxBackend over a worker group):
+  1. rank 0 picks a free port -> coordinator address
+  2. every worker calls `initialize_distributed(addr, world, rank)`
+  3. each worker builds the same Mesh over `jax.devices()` (global)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DistributedContext:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    initialized: bool = False
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+_ctx: Optional[DistributedContext] = None
+
+
+def get_address_and_port() -> tuple:
+    hostname = socket.gethostbyname(socket.gethostname())
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return hostname, port
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: int = 1,
+    process_id: int = 0,
+    local_device_ids: Optional[list] = None,
+) -> DistributedContext:
+    """Join the JAX process group. Single-process (num_processes=1) is a
+    no-op beyond recording context — jax.devices() already sees local chips.
+
+    Never call after any jax computation has run in this process (XLA
+    backends are frozen after first use) — the framework guarantees this by
+    doing it in `Backend.on_start` before user code (SURVEY.md §3.4).
+    """
+    global _ctx
+    if _ctx is not None and _ctx.initialized:
+        if (_ctx.coordinator_address == coordinator_address
+                and _ctx.process_id == process_id):
+            return _ctx
+        raise RuntimeError("jax.distributed already initialized differently")
+    ctx = DistributedContext(coordinator_address or "local",
+                             num_processes, process_id)
+    if num_processes > 1:
+        import jax
+
+        kwargs = {}
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = local_device_ids
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+        logger.info("jax.distributed initialized: rank %d/%d via %s",
+                    process_id, num_processes, coordinator_address)
+    ctx.initialized = True
+    _ctx = ctx
+    return ctx
+
+
+def shutdown_distributed():
+    global _ctx
+    if _ctx is not None and _ctx.initialized and _ctx.num_processes > 1:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _ctx = None
+
+
+def distributed_context() -> Optional[DistributedContext]:
+    return _ctx
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()

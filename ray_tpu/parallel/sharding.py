@@ -1,0 +1,109 @@
+"""Logical-axis sharding rules and helpers.
+
+Parameters and activations are annotated with *logical* axis names
+("embed", "mlp", "heads", "batch", "seq", ...); rules map logical axes to
+mesh axes (dp/fsdp/tp/sp). This is the GSPMD idiom: annotate, let XLA place
+collectives — the replacement for the reference's hand-managed NCCL calls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Default rules: FSDP shards embed dim; TP shards mlp/hidden + heads; SP
+# shards sequence; batch over dp+fsdp.
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", "fsdp"),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv", None),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("norm", None),
+)
+
+
+def logical_axis_rules(overrides: Optional[Dict[str, Any]] = None
+                       ) -> List[Tuple[str, Any]]:
+    rules = dict(DEFAULT_RULES)
+    if overrides:
+        rules.update(overrides)
+    return list(rules.items())
+
+
+def _spec_for(logical_axes: Sequence[Optional[str]], rules: Dict[str, Any],
+              mesh_axes: Sequence[str]):
+    import jax
+
+    out = []
+    used = set()
+    for ax in logical_axes:
+        target = rules.get(ax) if ax is not None else None
+        if target is None:
+            out.append(None)
+            continue
+        if isinstance(target, (tuple, list)):
+            present = tuple(t for t in target if t in mesh_axes and t not in used)
+            used.update(present)
+            out.append(present if present else None)
+        else:
+            if target in mesh_axes and target not in used:
+                used.add(target)
+                out.append(target)
+            else:
+                out.append(None)
+    return jax.sharding.PartitionSpec(*out)
+
+
+def named_sharding(mesh, *logical_axes: Optional[str],
+                   rules: Optional[Dict[str, Any]] = None):
+    """NamedSharding for a value whose dims carry these logical axis names."""
+    import jax
+
+    rd = dict(DEFAULT_RULES)
+    if rules:
+        rd.update(rules)
+    spec = _spec_for(logical_axes, rd, mesh.axis_names)
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
+def with_logical_constraint(x, mesh, *logical_axes: Optional[str],
+                            rules: Optional[Dict[str, Any]] = None):
+    """Annotate an intermediate value inside jit (lax.with_sharding_constraint)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, *logical_axes, rules=rules))
+
+
+def shard_params(params, mesh, param_logical_axes,
+                 rules: Optional[Dict[str, Any]] = None):
+    """device_put a parameter pytree according to per-leaf logical axes.
+
+    `param_logical_axes` is a pytree matching `params` whose leaves are
+    tuples of logical axis names (or None for replicated).
+    """
+    import jax
+
+    def place(p, axes):
+        if axes is None:
+            sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        else:
+            sh = named_sharding(mesh, *axes, rules=rules)
+        return jax.device_put(p, sh)
+
+    return jax.tree.map(place, params, param_logical_axes,
+                        is_leaf=lambda x: x is None)
+
+
+def replicated(mesh):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def batch_sharding(mesh):
+    """Sharding for host data entering the program: batch over dp(+fsdp)."""
+    return named_sharding(mesh, "batch")

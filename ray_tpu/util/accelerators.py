@@ -1,0 +1,96 @@
+"""TPU accelerator & pod-slice topology registry.
+
+The reference's `python/ray/util/accelerators/accelerators.py` enumerates GPU
+types and has NO TPU entry; TPU topology awareness is the net-new first-class
+capability here (SURVEY.md §7): generation → chips/host, hosts per slice
+topology, and ICI axis shapes used by `ray_tpu.parallel.mesh` to lay device
+meshes over slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+# Accelerator type constants (custom resource names)
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5E"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+NVIDIA_TESLA_V100 = "V100"
+NVIDIA_TESLA_T4 = "T4"
+NVIDIA_TESLA_A100 = "A100"
+NVIDIA_A10G = "A10G"
+NVIDIA_H100 = "H100"
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    name: str
+    chips_per_host: int
+    cores_per_chip: int
+    hbm_gb_per_chip: float
+    # Max ICI torus shape of a full pod (chips)
+    pod_shape: Tuple[int, ...]
+    megacore: bool = False
+
+
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    "v2": TpuGeneration("v2", 4, 2, 8, (4, 4, 2)),
+    "v3": TpuGeneration("v3", 4, 2, 16, (8, 8, 4)),
+    "v4": TpuGeneration("v4", 4, 2, 32, (8, 8, 8), megacore=True),
+    "v5e": TpuGeneration("v5e", 4, 1, 16, (16, 16, 1)),
+    "v5p": TpuGeneration("v5p", 4, 2, 95, (16, 16, 12), megacore=True),
+    "v6e": TpuGeneration("v6e", 4, 1, 32, (16, 16, 1)),
+}
+
+
+def parse_slice(slice_name: str) -> Tuple[str, int]:
+    """'v4-32' -> ('v4', 32 cores) ; returns (generation, total cores)."""
+    gen, _, cores = slice_name.partition("-")
+    gen = gen.lower().lstrip("tpu").lstrip("_") or gen.lower()
+    if gen not in TPU_GENERATIONS:
+        raise ValueError(f"Unknown TPU generation in '{slice_name}'")
+    return gen, int(cores)
+
+
+def slice_chip_count(slice_name: str) -> int:
+    gen, cores = parse_slice(slice_name)
+    g = TPU_GENERATIONS[gen]
+    return cores // g.cores_per_chip
+
+
+def slice_host_count(slice_name: str) -> int:
+    gen, _ = parse_slice(slice_name)
+    g = TPU_GENERATIONS[gen]
+    return max(1, slice_chip_count(slice_name) // g.chips_per_host)
+
+
+def slice_bundles(slice_name: str, cpus_per_host: float = 1.0):
+    """Placement-group bundles for one pod slice: one bundle per TPU host.
+
+    Feed to `placement_group(..., strategy='STRICT_SPREAD')` so each bundle
+    lands on a distinct host — the JaxBackend then runs one JAX process per
+    bundle and forms the ICI mesh.
+    """
+    gen, _ = parse_slice(slice_name)
+    g = TPU_GENERATIONS[gen]
+    hosts = slice_host_count(slice_name)
+    chips = min(g.chips_per_host, slice_chip_count(slice_name))
+    return [{"CPU": cpus_per_host, "TPU": float(chips)} for _ in range(hosts)]
+
+
+def detect_local_generation() -> Optional[str]:
+    """Best-effort generation detection from TPU runtime env vars."""
+    import os
+
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v4-8"
+    if accel:
+        try:
+            return parse_slice(accel)[0]
+        except ValueError:
+            return None
+    return None

@@ -1,0 +1,191 @@
+"""Host-level collective ops between actors.
+
+Equivalent of `python/ray/util/collective/collective.py` (:40 GroupManager,
+:120 init_collective_group, :258 allreduce) — but with no NCCL/Gloo layer:
+
+- **Device-side collectives** (the hot path) live *inside* XLA programs:
+  `jax.lax.psum/...` over a mesh axis, compiled to ICI/DCN transfers. See
+  `ray_tpu.parallel`. A "collective group" maps to a named JAX mesh, not a
+  communicator object (SURVEY.md §5.8).
+- **This module** is the host-RAM fallback for control-plane data (metric
+  reduction, weight broadcast between actor groups, rendezvous): CPU
+  reductions via a rendezvous actor, exchanging numpy through the object
+  store (zero-copy shm on one host).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, np.add),
+    "product": lambda xs: _tree_reduce(xs, np.multiply),
+    "min": lambda xs: _tree_reduce(xs, np.minimum),
+    "max": lambda xs: _tree_reduce(xs, np.maximum),
+}
+
+
+def _tree_reduce(xs: List[Any], op):
+    out = xs[0]
+    for x in xs[1:]:
+        out = _tree_map2(op, out, x)
+    return out
+
+
+def _tree_map2(op, a, b):
+    if isinstance(a, dict):
+        return {k: _tree_map2(op, a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_tree_map2(op, x, y) for x, y in zip(a, b))
+    return op(np.asarray(a), np.asarray(b))
+
+
+class _RendezvousActor:
+    """Barrier + gather/reduce/broadcast state machine for one group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._round: Dict[str, Dict[int, Any]] = {}
+        self._results: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._events: Dict[str, threading.Event] = {}
+
+    def _event(self, key: str) -> threading.Event:
+        with self._lock:
+            return self._events.setdefault(key, threading.Event())
+
+    def contribute(self, key: str, rank: int, value: Any, op: Optional[str]):
+        with self._lock:
+            slot = self._round.setdefault(key, {})
+            slot[rank] = value
+            done = len(slot) == self.world_size
+            if done:
+                vals = [slot[r] for r in sorted(slot)]
+                if op is None:
+                    self._results[key] = vals                # allgather
+                else:
+                    self._results[key] = _REDUCE_OPS[op](vals)
+                del self._round[key]
+        if done:
+            self._event(key).set()
+        return True
+
+    def fetch(self, key: str, timeout: float = 300.0):
+        if not self._event(key).wait(timeout):
+            raise TimeoutError(f"collective '{key}' timed out "
+                               f"(world_size={self.world_size})")
+        with self._lock:
+            return self._results[key]
+
+    def reset(self):
+        with self._lock:
+            self._round.clear()
+            self._results.clear()
+            self._events.clear()
+
+
+class CollectiveGroup:
+    """Handle used by each member actor/process."""
+
+    def __init__(self, name: str, world_size: int, rank: int):
+        import ray_tpu
+
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._actor = ray_tpu.remote(_RendezvousActor).options(
+            name=f"rtpu_collective_{name}", get_if_exists=True,
+            max_concurrency=max(8, world_size * 2), num_cpus=0,
+            lifetime="detached").remote(world_size)
+        self._seq = 0
+
+    def _next_key(self, tag: str) -> str:
+        self._seq += 1
+        return f"{tag}:{self._seq}"
+
+    def _exchange(self, tag: str, value: Any, op: Optional[str]):
+        import ray_tpu
+
+        key = self._next_key(tag)
+        ray_tpu.get(self._actor.contribute.remote(key, self.rank, value, op))
+        return ray_tpu.get(self._actor.fetch.remote(key))
+
+    def allreduce(self, value: Any, op: str = "sum"):
+        return self._exchange("ar", value, op)
+
+    def allgather(self, value: Any) -> List[Any]:
+        return self._exchange("ag", value, None)
+
+    def broadcast(self, value: Any, src_rank: int = 0):
+        vals = self._exchange("bc", value if self.rank == src_rank else None, None)
+        return vals[src_rank]
+
+    def reducescatter(self, value: Any, op: str = "sum"):
+        full = self._exchange("rs", value, op)
+        return _tree_index(full, self.rank, self.world_size)
+
+    def barrier(self):
+        self._exchange("barrier", None, None)
+
+    def destroy(self):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass
+
+
+def _tree_index(x, rank: int, world: int):
+    if isinstance(x, dict):
+        return {k: _tree_index(v, rank, world) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_index(v, rank, world) for v in x)
+    arr = np.asarray(x)
+    chunk = arr.shape[0] // world
+    return arr[rank * chunk:(rank + 1) * chunk]
+
+
+_groups: Dict[str, CollectiveGroup] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    group = CollectiveGroup(group_name, world_size, rank)
+    _groups[group_name] = group
+    return group
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    if group_name not in _groups:
+        raise ValueError(f"collective group '{group_name}' not initialized")
+    return _groups[group_name]
+
+
+def allreduce(value, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(value, op)
+
+
+def allgather(value, group_name: str = "default"):
+    return get_group(group_name).allgather(value)
+
+
+def broadcast(value, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(value, src_rank)
+
+
+def reducescatter(value, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(value, op)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def destroy_collective_group(group_name: str = "default"):
+    group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()

@@ -1,0 +1,48 @@
+"""Test harness config.
+
+JAX runs on an 8-device virtual CPU platform (mirrors how the reference
+exercises multi-node logic on one machine via `cluster_utils.Cluster`); env
+must be set before the first jax import anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-scoped cluster: fast, shared across a module's tests.
+
+    Teardown only shuts down the cluster THIS fixture created: the runtime is
+    a process-global, and a late-running finalizer from another module must
+    not tear down its successor's cluster.
+    """
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    created = ray_tpu._global_runtime
+    yield
+    if ray_tpu._global_runtime is created:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def ray_start_regular():
+    """Function-scoped fresh cluster for tests that mutate cluster state."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    created = ray_tpu._global_runtime
+    yield
+    if ray_tpu._global_runtime is created:
+        ray_tpu.shutdown()

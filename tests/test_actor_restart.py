@@ -1,0 +1,39 @@
+"""Actor restart on worker death (max_restarts), isolated cluster."""
+
+import time
+
+import ray_tpu
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    pid1 = ray_tpu.get(p.pid.remote())
+    try:
+        ray_tpu.get(p.die.remote())
+    except Exception:
+        pass
+    # Wait for restart
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote())
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert pid2 is not None and pid2 != pid1

@@ -1,0 +1,52 @@
+"""ID bit-layout invariants (reference: src/ray/design_docs/id_specification.md)."""
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_job_id_roundtrip():
+    j = JobID.from_int(7)
+    assert j.int_value() == 7
+    assert JobID.from_hex(j.hex()) == j
+
+
+def test_actor_id_embeds_job():
+    j = JobID.from_int(3)
+    a = ActorID.of(j)
+    assert a.job_id() == j
+
+
+def test_task_id_embeds_actor_and_job():
+    j = JobID.from_int(9)
+    a = ActorID.of(j)
+    t = TaskID.for_actor_task(a)
+    assert t.actor_id() == a
+    assert t.job_id() == j
+    t2 = TaskID.for_task(j)
+    assert t2.job_id() == j
+
+
+def test_object_id_embeds_task():
+    j = JobID.from_int(1)
+    t = TaskID.for_task(j)
+    o = ObjectID.for_return(t, 1)
+    assert o.task_id() == t
+    assert o.job_id() == j
+    assert o.object_index() == 1
+    p = ObjectID.for_put(t, 1)
+    assert p != o
+    assert p.task_id() == t
+
+
+def test_nil_and_equality():
+    n = TaskID.nil()
+    assert n.is_nil()
+    a = TaskID.for_task(JobID.from_int(1))
+    assert a != n
+    assert len({a, a}) == 1
+
+
+def test_pickle_roundtrip():
+    import pickle
+
+    t = TaskID.for_task(JobID.from_int(5))
+    assert pickle.loads(pickle.dumps(t)) == t

@@ -1,0 +1,92 @@
+"""Shared-memory store: create/seal/get, adopt, client attach, spilling."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import (
+    ObjectStoreClient,
+    ObjectStoreFullError,
+    SharedMemoryStore,
+)
+
+
+def make_oid(i=1):
+    return ObjectID.for_return(TaskID.for_task(JobID.from_int(1)), i)
+
+
+@pytest.fixture()
+def store():
+    s = SharedMemoryStore(f"test_{np.random.randint(1 << 30)}",
+                          capacity_bytes=50 << 20, spill_dir="/tmp/rtpu_test_spill")
+    yield s
+    s.shutdown()
+
+
+def test_put_get_value(store):
+    oid = make_oid()
+    x = np.arange(100000, dtype=np.float32)
+    store.put_value(oid, {"x": x, "tag": "hello"})
+    assert store.contains(oid)
+    client = ObjectStoreClient(store._session)
+    v = client.get_value(oid)
+    assert v["tag"] == "hello"
+    np.testing.assert_array_equal(v["x"], x)
+    client.close()
+
+
+def test_missing_object(store):
+    client = ObjectStoreClient(store._session)
+    assert client.get_buffer(make_oid(42)) is None
+    client.close()
+
+
+def test_delete(store):
+    oid = make_oid()
+    store.put_value(oid, b"x" * 1000)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_capacity_and_spill(store):
+    # Fill beyond capacity: oldest unpinned objects spill to disk and
+    # restore transparently on access.
+    oids = [make_oid(i + 1) for i in range(8)]
+    data = np.zeros(1 << 20, dtype=np.uint8)  # 1 MiB each
+    small = SharedMemoryStore(store._session + "s", capacity_bytes=4 << 20,
+                              spill_dir="/tmp/rtpu_test_spill")
+    try:
+        for oid in oids:
+            small.put_value(oid, data)
+        stats = small.stats()
+        assert stats["num_spilled"] >= 4
+        # restored read
+        buf = small.get_buffer(oids[0])
+        assert buf is not None
+    finally:
+        small.shutdown()
+
+
+def test_oversize_object_rejected(store):
+    tiny = SharedMemoryStore(store._session + "t", capacity_bytes=1 << 20)
+    try:
+        with pytest.raises(ObjectStoreFullError):
+            tiny.put_value(make_oid(), np.zeros(1 << 21, dtype=np.uint8))
+    finally:
+        tiny.shutdown()
+
+
+def test_pinned_objects_not_spilled(store):
+    small = SharedMemoryStore(store._session + "p", capacity_bytes=3 << 20,
+                              spill_dir="/tmp/rtpu_test_spill")
+    try:
+        a = make_oid(1)
+        small.put_value(a, np.zeros(1 << 20, dtype=np.uint8))
+        small.pin(a)
+        for i in range(2, 5):
+            small.put_value(make_oid(i), np.zeros(1 << 20, dtype=np.uint8))
+        # pinned object is still in shm
+        entry = small._objects[a]
+        assert entry.shm is not None
+    finally:
+        small.shutdown()

@@ -1,0 +1,70 @@
+"""Serialization: pickle-5 out-of-band buffers, zero-copy, exceptions."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization
+from ray_tpu.exceptions import RayTaskError
+
+
+def roundtrip(v, zero_copy=True):
+    blob = serialization.serialize_to_bytes(v)
+    return serialization.deserialize(blob, zero_copy=zero_copy)
+
+
+def test_scalars_and_containers():
+    for v in [1, 2.5, "hi", b"raw", None, True, [1, 2], {"a": (1, 2)}, {1, 2}]:
+        assert roundtrip(v) == v
+
+
+def test_numpy_out_of_band():
+    x = np.arange(10000, dtype=np.float64).reshape(100, 100)
+    y = roundtrip(x)
+    np.testing.assert_array_equal(x, y)
+    parts = serialization.serialize(x)
+    # large array must travel out-of-band, not in the pickle stream
+    assert serialization.serialized_size(parts) < x.nbytes + 2000
+    assert any(isinstance(p, memoryview) and p.nbytes == x.nbytes for p in parts)
+
+
+def test_zero_copy_view():
+    x = np.arange(1000, dtype=np.int64)
+    blob = serialization.serialize_to_bytes(x)
+    view = memoryview(blob)
+    y = serialization.deserialize(view, zero_copy=True)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_mixed_structure():
+    v = {"weights": np.ones((64, 64), dtype=np.float32), "step": 3,
+         "names": ["a", "b"]}
+    out = roundtrip(v)
+    assert out["step"] == 3
+    np.testing.assert_array_equal(out["weights"], v["weights"])
+
+
+def test_exception_roundtrip():
+    try:
+        raise ValueError("kaboom")
+    except ValueError as e:
+        blob = serialization.serialize_exception(e, "myfn")
+    err = serialization.deserialize_exception(blob)
+    assert isinstance(err, RayTaskError)
+    assert "kaboom" in err.traceback_str
+    typed = err.as_instanceof_cause()
+    assert isinstance(typed, ValueError)
+    with pytest.raises(ValueError):
+        raise typed
+
+
+def test_unpicklable_exception_fallback():
+    class Weird(Exception):
+        def __reduce__(self):
+            raise TypeError("cannot pickle me")
+
+    try:
+        raise Weird("odd")
+    except Weird as e:
+        blob = serialization.serialize_exception(e, "f")
+    err = serialization.deserialize_exception(blob)
+    assert isinstance(err, RayTaskError)
