@@ -1,0 +1,6 @@
+"""Model zoo: flax models with logical-axis sharding annotations."""
+
+from ray_tpu.models.gpt2 import GPT2, GPT2Config
+from ray_tpu.models.mlp import MLP
+
+__all__ = ["GPT2", "GPT2Config", "MLP"]

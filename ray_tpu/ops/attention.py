@@ -1,0 +1,175 @@
+"""Flash attention: Pallas TPU kernel with online softmax.
+
+Net-new TPU capability (the reference has no kernel code — SURVEY.md §5.7):
+a blocked attention forward that never materializes the S x S score matrix.
+Blocks of Q sit in VMEM while K/V blocks stream through the innermost grid
+dimension with running (max, denominator, accumulator) statistics; causal
+blocks above the diagonal are skipped entirely.
+
+Training uses a custom VJP whose backward recomputes attention under XLA
+(flash-style backward kernel lands later; the forward is the inference and
+benchmark hot path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_STATS_LANES = 128  # TPU lane width: stats scratch is (block_q, 128)
+
+
+def mha_reference(q, k, v, causal: bool = True,
+                  scale: Optional[float] = None) -> jax.Array:
+    """XLA reference attention. q,k,v: [batch, heads, seq, head_dim]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qs, ks = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((qs, ks), dtype=bool), k=ks - qs)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)              # [bq, d]
+        k = k_ref[0].astype(jnp.float32)              # [bk, d]
+        v = v_ref[0].astype(jnp.float32)              # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                         # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        correction = jnp.exp(m_prev - m_new)          # [bq, 1]
+        l_new = correction * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Skip blocks entirely above the diagonal.
+        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        def _run():
+            body()
+    else:
+        body()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float,
+                   block_q: int, block_k: int) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    bh = batch * heads
+    q3 = q.reshape(bh, seq_q, d)
+    k3 = k.reshape(bh, seq_k, d)
+    v3 = v.reshape(bh, seq_k, d)
+    nq = pl.cdiv(seq_q, block_q)
+    nk = pl.cdiv(seq_k, block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q3, k3, v3)
+    return out.reshape(batch, heads, seq_q, d)
+
+
+def _use_pallas(q, block_q: int, block_k: int) -> bool:
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else \
+            jax.devices()[0].platform
+    except Exception:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return False
+    _, _, seq, d = q.shape
+    return seq % block_q == 0 and seq % block_k == 0 and d % 64 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512) -> jax.Array:
+    """Blocked attention. q,k,v: [batch, heads, seq, head_dim].
+
+    Dispatches to the Pallas kernel on TPU (shapes permitting) and the XLA
+    reference elsewhere. Differentiable: backward recomputes via XLA.
+    """
+    return _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
+
+
+def _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    seq = q.shape[2]
+    bq, bk = min(block_q, seq), min(block_k, seq)
+    if _use_pallas(q, bq, bk):
+        return _flash_forward(q, k, v, causal, scale, bq, bk)
+    return mha_reference(q, k, v, causal=causal, scale=scale)
+
+
+def _attn_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _attn_bwd(causal, scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_attn_fwd, _attn_bwd)

@@ -1,0 +1,107 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+Net-new capability vs the reference (no sequence parallelism anywhere in it —
+SURVEY.md §5.7). Each device holds a sequence shard of Q/K/V; K/V shards
+rotate around the ring via `jax.lax.ppermute` (compiled to ICI neighbor
+transfers) while each device folds every K/V chunk into its local Q's online
+softmax statistics. Peak memory is O(S/sp * S/sp) per step instead of O(S^2),
+and the rotation overlaps with compute under XLA's async collectives.
+
+Use inside shard_map/pjit with `q,k,v` sharded over `axis_name` on the
+sequence dimension (logical axis "seq" -> mesh axis "sp").
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _chunk_attend(q, k, v, q_offset, k_offset, causal: bool, scale: float):
+    """Scores of local q against one k/v chunk with global-position masking.
+    Returns (m, l, acc) partial statistics. Shapes: q [b,h,sq,d], k/v [b,h,sk,d].
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                      # [b,h,sq,1]
+    # Guard fully-masked rows (all -inf): exp(-inf - -inf) -> use safe m.
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_safe, l, acc
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Attention over a ring of sequence shards.
+
+    Must run inside a mapped context (shard_map / pjit-manual) where
+    `axis_name` is a mesh axis and q/k/v carry this device's sequence shard:
+    [batch, heads, seq_shard, head_dim].
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    ring_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    seq_shard = q.shape[2]
+    q_offset = my_idx * seq_shard
+
+    m0 = jnp.full(q.shape[:3] + (1,), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros(q.shape[:3] + (1,), dtype=jnp.float32)
+    acc0 = jnp.zeros(q.shape, dtype=jnp.float32)
+
+    def step(i, carry):
+        m, l, acc, kv = carry
+        k_cur, v_cur = kv
+        # Chunk j currently held = (my_idx - i) mod ring  (kv rotates +1).
+        src_idx = (my_idx - i) % ring_size
+        k_offset = src_idx * seq_shard
+        m_c, l_c, acc_c = _chunk_attend(q, k_cur, v_cur, q_offset, k_offset,
+                                        causal, scale)
+        m_new = jnp.maximum(m, m_c)
+        corr_prev = jnp.exp(m - m_new)
+        corr_c = jnp.exp(m_c - m_new)
+        l_new = l * corr_prev + l_c * corr_c
+        acc_new = acc * corr_prev + acc_c * corr_c
+        perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, (k_next, v_next)
+
+    m, l, acc, _ = jax.lax.fori_loop(0, ring_size, step, (m0, l0, acc0, (k, v)))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
+                           scale: Optional[float] = None,
+                           sp_axis: str = "sp") -> jax.Array:
+    """Convenience wrapper: shard_map ring_attention over the mesh's sp axis.
+
+    q,k,v: global [batch, heads, seq, head_dim] arrays (sharded or not);
+    output matches the input sharding convention (seq over sp).
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    if sp_axis not in mesh.axis_names or mesh.shape[sp_axis] == 1:
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal, scale)
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    spec = P(data_axes, None, sp_axis, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=sp_axis, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

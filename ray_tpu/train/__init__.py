@@ -1,0 +1,42 @@
+"""ray_tpu.train: multi-worker training harness (reference: Ray Train + AIR).
+
+The north-star path: `JaxTrainer.fit()` places one JAX process per TPU host,
+forms the process group (`jax.distributed`), builds the mesh, and runs the
+user's SPMD loop with `session.report` streaming metrics/checkpoints back.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_mesh,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
+
+__all__ = [
+    "Backend", "BackendConfig", "JaxBackend", "JaxConfig", "BackendExecutor",
+    "TrainingFailedError", "Checkpoint", "CheckpointManager",
+    "CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig",
+    "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "get_mesh", "get_world_rank", "get_world_size", "BaseTrainer",
+    "DataParallelTrainer", "JaxTrainer", "Result", "TrainWorker",
+    "WorkerGroup",
+]

@@ -1,0 +1,122 @@
+"""Training backends: the multi-host process-group seam.
+
+Equivalent of the reference's `Backend.on_start` (`python/ray/train/backend.py:53`)
+whose Torch implementation runs `dist.init_process_group` over NCCL
+(`torch/config.py:69-113`). The TPU-native JaxBackend instead does
+coordinator election + `jax.distributed.initialize` + mesh construction —
+after which collectives live inside XLA programs (SURVEY.md §3.4 step 3).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class BackendConfig:
+    backend_name: str = "none"
+
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """No-op backend: workers run independently (pure data-parallel via
+    host-level collectives, or single-worker)."""
+
+    def on_start(self, worker_group, backend_config: "BackendConfig"):
+        pass
+
+    def on_training_start(self, worker_group, backend_config: "BackendConfig"):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: "BackendConfig"):
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Configuration for the JAX/TPU backend.
+
+    mesh: logical mesh laid over the job's global device set.
+    force_platform: override jax platform inside workers ("cpu" for tests).
+    coordinator_port: fixed port for jax.distributed (0 = auto).
+    """
+
+    backend_name: str = "jax"
+    mesh: Optional[MeshSpec] = None
+    force_platform: Optional[str] = None
+    coordinator_port: int = 0
+    distributed: Optional[bool] = None  # None = auto (world_size > 1)
+
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _set_platform(platform: str):
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    return True
+
+
+def _init_jax_distributed(coordinator: str, world: int, rank: int):
+    from ray_tpu.parallel.distributed import initialize_distributed
+
+    initialize_distributed(coordinator, world, rank)
+    return True
+
+
+def _mesh_builder_for(spec: Optional[MeshSpec]):
+    if spec is None:
+        return None
+
+    def build():
+        from ray_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(spec)
+
+    return build
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig):
+        world = len(worker_group)
+        if backend_config.force_platform:
+            worker_group.execute(_set_platform, backend_config.force_platform)
+        distributed = backend_config.distributed
+        if distributed is None:
+            distributed = world > 1
+        if distributed and world > 1:
+            from ray_tpu.parallel.distributed import get_address_and_port
+
+            host, port = worker_group.execute_single(0, get_address_and_port)
+            if backend_config.coordinator_port:
+                port = backend_config.coordinator_port
+            coordinator = f"{host}:{port}"
+            logger.info("forming JAX process group: %d procs via %s",
+                        world, coordinator)
+            # All ranks must call initialize concurrently (rank 0 hosts the
+            # coordination service).
+            import ray_tpu
+
+            refs = [w.execute.remote(_init_jax_distributed, coordinator, world, rank)
+                    for rank, w in enumerate(worker_group.workers)]
+            ray_tpu.get(refs)
+
+    def mesh_builder(self, backend_config: JaxConfig):
+        return _mesh_builder_for(backend_config.mesh)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig):
+        from ray_tpu.parallel.distributed import shutdown_distributed
+
+        try:
+            worker_group.execute(shutdown_distributed)
+        except Exception:
+            pass

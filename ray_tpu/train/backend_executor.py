@@ -1,0 +1,109 @@
+"""BackendExecutor: worker-group lifecycle + result streaming.
+
+Equivalent of the reference's `python/ray/train/_internal/backend_executor.py:43`
+(`start` :94, `start_training` :332): starts the WorkerGroup, runs the
+backend's process-group setup, launches the per-worker loop, and streams
+reported results back; whole-group restart on failure (FailureConfig).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.exceptions import RayActorError
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig,
+                 max_failures: int = 0):
+        self.backend_config = backend_config
+        self.backend: Backend = backend_config.backend_cls()()
+        self.scaling_config = scaling_config
+        self.max_failures = max_failures
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        sc = self.scaling_config
+        self.worker_group = WorkerGroup(
+            num_workers=sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_strategy=sc.placement_strategy,
+            use_placement_group=sc.num_workers > 1,
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def run(self, train_fn: Callable, config: Dict[str, Any],
+            checkpoint=None, datasets_per_worker: Optional[List[Dict]] = None,
+            experiment_name: str = "") -> Iterator[List[Dict[str, Any]]]:
+        """Generator: yields one list of per-worker results per report round;
+        returns when all workers finish. Restarts the whole group on worker
+        failure, up to max_failures (reference semantics — no partial
+        elasticity: ICI slice membership is static, SURVEY.md §7)."""
+        failures = 0
+        while True:
+            try:
+                yield from self._run_once(train_fn, config, checkpoint,
+                                          datasets_per_worker, experiment_name)
+                return
+            except (RayActorError, TrainingFailedError):
+                failures += 1
+                if failures > self.max_failures:
+                    raise
+                logger.warning("worker group failed; restart %d/%d",
+                               failures, self.max_failures)
+                self.shutdown()
+                self.start()
+
+    def _run_once(self, train_fn, config, checkpoint, datasets_per_worker,
+                  experiment_name):
+        wg = self.worker_group
+        mesh_builder = None
+        if hasattr(self.backend, "mesh_builder"):
+            mesh_builder = self.backend.mesh_builder(self.backend_config)
+        self.backend.on_training_start(wg, self.backend_config)
+        start_refs = []
+        for i, w in enumerate(wg.workers):
+            ds = datasets_per_worker[i] if datasets_per_worker else None
+            start_refs.append(w.start_training.remote(
+                train_fn, config, checkpoint, mesh_builder, ds, experiment_name))
+        ray_tpu.get(start_refs)
+        done = [False] * len(wg.workers)
+        while not all(done):
+            refs = [w.next_result.remote()
+                    for w, d in zip(wg.workers, done) if not d]
+            alive = [i for i, d in enumerate(done) if not d]
+            results = ray_tpu.get(refs)
+            round_results: List[Dict[str, Any]] = []
+            for idx, res in zip(alive, results):
+                if res.get("done"):
+                    done[idx] = True
+                    if res.get("error") is not None:
+                        err = serialization.deserialize_exception(res["error"])
+                        raise TrainingFailedError(
+                            f"worker {idx} train loop failed") from err
+                else:
+                    round_results.append({"rank": idx, **res})
+            if round_results:
+                yield round_results
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,178 @@
+"""Checkpoint: the interchange unit between Train/Tune/RLlib/Serve.
+
+Equivalent of the reference's AIR `Checkpoint` (`python/ray/air/checkpoint.py:65`
+— morphs dict <-> directory <-> URI). TPU-native addition: pytree payloads are
+stored via Orbax (`save_pytree`/`restore_pytree`) so sharded jax.Arrays
+checkpoint without host-gathering the whole model on one process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+_DICT_BLOB = "_ckpt_dict.pkl"
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("Checkpoint needs exactly one of data or path")
+        self._data = data
+        self._path = path
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=os.path.abspath(path))
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        if uri.startswith("file://"):
+            return cls.from_directory(uri[len("file://"):])
+        raise NotImplementedError(
+            f"Only file:// URIs are supported without cloud deps ({uri})")
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        save_pytree(os.path.join(path, "pytree"), tree)
+        return cls.from_directory(path)
+
+    # -- views ---------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        blob = os.path.join(self._path, _DICT_BLOB)
+        if os.path.exists(blob):
+            with open(blob, "rb") as f:
+                return pickle.load(f)
+        raise ValueError(
+            f"Directory checkpoint at {self._path} has no dict payload; "
+            "use to_directory()/get_pytree()")
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._data is not None:
+            with open(os.path.join(path, _DICT_BLOB), "wb") as f:
+                pickle.dump(self._data, f)
+        elif os.path.abspath(self._path) != os.path.abspath(path):
+            shutil.copytree(self._path, path, dirs_exist_ok=True)
+        return path
+
+    def get_pytree(self, target: Any = None) -> Any:
+        assert self._path, "pytree checkpoints are directory-backed"
+        return restore_pytree(os.path.join(self._path, "pytree"), target)
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._path}"
+        return f"Checkpoint({kind})"
+
+    def __reduce__(self):
+        # Dict checkpoints travel by value; directory checkpoints by path
+        # (the path must be reachable by the receiver — same host or shared fs).
+        return (Checkpoint, (self._data, self._path))
+
+
+# --------------------------------------------------------------------------- #
+# Orbax-backed pytree persistence (sharded-array aware)
+# --------------------------------------------------------------------------- #
+
+
+def save_pytree(path: str, tree: Any):
+    """Save a jax pytree with orbax; falls back to pickle for plain trees."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), tree, force=True)
+    except Exception:
+        with open(path + ".pkl", "wb") as f:
+            pickle.dump(tree, f)
+
+
+def restore_pytree(path: str, target: Any = None) -> Any:
+    pkl = path + ".pkl"
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is not None:
+        return ckptr.restore(os.path.abspath(path), item=target)
+    return ckptr.restore(os.path.abspath(path))
+
+
+# --------------------------------------------------------------------------- #
+# Keep-N checkpoint bookkeeping
+# --------------------------------------------------------------------------- #
+
+
+class CheckpointManager:
+    """Tracks reported checkpoints; keeps best-N by a score attribute
+    (reference `air/_internal/checkpoint_manager.py`)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries = []  # list of (score, index, path, metrics)
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> str:
+        self._index += 1
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        checkpoint.to_directory(dest)
+        with open(os.path.join(dest, "metrics.json"), "w") as f:
+            json.dump({k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))}, f)
+        score = metrics.get(self.score_attribute, self._index) \
+            if self.score_attribute else self._index
+        self._entries.append((score, self._index, dest, metrics))
+        self._evict()
+        return dest
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        reverse = self.score_order == "max"
+        ranked = sorted(self._entries, key=lambda e: e[0], reverse=reverse)
+        keep = ranked[: self.num_to_keep]
+        for entry in self._entries:
+            if entry not in keep:
+                shutil.rmtree(entry[2], ignore_errors=True)
+        self._entries = [e for e in self._entries if e in keep]
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        reverse = self.score_order == "max"
+        best = sorted(self._entries, key=lambda e: e[0], reverse=reverse)[0]
+        return Checkpoint.from_directory(best[2])
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint.from_directory(max(self._entries, key=lambda e: e[1])[2])

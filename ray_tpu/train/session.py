@@ -1,0 +1,118 @@
+"""Per-worker training session: report(), ranks, dataset shards, the mesh.
+
+Equivalent of the reference's `session.report`/`get_dataset_shard`
+(`python/ray/air/session.py:43,359`) + `_TrainSession`
+(`python/ray/train/_internal/session.py:63`). TPU addition: `get_mesh()`
+hands the worker its slice-wide `jax.sharding.Mesh` built by the JaxBackend.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int = 0,
+                 local_world_size: int = 1, node_rank: int = 0,
+                 trial_name: str = "", experiment_name: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.trial_name = trial_name
+        self.experiment_name = experiment_name
+
+
+class _TrainSession:
+    """Lives inside each training worker while the user loop runs."""
+
+    def __init__(self, context: TrainContext,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 checkpoint: Optional[Checkpoint] = None,
+                 mesh=None):
+        self.context = context
+        self.datasets = datasets or {}
+        self.loaded_checkpoint = checkpoint
+        self.mesh = mesh
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.final_return: Any = None
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def get_dataset_shard(self, name: str = "train"):
+        ds = self.datasets.get(name)
+        if ds is None:
+            return None
+        # ray_tpu.data DataIterator shards are pre-split by the trainer;
+        # plain iterables pass through.
+        return ds
+
+
+_session: Optional[_TrainSession] = None
+_session_lock = threading.Lock()
+
+
+def init_session(session: _TrainSession):
+    global _session
+    with _session_lock:
+        _session = session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active: session APIs are only usable inside "
+            "a train_loop_per_worker launched by a Trainer.")
+    return _session
+
+
+# Public functional API ------------------------------------------------------
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().loaded_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+def get_context() -> TrainContext:
+    return get_session().context
+
+
+def get_world_rank() -> int:
+    return get_session().context.world_rank
+
+
+def get_world_size() -> int:
+    return get_session().context.world_size
+
+
+def get_local_rank() -> int:
+    return get_session().context.local_rank
+
+
+def get_mesh():
+    """The slice-wide jax.sharding.Mesh assembled by the backend (None when
+    the trainer was configured without one)."""
+    return get_session().mesh

@@ -1,0 +1,181 @@
+"""WorkerGroup: N training-worker actors, placement-grouped.
+
+Equivalent of the reference's `python/ray/train/_internal/worker_group.py:100`.
+Workers are generic function-executor actors; the JaxBackend and the training
+loop both run through `execute*`. TPU workers are placed one per host via a
+STRICT_SPREAD placement group (ScalingConfig.topology).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.session import (
+    TrainContext,
+    _TrainSession,
+    init_session,
+    shutdown_session,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor hosting one training process (one JAX process per TPU host)."""
+
+    def __init__(self, rank: int, world_size: int, env: Optional[Dict[str, str]] = None):
+        self.rank = rank
+        self.world_size = world_size
+        if env:
+            os.environ.update(env)
+        self._session: Optional[_TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def node_info(self):
+        import socket
+
+        return {"hostname": socket.gethostname(), "pid": os.getpid(),
+                "rank": self.rank}
+
+    # -- training lifecycle --------------------------------------------------
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       checkpoint=None, mesh_builder: Optional[Callable] = None,
+                       datasets: Optional[Dict[str, Any]] = None,
+                       experiment_name: str = ""):
+        assert self._thread is None or not self._thread.is_alive(), \
+            "training already running"
+        mesh = mesh_builder() if mesh_builder is not None else None
+        context = TrainContext(world_rank=self.rank, world_size=self.world_size,
+                               experiment_name=experiment_name)
+        session = _TrainSession(context, datasets=datasets, checkpoint=checkpoint,
+                                mesh=mesh)
+        self._session = session
+        init_session(session)
+
+        def run():
+            try:
+                import inspect
+
+                if config and len(inspect.signature(train_fn).parameters) > 0:
+                    session.final_return = train_fn(config)
+                elif len(inspect.signature(train_fn).parameters) > 0:
+                    session.final_return = train_fn({})
+                else:
+                    session.final_return = train_fn()
+            except BaseException as e:  # noqa: BLE001
+                session.error = e
+                logger.exception("train loop failed on rank %d", self.rank)
+            finally:
+                session.finished.set()
+
+        self._thread = threading.Thread(target=run, name="train-loop", daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout: float = 3600.0):
+        """Block until the next session.report() or loop completion."""
+        import queue as _q
+
+        session = self._session
+        assert session is not None, "training not started"
+        while True:
+            try:
+                item = session.result_queue.get(timeout=0.1)
+                return {"done": False, **item}
+            except _q.Empty:
+                if session.finished.is_set() and session.result_queue.empty():
+                    if session.error is not None:
+                        from ray_tpu.core import serialization
+
+                        return {"done": True,
+                                "error": serialization.serialize_exception(
+                                    session.error, "train_loop_per_worker")}
+                    return {"done": True, "final": session.final_return}
+                timeout -= 0.1
+                if timeout <= 0:
+                    return {"done": False, "timeout": True}
+
+    def finish(self):
+        shutdown_session()
+        self._session = None
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 placement_strategy: str = "PACK",
+                 use_placement_group: bool = True):
+        self.num_workers = num_workers
+        resources = dict(resources_per_worker or {"CPU": 1.0})
+        self._pg = None
+        actor_cls = ray_tpu.remote(TrainWorker)
+        options: Dict[str, Any] = {}
+        num_cpus = resources.pop("CPU", 1.0)
+        num_tpus = resources.pop("TPU", 0)
+        if use_placement_group and num_workers > 1:
+            from ray_tpu.util.placement_group import placement_group
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            bundle = {"CPU": num_cpus}
+            if num_tpus:
+                bundle["TPU"] = num_tpus
+            bundle.update(resources)
+            self._pg = placement_group([dict(bundle)] * num_workers,
+                                       strategy=placement_strategy)
+            self._pg.ready(timeout=120)
+        self.workers = []
+        for rank in range(num_workers):
+            opts = dict(options)
+            opts["num_cpus"] = num_cpus
+            if num_tpus:
+                opts["num_tpus"] = num_tpus
+            if resources:
+                opts["resources"] = dict(resources)
+            if self._pg is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy,
+                )
+
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    self._pg, placement_group_bundle_index=rank)
+            self.workers.append(
+                actor_cls.options(**opts).remote(rank, num_workers))
+
+    def __len__(self):
+        return self.num_workers
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)
+                            for w in self.workers])
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+        self.workers = []
