@@ -133,17 +133,41 @@ class ActorClass:
                 pass
         if self._class_blob is None:
             self._class_blob = serialization.dumps(self._cls)
+        # Reference semantics: an actor with no explicit resource request
+        # needs 1 CPU to schedule its creation task but holds 0 for its
+        # lifetime (placement vs lifetime resources).
+        explicit = any(opts.get(k) is not None for k in
+                       ("num_cpus", "num_gpus", "num_tpus", "memory", "resources"))
         resources = normalize_resources(
             num_cpus=opts.get("num_cpus"),
             num_gpus=opts.get("num_gpus"),
             num_tpus=opts.get("num_tpus"),
             memory=opts.get("memory"),
             resources=opts.get("resources"),
-            default_cpus=1.0,
+            default_cpus=0.0,
         )
         from ray_tpu.remote_function import _resolve_pg_strategy
 
         resources, strategy, pg_id, bundle_idx = _resolve_pg_strategy(opts, resources)
+        # Placement must be computed AFTER PG rewriting so the creation task
+        # requests the bundle-formatted resource names, not raw CPU the
+        # placement group already absorbed.
+        if pg_id is not None:
+            placement_resources = dict(resources)
+            if not explicit:
+                # Default-resource actor in a PG: admission-control against
+                # the bundle so N such actors can't all land concurrently on
+                # a saturated bundle (mirror of the non-PG 1-CPU default).
+                strategy_obj = opts.get("scheduling_strategy")
+                pg = strategy_obj.placement_group
+                idx = strategy_obj.placement_group_bundle_index
+                bundle = pg.bundles[idx] if idx >= 0 else {}
+                if bundle:
+                    r, amt = next(iter(bundle.items()))
+                    placement_resources = {
+                        f"{r}_group_{idx}_{pg.id.hex()}": min(1.0, amt)}
+        else:
+            placement_resources = dict(resources) if explicit else {"CPU": 1.0}
         ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
         actor_id = ActorID.of(runtime.job_id)
         spec = TaskSpec(
@@ -156,6 +180,7 @@ class ActorClass:
             kwargs_keys=kwargs_keys,
             num_returns=0,
             resources=resources,
+            placement_resources=placement_resources,
             actor_id=actor_id,
             actor_creation=True,
             actor_class_blob=self._class_blob,

@@ -84,6 +84,10 @@ class TaskSpec:
     kwargs_keys: List[str] = field(default_factory=list)  # last len(kwargs_keys) args are kwargs
     num_returns: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
+    # Actor creation: resources required to *schedule* the creation task
+    # (reference: PlacementResources — default-CPU actors need 1 CPU to be
+    # placed but 0 for their lifetime, so idle actors don't pin cores).
+    placement_resources: Optional[Dict[str, float]] = None
     max_retries: int = 0
     retry_exceptions: bool = False
     # Actor fields

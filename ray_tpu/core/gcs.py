@@ -495,7 +495,8 @@ class GcsServer:
                 if info.state != "ALIVE":
                     continue
                 avail = info.resources_available
-                if all(avail.get(r, 0.0) >= amt for r, amt in spec.resources.items()):
+                need = getattr(spec, "placement_resources", None) or spec.resources
+                if all(avail.get(r, 0.0) >= amt for r, amt in need.items()):
                     candidates.append(info)
             if isinstance(strategy, NodeAffinitySchedulingStrategy):
                 target = next((c for c in candidates

@@ -110,6 +110,9 @@ class WorkerHandle:
     last_idle: float = field(default_factory=time.monotonic)
     # env granted at spawn (e.g. TPU chip visibility)
     granted_env: Dict[str, str] = field(default_factory=dict)
+    # Resources held for this worker's lifetime (actor workers hold their
+    # creation-task resources until death, like the reference's leases).
+    held_resources: Dict[str, float] = field(default_factory=dict)
 
 
 class WorkerPool:
@@ -178,13 +181,34 @@ class WorkerPool:
             self.consecutive_startup_failures = 0
             return handle
 
-    def pop_idle(self) -> Optional[WorkerHandle]:
+    def pop_idle(self, required_env: Optional[Dict[str, str]] = None
+                 ) -> Optional[WorkerHandle]:
+        """Lease an idle worker whose granted env matches the task's
+        requirement (reference worker_pool lease matching): a TPU task must
+        run in a worker started with the TPU grant env, and a TPU-granted
+        worker must not serve plain CPU tasks."""
+        want = required_env or {}
         with self._lock:
             for h in self._workers.values():
-                if h.state == "idle" and not h.is_actor:
+                if h.state == "idle" and not h.is_actor and h.granted_env == want:
                     h.state = "busy"
                     return h
             return None
+
+    def pop_idle_mismatched(self, want: Dict[str, str]) -> Optional[WorkerHandle]:
+        """Longest-idle worker whose granted env does NOT match `want` —
+        retired by the dispatcher when the pool is at capacity but no
+        env-compatible worker exists (prevents a wedged pool of idle
+        workers none of which can serve the queued task)."""
+        with self._lock:
+            candidates = [h for h in self._workers.values()
+                          if h.state == "idle" and not h.is_actor
+                          and h.granted_env != want]
+            if not candidates:
+                return None
+            h = min(candidates, key=lambda x: x.last_idle)
+            h.state = "busy"  # reserve so nothing else grabs it
+            return h
 
     def push_idle(self, handle: WorkerHandle):
         with self._lock:
@@ -510,7 +534,8 @@ class Raylet:
                 if not self.resources.try_acquire(qt.spec.resources):
                     return  # FIFO head-of-line; resources busy
                 del self._queue[ready_idx]
-            worker = self.pool.pop_idle()
+            env = self._env_for(qt.spec)
+            worker = self.pool.pop_idle(env)
             if worker is None:
                 # Throttle concurrent spawns: Python worker startup is CPU
                 # bound (~2s of imports); parallel cold starts convoy on small
@@ -519,7 +544,18 @@ class Raylet:
                 if (self.pool.num_starting() < self._spawn_parallelism
                         and self.pool.num_alive() < self.pool.max_workers
                         and self.pool.spawn_allowed()):
-                    self.pool.spawn_worker(env_extra=self._env_for(qt.spec))
+                    self.pool.spawn_worker(env_extra=env)
+                elif self.pool.num_alive() >= self.pool.max_workers:
+                    # Pool full of env-incompatible workers: retire one so a
+                    # compatible worker can be spawned on the next pass.
+                    stale = self.pool.pop_idle_mismatched(env)
+                    if stale is not None:
+                        self._on_worker_dead(stale, "retired (env mismatch)")
+                        if stale.proc is not None and stale.proc.poll() is None:
+                            try:
+                                stale.proc.terminate()
+                            except Exception:
+                                pass
                 # keep resources held? No: release and retry when a worker registers.
                 self.resources.release(qt.spec.resources)
                 with self._lock:
@@ -569,12 +605,23 @@ class Raylet:
         if entry is None:
             return {}
         spec, worker = entry
-        # Resource release (handle partial release from blocked state)
-        res = dict(spec.resources)
+        # Resource release (handle partial release from blocked state).
+        acquired = self._acquired_resources(spec)
         if released:
             for r, amt in released.items():
-                res[r] = res.get(r, 0) - amt
-        self.resources.release({r: a for r, a in res.items() if a > 0})
+                acquired[r] = acquired.get(r, 0) - amt
+        remaining = {r: a for r, a in acquired.items() if a > 0}
+        if spec.actor_creation and error_blob is None:
+            # The actor's *lifetime* resources stay held until death/kill
+            # (reference: the lease stays acquired); the placement-only
+            # surplus (default 1 CPU used to schedule creation) is returned.
+            lifetime = {r: a for r, a in spec.resources.items() if a > 0}
+            worker.held_resources = lifetime
+            surplus = {r: a - lifetime.get(r, 0.0) for r, a in remaining.items()
+                       if a - lifetime.get(r, 0.0) > 0}
+            self.resources.release(surplus)
+        else:
+            self.resources.release(remaining)
         self._register_results(spec, results)
         if submitter is not None and submitter.alive:
             try:
@@ -629,6 +676,14 @@ class Raylet:
         self._on_object_local(oid)
         return {}
 
+    @staticmethod
+    def _acquired_resources(spec: TaskSpec) -> Dict[str, float]:
+        """What the raylet actually acquired for this task (actor creation
+        acquires placement_resources, everything else spec.resources)."""
+        if spec.actor_creation:
+            return dict(spec.placement_resources or spec.resources)
+        return dict(spec.resources)
+
     def handle_worker_blocked(self, conn: Connection, data: Dict[str, Any]):
         """Worker blocked in get(): release its CPU so others can run
         (reference: raylet marks the lease as blocked and can start more)."""
@@ -636,7 +691,7 @@ class Raylet:
         if handle is None or handle.current_task is None:
             return {}
         spec = handle.current_task
-        cpus = spec.resources.get(CPU, 0)
+        cpus = self._acquired_resources(spec).get(CPU, 0)
         if cpus:
             with self._lock:
                 self._released_cpu[spec.task_id.binary()] = {CPU: cpus}
@@ -658,10 +713,17 @@ class Raylet:
                     self.resources.available[r] = self.resources.available.get(r, 0) - amt
         return {}
 
+    def _release_held_resources(self, handle: WorkerHandle):
+        """Release lifetime-held (actor) resources exactly once per worker."""
+        held, handle.held_resources = handle.held_resources, {}
+        if held:
+            self.resources.release(held)
+
     def _on_worker_dead(self, handle: WorkerHandle, reason: str):
         handle = self.pool.mark_dead(handle.worker_id)
         if handle is None:
             return
+        self._release_held_resources(handle)
         logger.warning("worker %s (pid %s) died: %s", handle.worker_id.hex()[:12],
                        handle.pid, reason)
         spec = handle.current_task
@@ -671,7 +733,7 @@ class Raylet:
                 self._running.pop(task_id_b, None)
                 submitter = self._task_submitters.pop(task_id_b, None)
                 released = self._released_cpu.pop(task_id_b, None)
-            res = dict(spec.resources)
+            res = self._acquired_resources(spec)
             if released:  # worker was blocked in get(): CPU already released
                 for r, amt in released.items():
                     res[r] = res.get(r, 0) - amt
@@ -723,14 +785,13 @@ class Raylet:
         """GCS asks this node to host an actor (reference
         `GcsActorScheduler::LeaseWorkerFromNode`)."""
         spec: TaskSpec = data["spec"]
-        if not self.resources.try_acquire(spec.resources):
+        placement = spec.placement_resources or spec.resources
+        if not self.resources.try_acquire(placement):
             return {"status": "retry"}
         env = self._env_for(spec)
-        worker = None
-        if not env:
-            # Reuse an idle pooled worker as the actor host (reference
-            # worker_pool.h lease matching) — saves a cold start.
-            worker = self.pool.pop_idle()
+        # Reuse an idle pooled worker whose granted env matches (reference
+        # worker_pool.h lease matching) — saves a cold start.
+        worker = self.pool.pop_idle(env)
         if worker is None:
             worker = self.pool.spawn_worker(env_extra=env)
         worker.is_actor = True
@@ -741,14 +802,14 @@ class Raylet:
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0
         while worker.conn is None and time.monotonic() < deadline:
             if worker.proc.poll() is not None:
-                self.resources.release(spec.resources)
+                self.resources.release(placement)
                 self._pending_actor_creates.pop(spec.actor_id, None)
                 return {"status": "error",
                         "error": f"actor worker exited at startup "
                                  f"(code {worker.proc.returncode})"}
             time.sleep(0.01)
         if worker.conn is None:
-            self.resources.release(spec.resources)
+            self.resources.release(placement)
             self._pending_actor_creates.pop(spec.actor_id, None)
             return {"status": "error", "error": "actor worker failed to register"}
         worker.state = "busy"
@@ -785,7 +846,8 @@ class Raylet:
             # restartable kill must still report actor_died so the GCS
             # drives the RESTARTING transition.
             handle.is_actor = False
-        self.pool.mark_dead(handle.worker_id)
+        if self.pool.mark_dead(handle.worker_id) is not None:
+            self._release_held_resources(handle)
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()

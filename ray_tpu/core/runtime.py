@@ -150,6 +150,7 @@ class CoreRuntime:
         self._actor_events: Dict[bytes, threading.Event] = defaultdict(threading.Event)
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
         self._free_buffer: List[ObjectID] = []
+        self._free_timer: Optional[threading.Timer] = None
         # Owner-side reference counting (reference `reference_count.h`):
         # local ObjectRef count per object + pins while submitted tasks
         # depend on the object; frees are deferred until both drop to zero.
@@ -629,21 +630,38 @@ class CoreRuntime:
             self.free_ref(dep)
 
     def free_ref(self, oid: ObjectID):
-        """Owner dropped its last reference; batch-free in the directory."""
+        """Owner dropped its last reference; batch-free in the directory.
+
+        Flushes at 100 ids or after 1s (timer), so drivers freeing fewer
+        than 100 objects still release GCS directory entries promptly.
+        """
         if self._closed:
             return
         with self._lock:
             self._free_buffer.append(oid)
             flush = len(self._free_buffer) >= 100
-            if flush:
-                batch, self._free_buffer = self._free_buffer, []
+            if not flush and self._free_timer is None:
+                self._free_timer = threading.Timer(1.0, self._flush_free_buffer)
+                self._free_timer.daemon = True
+                self._free_timer.start()
         if flush:
-            try:
-                self.gcs.call("free_objects", {"object_ids": batch}, timeout=5)
-            except Exception:
-                pass
+            self._flush_free_buffer()
+
+    def _flush_free_buffer(self):
+        with self._lock:
+            if self._free_timer is not None:
+                self._free_timer.cancel()
+                self._free_timer = None
+            if not self._free_buffer:
+                return
+            batch, self._free_buffer = self._free_buffer, []
+        try:
+            self.gcs.call("free_objects", {"object_ids": batch}, timeout=5)
+        except Exception:
+            pass
 
     def shutdown(self):
+        self._flush_free_buffer()
         self._closed = True
         for c in self._actor_clients.values():
             c.client.close()

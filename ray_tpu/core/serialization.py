@@ -96,6 +96,12 @@ def deserialize(data: memoryview | bytes, zero_copy: bool = True) -> Any:
         b = view[pos : pos + blen]
         if not zero_copy:
             b = memoryview(bytes(b))
+        else:
+            # Zero-copy readers alias shared-memory pages: hand out read-only
+            # views so a consumer mutating e.g. a numpy array cannot corrupt
+            # the object for other readers (reference: plasma buffers are
+            # read-only after seal).
+            b = b.toreadonly()
         buffers.append(b)
         pos += blen
     return pickle.loads(bytes(payload), buffers=buffers)

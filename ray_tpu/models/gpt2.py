@@ -168,7 +168,7 @@ def mesh_shardings_for(model: nn.Module, mesh,
     from ray_tpu.parallel.sharding import logical_axis_rules
 
     logical = logical_param_specs(model, sample_shape)
-    rule_list = logical_axis_rules(rules)
+    rule_list = logical_axis_rules(rules, mesh_axes=mesh.axis_names)
     with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") \
             else _null():
         resolved = nn.logical_to_mesh_sharding(logical, mesh, rule_list)
@@ -219,7 +219,8 @@ def make_train_step(model: nn.Module, optimizer, mesh=None,
 
     from ray_tpu.parallel.sharding import logical_axis_rules
 
-    rules = logical_axis_rules()
+    rules = logical_axis_rules(
+        mesh_axes=mesh.axis_names if mesh is not None else None)
 
     def step(params, opt_state, batch):
         def loss_fn(p):

@@ -1,0 +1,70 @@
+"""MLP: the smoke-test model for trainers, Tune, and multichip dryruns.
+
+A plain flax MLP with the same logical-axis annotations as the flagship
+GPT-2 ("embed"/"mlp" matmul axes over tp, "batch" over dp/fsdp), so every
+sharding path exercised by the big model is exercised by the cheap one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Multi-layer perceptron with logical sharding annotations.
+
+    features: hidden layer widths; the final entry is the output width.
+    """
+
+    features: Sequence[int] = (128, 128, 10)
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "embed"))
+        for i, width in enumerate(self.features):
+            x = nn.Dense(
+                width,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(),
+                    ("embed", "mlp") if i % 2 == 0 else ("mlp", "embed")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros,
+                    ("mlp",) if i % 2 == 0 else ("embed",)),
+                name=f"dense_{i}",
+            )(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+def classification_loss(logits, labels):
+    """Mean softmax cross-entropy; labels are integer class ids."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(model: nn.Module, optimizer):
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss)."""
+    import optax
+
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["x"])
+            return classification_loss(logits, batch["y"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step)

@@ -126,7 +126,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     return out.reshape(batch, heads, seq_q, d)
 
 
-def _use_pallas(q, block_q: int, block_k: int) -> bool:
+def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
     try:
         platform = q.devices().pop().platform if hasattr(q, "devices") else \
             jax.devices()[0].platform
@@ -134,8 +134,14 @@ def _use_pallas(q, block_q: int, block_k: int) -> bool:
         platform = jax.default_backend()
     if platform != "tpu":
         return False
-    _, _, seq, d = q.shape
-    return seq % block_q == 0 and seq % block_k == 0 and d % 64 == 0
+    _, _, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    # The kernel's causal mask assumes q and k positions share origin 0,
+    # while mha_reference aligns sequence *ends* (tril k=ks-qs); restrict
+    # the kernel to seq_q == seq_k so both paths agree, and validate k's
+    # sequence length for block divisibility.
+    return (seq_q == seq_k and seq_q % block_q == 0 and seq_k % block_k == 0
+            and d % 64 == 0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -155,7 +161,7 @@ def _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k):
         scale = 1.0 / math.sqrt(q.shape[-1])
     seq = q.shape[2]
     bq, bk = min(block_q, seq), min(block_k, seq)
-    if _use_pallas(q, bq, bk):
+    if _use_pallas(q, k, bq, bk):
         return _flash_forward(q, k, v, causal, scale, bq, bk)
     return mha_reference(q, k, v, causal=causal, scale=scale)
 

@@ -25,11 +25,24 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
 )
 
 
-def logical_axis_rules(overrides: Optional[Dict[str, Any]] = None
+def logical_axis_rules(overrides: Optional[Dict[str, Any]] = None,
+                       mesh_axes: Optional[Sequence[str]] = None
                        ) -> List[Tuple[str, Any]]:
+    """Rules as (logical, mesh-axis) pairs. When `mesh_axes` is given, targets
+    not present in the mesh are pruned (flax's logical_to_mesh raises on
+    unknown axes; a dp-only mesh must still shard "batch")."""
     rules = dict(DEFAULT_RULES)
     if overrides:
         rules.update(overrides)
+    if mesh_axes is not None:
+        pruned = {}
+        for logical, target in rules.items():
+            if isinstance(target, (tuple, list)):
+                kept = tuple(t for t in target if t in mesh_axes)
+                pruned[logical] = kept if kept else None
+            else:
+                pruned[logical] = target if target in mesh_axes else None
+        rules = pruned
     return list(rules.items())
 
 

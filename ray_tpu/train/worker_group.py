@@ -121,6 +121,20 @@ class WorkerGroup:
         options: Dict[str, Any] = {}
         num_cpus = resources.pop("CPU", 1.0)
         num_tpus = resources.pop("TPU", 0)
+        # CPU is a *logical* resource: scale the per-worker request down so
+        # the group always fits the cluster (a 2-worker default must work on
+        # a 1-CPU bench host). TPU chips are physical and never scaled.
+        try:
+            total_cpu = ray_tpu.cluster_resources().get("CPU", 0.0)
+        except Exception:
+            total_cpu = 0.0
+        if total_cpu and num_cpus * num_workers > total_cpu:
+            fitted = max(0.01, int(total_cpu * 100 / num_workers) / 100)
+            logger.warning(
+                "ScalingConfig requests %s CPUs x %d workers but the cluster "
+                "has %s; scaling the per-worker CPU request to %s.",
+                num_cpus, num_workers, total_cpu, fitted)
+            num_cpus = fitted
         if use_placement_group and num_workers > 1:
             from ray_tpu.util.placement_group import placement_group
             from ray_tpu.util.scheduling_strategies import (

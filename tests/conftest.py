@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Worker subprocesses must resolve functions defined in test modules (pytest
+# puts tests/ on the driver's sys.path; spawned workers inherit PYTHONPATH).
+_tests_dir = os.path.dirname(os.path.abspath(__file__))
+_pp = os.environ.get("PYTHONPATH", "")
+if _tests_dir not in _pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = (
+        _tests_dir + (os.pathsep + _pp if _pp else ""))
+
 # The container's sitecustomize may import jax and register a TPU plugin
 # before conftest runs; flip the already-imported config to CPU (backends
 # aren't initialized yet at collection time, so this still takes effect).

@@ -1,0 +1,83 @@
+"""Mesh construction + logical sharding rules on the 8-device CPU platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    mesh_shape_summary,
+    validate_divisibility,
+)
+from ray_tpu.parallel.sharding import named_sharding, shard_params
+
+
+def test_meshspec_resolution():
+    assert MeshSpec({"dp": -1}).resolved(8) == {"dp": 8}
+    assert MeshSpec({"dp": 2, "tp": -1}).resolved(8) == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": 3}).resolved(8)
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "tp": -1}).resolved(8)
+
+
+def test_axis_order_is_canonical():
+    spec = MeshSpec({"tp": 2, "dp": 2, "sp": 2})
+    assert spec.axis_names() == ("dp", "sp", "tp")
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh(MeshSpec({"dp": 2, "fsdp": 2, "tp": 2}))
+    assert mesh_shape_summary(mesh) == {"dp": 2, "fsdp": 2, "tp": 2}
+    assert mesh.devices.size == 8
+
+
+def test_dcn_axes_are_slowest_varying():
+    mesh = build_mesh(MeshSpec({"dp": 2, "tp": 4}, dcn_axes=("dp",)))
+    assert mesh.axis_names[0] == "dp"
+
+
+def test_named_sharding_rules():
+    mesh = build_mesh(MeshSpec({"dp": 2, "fsdp": 2, "tp": 2}))
+    sh = named_sharding(mesh, "batch", "embed")
+    # batch -> (dp, fsdp); embed -> fsdp is taken, so None.
+    spec = sh.spec
+    assert spec[0] == ("dp", "fsdp")
+    assert spec[1] is None
+    sh2 = named_sharding(mesh, "embed", "mlp")
+    assert sh2.spec[0] == "fsdp" and sh2.spec[1] == "tp"
+
+
+def test_sharded_matmul_matches_single_device():
+    mesh = build_mesh(MeshSpec({"dp": 2, "tp": 4}))
+    x = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+    w = jnp.ones((16, 32), jnp.float32)
+    xs = jax.device_put(x, named_sharding(mesh, "batch", None))
+    ws = jax.device_put(w, named_sharding(mesh, "embed", "mlp"))
+
+    @jax.jit
+    def f(x, w):
+        return x @ w
+
+    out = f(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w))
+
+
+def test_validate_divisibility():
+    mesh = build_mesh(MeshSpec({"dp": 4, "sp": 2}))
+    validate_divisibility(mesh, batch_size=8, seq_len=128)
+    with pytest.raises(ValueError):
+        validate_divisibility(mesh, batch_size=6)
+    with pytest.raises(ValueError):
+        validate_divisibility(mesh, batch_size=8, seq_len=127)
+
+
+def test_shard_params_places_leaves():
+    mesh = build_mesh(MeshSpec({"dp": 2, "tp": 4}))
+    params = {"w": jnp.ones((16, 8)), "b": jnp.ones((8,))}
+    axes = {"w": ("embed", "mlp"), "b": None}
+    placed = shard_params(params, mesh, axes)
+    assert placed["w"].sharding.spec[1] == "tp"
+    assert placed["b"].sharding.is_fully_replicated
