@@ -158,14 +158,22 @@ class ActorClass:
                 # Default-resource actor in a PG: admission-control against
                 # the bundle so N such actors can't all land concurrently on
                 # a saturated bundle (mirror of the non-PG 1-CPU default).
+                # Wildcard index (-1) gates on the group-wide wildcard
+                # resource instead.
+                from ray_tpu.core.common import (
+                    pg_bundle_resource_name,
+                    pg_wildcard_resource_name,
+                )
+
                 strategy_obj = opts.get("scheduling_strategy")
                 pg = strategy_obj.placement_group
                 idx = strategy_obj.placement_group_bundle_index
-                bundle = pg.bundles[idx] if idx >= 0 else {}
+                bundle = pg.bundles[idx] if idx >= 0 else pg.bundles[0]
                 if bundle:
-                    r, amt = next(iter(bundle.items()))
-                    placement_resources = {
-                        f"{r}_group_{idx}_{pg.id.hex()}": min(1.0, amt)}
+                    r, amt = next(iter(sorted(bundle.items())))
+                    name = pg_bundle_resource_name(r, idx, pg.id) if idx >= 0 \
+                        else pg_wildcard_resource_name(r, pg.id)
+                    placement_resources = {name: min(1.0, amt)}
         else:
             placement_resources = dict(resources) if explicit else {"CPU": 1.0}
         ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)

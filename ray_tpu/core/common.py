@@ -210,9 +210,19 @@ class PlacementGroupInfo:
     lifetime: Optional[str] = None
 
     def bundle_resource_name(self, base: str, index: int) -> str:
-        # `CPU_group_0_<pgid>` style wildcard/indexed names as in the reference
-        # (`src/ray/common/placement_group.h` BundleSpec resource formatting).
-        return f"{base}_group_{index}_{self.pg_id.hex()}"
+        return pg_bundle_resource_name(base, index, self.pg_id)
 
     def wildcard_resource_name(self, base: str) -> str:
-        return f"{base}_group_{self.pg_id.hex()}"
+        return pg_wildcard_resource_name(base, self.pg_id)
+
+
+def pg_bundle_resource_name(base: str, index: int, pg_id) -> str:
+    """`CPU_group_0_<pgid>` style indexed name as in the reference
+    (`src/ray/common/placement_group.h` BundleSpec resource formatting).
+    The single source of truth for the format — raylet commit, task
+    submission, and actor placement must all agree."""
+    return f"{base}_group_{index}_{pg_id.hex()}"
+
+
+def pg_wildcard_resource_name(base: str, pg_id) -> str:
+    return f"{base}_group_{pg_id.hex()}"

@@ -1,0 +1,135 @@
+"""ray_tpu.data: distributed datasets executed as tasks over the core.
+
+Equivalent of Ray Data (`python/ray/data/read_api.py`, `dataset.py`):
+creation APIs here, transforms/consumption on `Dataset`. Reads are lazy —
+each file/chunk becomes a read task fused with downstream transforms.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.iterator import DataIterator, StreamSplitDataIterator
+from ray_tpu.data import datasource as _ds
+
+
+def _auto_parallelism(n_items: int) -> int:
+    ctx = DataContext.get_current()
+    if ctx.read_parallelism > 0:
+        return min(n_items, ctx.read_parallelism)
+    try:
+        import ray_tpu
+
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 2))
+    except Exception:
+        cpus = 2
+    return max(1, min(n_items, 2 * cpus, 192))
+
+
+# ------------------------------------------------------------------ creation #
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    import builtins
+
+    p = parallelism if parallelism > 0 else _auto_parallelism(max(1, n // 1000))
+    per = max(1, -(-n // p))
+    work = [(_ds.make_range_block, (s, min(s + per, n)))
+            for s in builtins.range(0, n, per)]
+    return Dataset(work)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    import builtins
+
+    p = parallelism if parallelism > 0 else _auto_parallelism(max(1, n // 1000))
+    per = max(1, -(-n // p))
+    work = [(_ds.make_tensor_range_block, (s, min(s + per, n), tuple(shape)))
+            for s in builtins.range(0, n, per)]
+    return Dataset(work)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    import builtins
+
+    p = parallelism if parallelism > 0 else _auto_parallelism(
+        max(1, len(items) // 100))
+    per = max(1, -(-len(items) // p)) if items else 1
+    work = [(None, (items[s:s + per],))
+            for s in builtins.range(0, max(len(items), 1), per)]
+    return Dataset(work)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               parallelism: int = -1) -> Dataset:
+    import builtins
+
+    n = len(arr)
+    p = parallelism if parallelism > 0 else _auto_parallelism(max(1, n // 1000))
+    per = max(1, -(-n // p))
+    work = [(None, ({column: arr[s:s + per]},))
+            for s in builtins.range(0, n, per)]
+    return Dataset(work)
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([(None, (df,))])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([(None, (table,))])
+
+
+# -------------------------------------------------------------------- reads #
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_parquet_file, (f, columns)) for f in files])
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    import functools
+
+    files = _ds.expand_paths(paths)
+    reader = functools.partial(_ds.read_csv_file, **kw) if kw \
+        else _ds.read_csv_file
+    return Dataset([(reader, (f,)) for f in files])
+
+
+def read_json(paths, *, lines: bool = True, parallelism: int = -1) -> Dataset:
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_json_file, (f, lines)) for f in files])
+
+
+def read_text(paths, *, encoding: str = "utf-8",
+              parallelism: int = -1) -> Dataset:
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_text_file, (f, encoding)) for f in files])
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_numpy_file, (f,)) for f in files])
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_binary_file, (f, include_paths)) for f in files])
+
+
+__all__ = [
+    "Dataset", "DataIterator", "StreamSplitDataIterator", "DataContext",
+    "Block", "BlockAccessor", "BlockMetadata",
+    "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
+    "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",
+    "read_numpy", "read_binary_files",
+]

@@ -1,0 +1,29 @@
+"""DataContext: execution knobs (reference `python/ray/data/context.py:134`)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Streaming executor backpressure: max concurrent tasks per operator and
+    # max buffered output blocks per operator before the op is throttled.
+    max_tasks_in_flight_per_op: int = 8
+    max_buffered_blocks_per_op: int = 16
+    read_parallelism: int = -1  # -1 = auto (min(files, 2*CPUs, 192))
+    eager_free: bool = True
+
+    _instance: Optional["DataContext"] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DataContext()
+            return cls._instance

@@ -1,0 +1,364 @@
+"""Dataset: lazy, distributed data pipeline executed as tasks over the core.
+
+Equivalent of the reference's `Dataset`/`Datastream`
+(`python/ray/data/dataset.py`, `datastream.py:1096` streaming_split) with the
+logical plan + streaming executor collapsed into one chain of fused block
+transforms (`_internal/logical/`, `_internal/planner/planner.py`): every
+consecutive 1:1 transform rides the same task, all-to-all ops (repartition,
+random_shuffle) are materialization barriers, and consumption is streaming
+(`iter_batches` starts before reads finish).
+
+TPU-first choice: the canonical batch format is dict[str, np.ndarray] so
+`iter_batches` output feeds `jax.device_put` without conversion.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+
+logger = logging.getLogger(__name__)
+
+WorkItem = Tuple[Optional[Callable], tuple]
+
+
+def _map_rows_transform(fn):
+    def transform(block):
+        return [fn(row) for row in BlockAccessor(block).rows()]
+
+    return transform
+
+
+def _flat_map_transform(fn):
+    def transform(block):
+        out = []
+        for row in BlockAccessor(block).rows():
+            out.extend(fn(row))
+        return out
+
+    return transform
+
+
+def _filter_transform(fn):
+    def transform(block):
+        acc = BlockAccessor(block)
+        if isinstance(block, list):
+            return [r for r in acc.rows() if fn(r)]
+        batch = acc.to_batch()
+        keep = np.asarray([bool(fn(row)) for row in acc.rows()])
+        return {k: v[keep] for k, v in batch.items()}
+
+    return transform
+
+
+def _map_batches_transform(fn, batch_size: Optional[int], fn_kwargs):
+    def transform(block):
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        if n == 0:
+            return block
+        if batch_size is None or batch_size >= n:
+            out = fn(acc.to_batch(), **fn_kwargs) if fn_kwargs else fn(acc.to_batch())
+            return BlockAccessor.batch_to_block(out)
+        pieces = []
+        for start in range(0, n, batch_size):
+            piece = BlockAccessor(acc.slice(start, min(start + batch_size, n)))
+            out = fn(piece.to_batch(), **fn_kwargs) if fn_kwargs \
+                else fn(piece.to_batch())
+            pieces.append(BlockAccessor.batch_to_block(out))
+        return BlockAccessor.concat(pieces)
+
+    return transform
+
+
+class Dataset:
+    """Lazy pipeline: `_work` produces input blocks, `_transforms` fuse."""
+
+    def __init__(self, work: List[WorkItem],
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        self._work = work
+        self._transforms = list(transforms or [])
+        self._resources = resources
+        self._materialized_refs: Optional[List[Any]] = None
+
+    # ------------------------------------------------------------ transforms
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return Dataset(self._work, self._transforms + [transform],
+                       self._resources)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._derive(_map_rows_transform(fn))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        return self._derive(_flat_map_transform(fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._derive(_filter_transform(fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    fn_kwargs: Optional[Dict] = None, **_compat) -> "Dataset":
+        return self._derive(_map_batches_transform(fn, batch_size,
+                                                   fn_kwargs or {}))
+
+    def with_resources(self, **resources) -> "Dataset":
+        """Run this dataset's tasks with resource options (e.g. num_cpus)."""
+        return Dataset(self._work, self._transforms, resources)
+
+    # ----------------------------------------------------------- all-to-all
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        parent = self
+
+        def work() -> List[WorkItem]:
+            blocks = [b for b in parent._iter_block_values()]
+            merged = BlockAccessor.concat(blocks) if blocks else []
+            total = BlockAccessor(merged).num_rows()
+            per = max(1, -(-total // num_blocks))
+            acc = BlockAccessor(merged)
+            out: List[WorkItem] = []
+            for i in range(num_blocks):
+                start = min(i * per, total)
+                end = min((i + 1) * per, total)
+                out.append((None, (acc.slice(start, end),)))
+            return out
+
+        return _DeferredDataset(work)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        parent = self
+
+        def work() -> List[WorkItem]:
+            blocks = [b for b in parent._iter_block_values()]
+            if not blocks:
+                return []
+            merged = BlockAccessor.concat(blocks)
+            acc = BlockAccessor(merged)
+            n = acc.num_rows()
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(n)
+            batch = acc.to_batch()
+            shuffled = {k: v[perm] for k, v in batch.items()}
+            nb = max(1, len(blocks))
+            per = max(1, -(-n // nb))
+            sacc = BlockAccessor(shuffled)
+            return [(None, (sacc.slice(i * per, min((i + 1) * per, n)),))
+                    for i in range(nb) if i * per < n]
+
+        return _DeferredDataset(work)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        sets = [self, *others]
+
+        def work() -> List[WorkItem]:
+            out: List[WorkItem] = []
+            for ds in sets:
+                for ref in ds._iter_block_refs():
+                    out.append((None, (ref,)))
+            return out
+
+        return _DeferredDataset(work)
+
+    # ------------------------------------------------------------- execution
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        """Streaming execution: yields ObjectRefs to output blocks."""
+        if self._materialized_refs is not None:
+            yield from self._materialized_refs
+            return
+        from ray_tpu.data.executor import StreamingExecutor
+
+        executor = StreamingExecutor(self._transforms,
+                                     resources=self._resources)
+        yield from executor.execute(iter(self._work))
+
+    def _iter_block_values(self) -> Iterator[Block]:
+        import ray_tpu
+
+        for ref in self._iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._iter_block_refs())
+        out = Dataset(self._work, self._transforms, self._resources)
+        out._materialized_refs = refs
+        # Keep a plan for re-execution-from-refs.
+        out._work = [(None, (r,)) for r in refs]
+        out._transforms = []
+        return out
+
+    # ------------------------------------------------------------ consumers
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_block_values():
+            yield from BlockAccessor(block).rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     prefetch_batches: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        from ray_tpu.data.iterator import batch_blocks
+
+        yield from batch_blocks(self._iter_block_values(), batch_size,
+                                drop_last)
+
+    def iterator(self):
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self)
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for block in self._iter_block_values():
+            out.extend(BlockAccessor(block).take(limit - len(out)))
+            if len(out) >= limit:
+                break
+        return out[:limit]
+
+    def take_all(self) -> List[Any]:
+        return [r for r in self.iter_rows()]
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows()
+                   for b in self._iter_block_values())
+
+    def schema(self):
+        for block in self._iter_block_values():
+            acc = BlockAccessor(block)
+            if acc.num_rows():
+                return acc.schema()
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._work)
+
+    def sum(self, on: Optional[str] = None):
+        return self._agg(np.sum, on)
+
+    def mean(self, on: Optional[str] = None):
+        total, rows = 0.0, 0
+        for b in self._iter_block_values():
+            acc = BlockAccessor(b)
+            batch = acc.to_batch()
+            col = batch[on] if on else next(iter(batch.values()))
+            total += float(np.sum(col))
+            rows += len(col)
+        return total / rows if rows else 0.0
+
+    def min(self, on: Optional[str] = None):
+        return self._agg(np.min, on, reducer=min)
+
+    def max(self, on: Optional[str] = None):
+        return self._agg(np.max, on, reducer=max)
+
+    def _agg(self, fn, on, reducer=None):
+        parts = []
+        for b in self._iter_block_values():
+            batch = BlockAccessor(b).to_batch()
+            col = batch[on] if on else next(iter(batch.values()))
+            if len(col):
+                parts.append(fn(col))
+        if not parts:
+            return None
+        if reducer:
+            out = parts[0]
+            for p in parts[1:]:
+                out = reducer(out, p)
+            return out
+        return float(np.sum(parts)) if fn is np.sum else fn(parts)
+
+    # ---------------------------------------------------------------- splits
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing split into n datasets with balanced rows."""
+        refs = list(self.materialize()._iter_block_refs())
+        groups: List[List[Any]] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            groups[i % n].append(ref)
+        out = []
+        for g in groups:
+            ds = Dataset([(None, (r,)) for r in g])
+            out.append(ds)
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[Any]:
+        """n coordinated iterators over ONE shared streaming execution
+        (reference `datastream.py:1096` -> `StreamSplitDataIterator`)."""
+        from ray_tpu.data.iterator import make_streaming_splits
+
+        return make_streaming_splits(self, n, equal=equal)
+
+    # ---------------------------------------------------------------- writes
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def write_numpy(self, path: str, column: str = "item") -> List[str]:
+        return self._write(path, "numpy", column=column)
+
+    def _write(self, path: str, fmt: str, **kw) -> List[str]:
+        import os
+
+        import ray_tpu
+        from ray_tpu.data.datasource import write_block
+
+        os.makedirs(path, exist_ok=True)
+        refs = []
+        for i, block_ref in enumerate(self._iter_block_refs()):
+            refs.append(ray_tpu.remote(write_block).remote(
+                block_ref, path, i, fmt, kw))
+        return ray_tpu.get(refs)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = [BlockAccessor(b).to_pandas()
+                  for b in self._iter_block_values()]
+        return pd.concat(blocks, ignore_index=True) if blocks else pd.DataFrame()
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._work)}, "
+                f"num_transforms={len(self._transforms)})")
+
+
+class _DeferredDataset(Dataset):
+    """Dataset whose inputs come from a barrier (all-to-all) computation;
+    the work list is computed on first execution and cached."""
+
+    def __init__(self, work_fn: Callable[[], List[WorkItem]],
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        super().__init__([], transforms, resources)
+        self._work_fn = work_fn
+        self._resolved = False
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return _DeferredDataset(self._work_fn,
+                                self._transforms + [transform],
+                                self._resources)
+
+    def _resolve(self):
+        if not self._resolved:
+            self._work = self._work_fn()
+            self._resolved = True
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        self._resolve()
+        yield from super()._iter_block_refs()
+
+    def num_blocks(self) -> int:
+        self._resolve()
+        return len(self._work)

@@ -1,0 +1,112 @@
+"""Datasources: file readers/writers run inside read tasks.
+
+Equivalent of the reference's `python/ray/data/datasource/*_datasource.py`
+(parquet, csv, json, text, numpy, binary) + `file_based_datasource.py` path
+expansion. Each reader returns one block per file chunk; the read happens in
+the task, so bytes never flow through the driver.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "**", "*"), recursive=True)
+                if os.path.isfile(f) and not os.path.basename(f).startswith((".", "_"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"No input files found for {paths}")
+    return out
+
+
+# ------------------------------------------------------------------ readers #
+
+
+def read_parquet_file(path: str, columns: Optional[List[str]] = None):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path, columns=columns)
+
+
+def read_csv_file(path: str, **kw):
+    import pandas as pd
+
+    return pd.read_csv(path, **kw)
+
+
+def read_json_file(path: str, lines: bool = True):
+    import pandas as pd
+
+    return pd.read_json(path, lines=lines)
+
+
+def read_text_file(path: str, encoding: str = "utf-8",
+                   drop_empty_lines: bool = True) -> List[str]:
+    with open(path, "r", encoding=encoding) as f:
+        lines = f.read().splitlines()
+    return [l for l in lines if l or not drop_empty_lines]
+
+
+def read_numpy_file(path: str) -> Dict[str, np.ndarray]:
+    arr = np.load(path, allow_pickle=False)
+    if isinstance(arr, np.lib.npyio.NpzFile):
+        return {k: arr[k] for k in arr.files}
+    return {"item": arr}
+
+
+def read_binary_file(path: str, include_paths: bool = False):
+    with open(path, "rb") as f:
+        data = f.read()
+    if include_paths:
+        return [{"path": path, "bytes": data}]
+    return [data]
+
+
+def make_range_block(start: int, stop: int) -> Dict[str, np.ndarray]:
+    return {"id": np.arange(start, stop, dtype=np.int64)}
+
+
+def make_tensor_range_block(start: int, stop: int, shape) -> Dict[str, np.ndarray]:
+    n = stop - start
+    base = np.arange(start, stop, dtype=np.float64).reshape((n,) + (1,) * len(shape))
+    return {"data": np.broadcast_to(base, (n,) + tuple(shape)).copy()}
+
+
+# ------------------------------------------------------------------ writers #
+
+
+def write_block(block: Any, path: str, index: int, fmt: str,
+                kw: Dict[str, Any]) -> str:
+    from ray_tpu.data.block import BlockAccessor
+
+    acc = BlockAccessor(block)
+    out = os.path.join(path, f"part-{index:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(acc.to_arrow(), out)
+    elif fmt == "csv":
+        acc.to_pandas().to_csv(out, index=False)
+    elif fmt == "json":
+        acc.to_pandas().to_json(out, orient="records", lines=True)
+    elif fmt == "numpy":
+        col = kw.get("column", "item")
+        np.save(out, acc.to_batch()[col])
+        out += ".npy"
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return out

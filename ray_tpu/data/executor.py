@@ -1,0 +1,91 @@
+"""Streaming executor: pull-based block pipeline with backpressure.
+
+Equivalent of the reference's `StreamingExecutor`
+(`python/ray/data/_internal/execution/streaming_executor.py:48` and the
+control loop in `streaming_executor_state.py:259-364`), redesigned around
+this framework's one-hop task dispatch:
+
+- consecutive 1:1 block transforms are FUSED into one remote call per block
+  (the reference's operator fusion rule), so a read->map->filter pipeline
+  costs one task per block;
+- at most `max_tasks_in_flight_per_op` tasks run concurrently and at most
+  `max_buffered_blocks_per_op` finished blocks sit unconsumed — the pump
+  stops submitting until the consumer drains them (backpressure);
+- blocks are yielded as ObjectRefs in completion order (streaming), so
+  downstream consumers (iter_batches / streaming_split) start before the
+  read finishes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def _fused_apply(fns, producer, *args):
+    """Remote body: run the producer (read task or identity on a block),
+    then thread the block through every fused transform."""
+    block = producer(*args) if producer is not None else args[0]
+    for fn in fns:
+        block = fn(block)
+    return block
+
+
+class StreamingExecutor:
+    """Pumps (producer, args) work items through fused transforms."""
+
+    def __init__(self, transforms: List[Callable],
+                 max_in_flight: Optional[int] = None,
+                 max_buffered: Optional[int] = None,
+                 resources: Optional[dict] = None):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        self._transforms = transforms
+        self._max_in_flight = max_in_flight or ctx.max_tasks_in_flight_per_op
+        self._max_buffered = max_buffered or ctx.max_buffered_blocks_per_op
+        self._resources = resources
+
+    def execute(self, work: Iterator[Tuple[Optional[Callable], tuple]]
+                ) -> Iterator[Any]:
+        """work: iterator of (producer, args). Yields block ObjectRefs in
+        completion order."""
+        import ray_tpu
+
+        remote_fn = ray_tpu.remote(_fused_apply)
+        if self._resources:
+            remote_fn = remote_fn.options(**self._resources)
+
+        work_iter = iter(work)
+        in_flight: List[Any] = []
+        buffered: List[Any] = []
+        exhausted = False
+        while True:
+            # Submit while under the in-flight cap and backpressure allows.
+            while (not exhausted and len(in_flight) < self._max_in_flight
+                   and len(buffered) + len(in_flight) < self._max_buffered):
+                try:
+                    producer, args = next(work_iter)
+                except StopIteration:
+                    exhausted = True
+                    break
+                in_flight.append(
+                    remote_fn.remote(self._transforms, producer, *args))
+            if buffered:
+                yield buffered.pop(0)
+                continue
+            if not in_flight:
+                if exhausted:
+                    return
+                continue
+            ready, in_flight = ray_tpu.wait(
+                in_flight, num_returns=1, timeout=10.0)
+            buffered.extend(ready)
+
+
+def apply_transforms_local(transforms: List[Callable], block: Any) -> Any:
+    for fn in transforms:
+        block = fn(block)
+    return block

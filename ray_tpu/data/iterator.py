@@ -1,0 +1,139 @@
+"""Data iterators: batching, prefetch, and coordinated streaming splits.
+
+Equivalent of the reference's `DataIterator` (`python/ray/data/iterator.py`),
+the prefetching batcher (`_internal/block_batching/iter_batches.py`) and
+`StreamSplitDataIterator` (`_internal/iterator/stream_split_iterator.py:41`):
+`streaming_split(n)` starts ONE coordinator actor that drives a single
+streaming execution and hands blocks to whichever consumer asks first, so
+fast train workers pull more data instead of idling on a static shard.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import BlockAccessor
+
+
+def batch_blocks(blocks: Iterator[Any], batch_size: int,
+                 drop_last: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+    """Re-chunk a stream of blocks into exact-size numpy-dict batches."""
+    carry: Optional[Dict[str, np.ndarray]] = None
+    for block in blocks:
+        batch = BlockAccessor(block).to_batch()
+        if not batch or len(next(iter(batch.values()))) == 0:
+            continue
+        if carry is not None:
+            batch = {k: np.concatenate([carry[k], batch[k]]) for k in batch}
+            carry = None
+        n = len(next(iter(batch.values())))
+        start = 0
+        while n - start >= batch_size:
+            yield {k: v[start:start + batch_size] for k, v in batch.items()}
+            start += batch_size
+        if start < n:
+            carry = {k: v[start:] for k, v in batch.items()}
+    if carry is not None and not drop_last:
+        yield carry
+
+
+class DataIterator:
+    """Per-consumer view of a Dataset (whole dataset, no split)."""
+
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
+                     prefetch_batches: int = 1
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        yield from batch_blocks(self._dataset._iter_block_values(),
+                                batch_size, drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        yield from self._dataset.iter_rows()
+
+    def materialize(self):
+        return self._dataset.materialize()
+
+
+class _SplitCoordinator:
+    """Actor driving one streaming execution for n consumers.
+
+    Blocks are handed out first-come-first-served; `equal` slices each block
+    so no consumer can run ahead by more than one block.
+    """
+
+    def __init__(self, ds_blob: bytes, n: int, equal: bool):
+        import cloudpickle
+
+        self._ds = cloudpickle.loads(ds_blob)
+        self._n = n
+        self._equal = equal
+        self._epoch = -1
+        self._iter: Optional[Iterator[Any]] = None
+        self._lock = threading.Lock()
+
+    def next_block(self, split_id: int, epoch: int) -> Dict[str, Any]:
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = epoch
+                self._iter = self._ds._iter_block_values()
+            if epoch < self._epoch or self._iter is None:
+                return {"end": True}
+            try:
+                return {"block": next(self._iter)}
+            except StopIteration:
+                return {"end": True}
+
+    def stats(self) -> Dict[str, Any]:
+        return {"epoch": self._epoch, "n": self._n}
+
+
+class StreamSplitDataIterator:
+    """One of n coordinated consumers; picklable (ships to train workers)."""
+
+    def __init__(self, coordinator, split_id: int, n: int):
+        self._coordinator = coordinator
+        self._split_id = split_id
+        self._n = n
+        self._epoch = 0
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
+                     prefetch_batches: int = 1
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        yield from batch_blocks(self._iter_blocks(), batch_size, drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).rows()
+
+    def _iter_blocks(self) -> Iterator[Any]:
+        import ray_tpu
+
+        epoch = self._epoch
+        self._epoch += 1
+        while True:
+            resp = ray_tpu.get(
+                self._coordinator.next_block.remote(self._split_id, epoch))
+            if resp.get("end"):
+                return
+            yield resp["block"]
+
+    def __reduce__(self):
+        return (StreamSplitDataIterator,
+                (self._coordinator, self._split_id, self._n))
+
+
+def make_streaming_splits(dataset, n: int, equal: bool = False
+                          ) -> List[StreamSplitDataIterator]:
+    import cloudpickle
+
+    import ray_tpu
+
+    blob = cloudpickle.dumps(dataset)
+    coordinator = ray_tpu.remote(_SplitCoordinator).options(
+        max_concurrency=max(2, n)).remote(blob, n, equal)
+    return [StreamSplitDataIterator(coordinator, i, n) for i in range(n)]

@@ -38,12 +38,17 @@ def _resolve_pg_strategy(options: Dict[str, Any], resources: Dict[str, float]):
     pg: PlacementGroup = strategy.placement_group
     idx = strategy.placement_group_bundle_index
     node_hex = pg._bundle_node_hex(idx)
+    from ray_tpu.core.common import (
+        pg_bundle_resource_name,
+        pg_wildcard_resource_name,
+    )
+
     renamed: Dict[str, float] = {}
     for r, amt in resources.items():
         if idx >= 0:
-            renamed[f"{r}_group_{idx}_{pg.id.hex()}"] = amt
+            renamed[pg_bundle_resource_name(r, idx, pg.id)] = amt
         else:
-            renamed[f"{r}_group_{pg.id.hex()}"] = amt
+            renamed[pg_wildcard_resource_name(r, pg.id)] = amt
     return renamed, NodeAffinitySchedulingStrategy(node_hex, soft=False), pg.id, idx
 
 

@@ -1,0 +1,192 @@
+"""ray_tpu.tune: hyperparameter search over trials-as-actors.
+
+Equivalent of Ray Tune (`python/ray/tune/tuner.py:52,315`): `Tuner.fit`
+expands the param space into trials, runs them through the TuneController
+with a scheduler (ASHA/PBT/FIFO), checkpoints experiment state, and returns
+a ResultGrid. Train trainers plug in via `Trainer.as_trainable()`.
+
+    from ray_tpu import tune
+
+    def trainable(config):
+        for step in range(10):
+            tune.report({"loss": config["lr"] * step})
+
+    tuner = tune.Tuner(trainable,
+                       param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+                       tune_config=tune.TuneConfig(num_samples=8,
+                                                   metric="loss", mode="min"))
+    results = tuner.fit()
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.session import get_checkpoint, get_session
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial import Trial, TrialStatus
+from ray_tpu.tune.tune_controller import TuneController
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (+ optional checkpoint) from inside a trainable."""
+    get_session().report(metrics, checkpoint)
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    config: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    trial_id: str
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __iter__(self):
+        return iter(self.results)
+
+    def __getitem__(self, i):
+        return self.results[i]
+
+    @property
+    def results(self) -> List[Result]:
+        return [Result(
+            metrics=t.last_result, config=t.config,
+            checkpoint=Checkpoint.from_directory(t.checkpoint_path)
+            if t.checkpoint_path else None,
+            error=t.error, trial_id=t.trial_id,
+            metrics_history=t.metrics_history) for t in self._trials]
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        scored = [r for r in self.results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self.results:
+            row = {"trial_id": r.trial_id, **{f"config/{k}": v
+                                              for k, v in r.config.items()}}
+            row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _trials: Optional[List[Trial]] = None):
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._restored_trials = _trials
+
+    def _experiment_dir(self) -> str:
+        name = self._run_config.name or \
+            f"{getattr(self._trainable, '__name__', 'trainable')}_{int(time.time())}"
+        base = self._run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return os.path.join(base, name)
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self._tune_config
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            configs = BasicVariantGenerator(
+                self._param_space, tc.num_samples, tc.seed).generate()
+            trials = [Trial(config=c) for c in configs]
+        controller = TuneController(
+            self._trainable, trials,
+            scheduler=tc.scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            experiment_dir=self._experiment_dir(),
+            stop=self._run_config.stop,
+            metric=tc.metric, mode=tc.mode,
+        )
+        controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        trials = TuneController.load_trials(path)
+        run_config = RunConfig(name=os.path.basename(path.rstrip("/")),
+                               storage_path=os.path.dirname(path.rstrip("/")))
+        return cls(trainable, tune_config=tune_config, run_config=run_config,
+                   _trials=trials)
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "tuner.pkl"))
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "Result", "ResultGrid", "report",
+    "Trial", "TrialStatus", "TrialScheduler", "FIFOScheduler",
+    "ASHAScheduler", "PopulationBasedTraining",
+    "grid_search", "choice", "uniform", "loguniform", "randint", "quniform",
+    "sample_from", "get_checkpoint",
+]

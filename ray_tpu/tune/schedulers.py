@@ -1,0 +1,157 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Equivalent of the reference's `python/ray/tune/schedulers/`:
+`async_hyperband.py` (ASHA — rung-quantile early stopping without
+synchronized brackets) and `pbt.py` (exploit top quantile's checkpoint +
+perturb config). Decisions are returned from `on_trial_result`; the
+controller enforces them.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial: Trial):
+        pass
+
+    def choose_trial_to_run(self, pending: List[Trial]) -> Optional[Trial]:
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving (reference `async_hyperband.py`).
+
+    Rungs at r, r*eta, r*eta^2, ... up to max_t; a trial reaching a rung is
+    stopped unless it is in the top 1/eta of results recorded at that rung.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.eta = reduction_factor
+        # rung value -> list of recorded metric values
+        self.rungs: Dict[int, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(int(t))
+            t *= self.eta
+        self.milestones = milestones
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, trial.num_results)
+        value = result.get(self.metric)
+        if value is None:
+            return self.CONTINUE
+        if t >= self.max_t:
+            return self.STOP
+        for rung in self.milestones:
+            if t == rung or (t > rung and not self._recorded(trial, rung)):
+                recorded = self.rungs.setdefault(rung, [])
+                recorded.append(float(value))
+                trial.last_result.setdefault("_asha_rungs", []).append(rung)
+                if not self._in_top_fraction(float(value), recorded):
+                    return self.STOP
+        return self.CONTINUE
+
+    def _recorded(self, trial: Trial, rung: int) -> bool:
+        return rung in trial.last_result.get("_asha_rungs", [])
+
+    def _in_top_fraction(self, value: float, recorded: List[float]) -> bool:
+        if len(recorded) < self.eta:
+            return True  # not enough data to cut
+        ranked = sorted(recorded, reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) / self.eta))
+        cutoff = ranked[k - 1]
+        return value >= cutoff if self.mode == "max" else value <= cutoff
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference `pbt.py`): every `perturbation_interval` results, a
+    bottom-quantile trial is stopped and respawned from a top-quantile
+    trial's checkpoint with a perturbed config. The controller performs the
+    respawn when it sees the EXPLOIT decision."""
+
+    EXPLOIT = "EXPLOIT"
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        self._trials: Dict[str, Trial] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        self._trials[trial.trial_id] = trial
+        if trial.num_results % self.interval != 0:
+            return self.CONTINUE
+        value = result.get(self.metric)
+        if value is None:
+            return self.CONTINUE
+        scored = [(t.last_result.get(self.metric), t)
+                  for t in self._trials.values()
+                  if t.last_result.get(self.metric) is not None]
+        if len(scored) < 2:
+            return self.CONTINUE
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        bottom_ids = {t.trial_id for _, t in scored[-k:]}
+        if trial.trial_id in bottom_ids:
+            return self.EXPLOIT
+        return self.CONTINUE
+
+    def exploit_target(self, trial: Trial) -> Optional[Trial]:
+        scored = [(t.last_result.get(self.metric), t)
+                  for t in self._trials.values()
+                  if t.last_result.get(self.metric) is not None
+                  and t.trial_id != trial.trial_id]
+        if not scored:
+            return None
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        return self._rng.choice([t for _, t in scored[:k]])
+
+    def perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, mutation in self.mutations.items():
+            if isinstance(mutation, list):
+                out[key] = self._rng.choice(mutation)
+            elif isinstance(mutation, Domain):
+                out[key] = mutation.sample(self._rng)
+            elif callable(mutation):
+                out[key] = mutation()
+            elif key in out and isinstance(out[key], (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = out[key] * factor
+        return out

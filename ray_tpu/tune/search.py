@@ -1,0 +1,138 @@
+"""Search spaces + trial variant generation.
+
+Equivalent of the reference's `python/ray/tune/search/sample.py` domains and
+`BasicVariantGenerator` (`tune/search/basic_variant.py`): grid_search entries
+are expanded into the cross product; sampling domains draw per trial.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Categorical(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class Uniform(Domain):
+    lower: float
+    upper: float
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+@dataclass
+class LogUniform(Domain):
+    lower: float
+    upper: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+
+
+@dataclass
+class Randint(Domain):
+    lower: int
+    upper: int
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+@dataclass
+class QUniform(Domain):
+    lower: float
+    upper: float
+    q: float
+
+    def sample(self, rng):
+        v = rng.uniform(self.lower, self.upper)
+        return round(v / self.q) * self.q
+
+
+@dataclass
+class FunctionDomain(Domain):
+    fn: Callable[[], Any]
+
+    def sample(self, rng):
+        return self.fn()
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+# Public constructors (reference `tune.grid_search`, `tune.choice`, ...)
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(list(categories))
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower: int, upper: int) -> Randint:
+    return Randint(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> QUniform:
+    return QUniform(lower, upper, q)
+
+
+def sample_from(fn: Callable[[], Any]) -> FunctionDomain:
+    return FunctionDomain(fn)
+
+
+class BasicVariantGenerator:
+    """Expands grid_search cross products x num_samples; samples domains."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self._rng = random.Random(seed)
+
+    def generate(self) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, GridSearch)]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+        configs: List[Dict[str, Any]] = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg: Dict[str, Any] = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                configs.append(cfg)
+        return configs

@@ -1,0 +1,185 @@
+"""ray_tpu.tune: search spaces, schedulers, controller, restore."""
+
+import os
+
+import pytest
+
+from ray_tpu import tune
+
+
+def test_variant_generator_grid_and_samples():
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    configs = BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.choice([10]),
+         "c": "fixed"},
+        num_samples=2, seed=0).generate()
+    assert len(configs) == 6
+    assert {c["a"] for c in configs} == {1, 2, 3}
+    assert all(c["b"] == 10 and c["c"] == "fixed" for c in configs)
+
+
+def test_domains_sample_in_range():
+    import random
+
+    rng = random.Random(0)
+    for _ in range(20):
+        assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+        assert 0 <= tune.uniform(0, 5).sample(rng) <= 5
+        assert tune.randint(3, 7).sample(rng) in (3, 4, 5, 6)
+
+
+def test_asha_stops_bad_trials_unit():
+    from ray_tpu.tune.schedulers import ASHAScheduler
+    from ray_tpu.tune.trial import Trial
+
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=16,
+                          grace_period=2, reduction_factor=2)
+    good, bad = Trial(config={}), Trial(config={})
+    decisions = []
+    for t in range(1, 17):
+        for trial, loss in ((good, 0.1 / t), (bad, 5.0)):
+            trial.num_results += 1
+            d = sched.on_trial_result(trial, {"loss": loss,
+                                              "training_iteration": t})
+            decisions.append((trial is bad, t, d))
+    bad_stopped = any(is_bad and d == "STOP" for is_bad, _, d in decisions)
+    good_stopped = any((not is_bad) and d == "STOP" and t < 16
+                       for is_bad, t, d in decisions)
+    assert bad_stopped and not good_stopped
+
+
+def _trainable(config):
+    for step in range(1, config.get("steps", 8) + 1):
+        loss = config["lr"] * 100 + 1.0 / step
+        tune.report({"loss": loss, "training_iteration": step})
+
+
+def test_tuner_fit_random_search(ray_start_shared, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"lr": tune.grid_search([0.001, 0.1]), "steps": 3},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["lr"] == 0.001
+    df = results.get_dataframe()
+    assert "config/lr" in df.columns and len(df) == 2
+
+
+def test_tuner_asha_10_trials(ray_start_shared, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e0), "steps": 8},
+        tune_config=tune.TuneConfig(
+            num_samples=10, metric="loss", mode="min", seed=42,
+            scheduler=tune.ASHAScheduler(metric="loss", mode="min",
+                                         max_t=8, grace_period=2,
+                                         reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 10
+    assert not results.errors
+    # ASHA must have early-stopped at least one trial.
+    iters = [len(r.metrics_history) for r in results]
+    assert min(iters) < max(iters)
+    best = results.get_best_result()
+    assert best.metrics["loss"] == min(r.metrics["loss"] for r in results
+                                       if "loss" in r.metrics)
+
+
+def test_tuner_checkpoint_and_restore(ray_start_shared, tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.config import RunConfig
+
+    def ckpt_trainable(config):
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 4):
+            tune.report({"loss": 1.0 / (step + 1), "step": step},
+                        checkpoint=Checkpoint.from_dict({"step": step}))
+
+    exp = str(tmp_path / "resume_exp")
+    run = RunConfig(name="resume_exp", storage_path=str(tmp_path))
+    tuner = tune.Tuner(ckpt_trainable,
+                       param_space={"x": tune.grid_search([1, 2])},
+                       tune_config=tune.TuneConfig(metric="loss", mode="min"),
+                       run_config=run)
+    results = tuner.fit()
+    assert not results.errors
+    assert tune.Tuner.can_restore(exp)
+
+    # Restore: finished trials stay finished; no errors on refit.
+    restored = tune.Tuner.restore(exp, ckpt_trainable)
+    results2 = restored.fit()
+    assert len(results2) == 2
+    assert not results2.errors
+    for r in results2:
+        assert r.checkpoint is not None
+        assert r.checkpoint.to_dict()["step"] == 3
+
+
+def test_trainer_as_trainable_through_tuner(ray_start_shared, tmp_path):
+    """Train -> Tune integration (reference: base_trainer constructs a Tuner)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.backend import JaxConfig
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        session.report({"loss": config.get("lr", 1.0)})
+
+    trainer = JaxTrainer(
+        loop,
+        jax_config=JaxConfig(distributed=False),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="tt", storage_path=str(tmp_path / "inner")),
+    )
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.3, 0.7])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="tt_exp", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert not results.errors, results.errors
+    assert results.get_best_result().config["lr"] == 0.3
+
+
+def test_pbt_exploits(ray_start_shared, tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.config import RunConfig
+
+    def pbt_trainable(config):
+        ckpt = tune.get_checkpoint()
+        score = ckpt.to_dict()["score"] if ckpt else 0.0
+        for _ in range(8):
+            score += config["rate"]
+            tune.report({"score": score},
+                        checkpoint=Checkpoint.from_dict({"score": score}))
+
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"rate": [0.1, 1.0]}, seed=0)
+    run = RunConfig(name="pbt", storage_path=str(tmp_path))
+    results = tune.Tuner(
+        pbt_trainable,
+        param_space={"rate": tune.grid_search([0.1, 0.1, 1.0, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched),
+        run_config=run,
+    ).fit()
+    assert not results.errors, results.errors
+    best = results.get_best_result()
+    assert best.metrics["score"] > 0
