@@ -176,6 +176,44 @@ def bench_core(quick: bool) -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# PPO: env throughput + learner SPS (BASELINE.json north-star #2)
+# --------------------------------------------------------------------------- #
+
+
+def bench_ppo(quick: bool) -> dict:
+    from ray_tpu.rllib import PPO, PPOConfig
+
+    minibatch = 256
+    algo = PPO(PPOConfig(
+        env="CartPole-v1",
+        num_rollout_workers=1 if quick else 2,
+        num_envs_per_worker=8 if quick else 16,
+        rollout_fragment_length=64 if quick else 128,
+        num_sgd_iter=4 if quick else 8,
+        sgd_minibatch_size=minibatch,
+        rollout_platform="cpu",
+    ))
+    try:
+        algo.train()  # warm compile
+        iters = 2 if quick else 4
+        t0 = time.perf_counter()
+        timesteps0 = algo._timesteps
+        sgd_total = 0
+        learn_s = 0.0
+        for _ in range(iters):
+            m = algo.train()
+            sgd_total += m.get("sgd_steps", 0)
+            learn_s += m.get("learn_s", 0.0)
+        dt = time.perf_counter() - t0
+        steps = algo._timesteps - timesteps0
+        return {
+            "ppo_env_steps_per_s": steps / dt,
+            "ppo_learner_sgd_per_s": sgd_total / learn_s if learn_s else 0.0,
+            "ppo_learner_steps_per_s":
+                sgd_total * minibatch / learn_s if learn_s else 0.0,
+        }
+    finally:
+        algo.stop()
 
 
 def main():
@@ -183,6 +221,7 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--skip-core", action="store_true")
     ap.add_argument("--skip-train", action="store_true")
+    ap.add_argument("--skip-ppo", action="store_true")
     args = ap.parse_args()
 
     import ray_tpu
@@ -198,6 +237,11 @@ def main():
             value = float(train_metrics.get("tokens_per_sec", 0.0))
         if not args.skip_core:
             extra.update(bench_core(args.quick))
+        if not args.skip_ppo:
+            try:
+                extra.update(bench_ppo(args.quick))
+            except Exception as e:  # noqa: BLE001
+                extra["ppo_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:  # noqa: BLE001
         extra["error"] = f"{type(e).__name__}: {e}"
     finally:

@@ -1,0 +1,29 @@
+"""JAX platform pinning for worker processes.
+
+`JAX_PLATFORMS` alone is not enough in managed environments: a
+sitecustomize may register an accelerator plugin at interpreter start and
+overwrite `jax_platforms` (observed: "axon,cpu" forced by the TPU relay's
+sitecustomize). `RAY_TPU_JAX_PLATFORM` is this framework's knob — actors
+and workers that are about to touch jax call `apply_jax_platform_env()`
+first, which re-pins the config (safe any time before backend init).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env():
+    platform = os.environ.get("RAY_TPU_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to pin jax platform to %r — this process may grab "
+                "an accelerator another process owns", platform,
+                exc_info=True)

@@ -1,0 +1,25 @@
+"""ray_tpu.rllib: reinforcement learning on the new-stack architecture.
+
+Equivalent of the reference's RLModule/Learner/LearnerGroup/RolloutWorker
+stack (`rllib/core/`, `rllib/evaluation/` — the new stack only, per
+SURVEY.md §7 "keep the new stack only"), with the torch/DDP learner replaced
+by a jitted JAX learner.
+"""
+
+from ray_tpu.rllib.env import (
+    CartPoleVectorEnv,
+    GymnasiumVectorEnv,
+    VectorEnv,
+    make_env,
+)
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rllib.rl_module import DiscretePolicyModule, RLModule, SpecDict
+from ray_tpu.rllib.rollout import RolloutWorker, WorkerSet
+
+__all__ = [
+    "VectorEnv", "CartPoleVectorEnv", "GymnasiumVectorEnv", "make_env",
+    "RLModule", "DiscretePolicyModule", "SpecDict",
+    "Learner", "LearnerGroup", "RolloutWorker", "WorkerSet",
+    "PPO", "PPOConfig", "PPOLearner",
+]

@@ -1,0 +1,157 @@
+"""Vectorized environments for rollout workers.
+
+Equivalent of the reference's env layer (`rllib/env/vector_env.py`) reduced
+to the batch-first protocol the sampler needs:
+
+    reset() -> obs [n_envs, obs_dim]
+    step(actions [n_envs]) -> (obs, rewards, dones, infos)
+
+with auto-reset on termination (done envs restart; the returned obs is the
+fresh episode's first observation, reference `VectorEnv` semantics).
+
+`CartPoleVectorEnv` is a pure-numpy vectorized CartPole (dynamics per the
+classic Barto-Sutton-Anderson formulation) — the sampler hot loop stays in
+numpy instead of stepping n Python envs. `GymnasiumVectorEnv` adapts any
+gymnasium env id.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    n_envs: int
+    obs_dim: int
+    n_actions: int
+    max_episode_steps: int = 500
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """Numpy-vectorized CartPole-v1 (same constants as gymnasium's)."""
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5           # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * math.pi / 360
+    X_LIMIT = 2.4
+
+    def __init__(self, n_envs: int = 8, seed: int = 0,
+                 max_episode_steps: int = 500):
+        self.n_envs = n_envs
+        self.obs_dim = 4
+        self.n_actions = 2
+        self.max_episode_steps = max_episode_steps
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((n_envs, 4), dtype=np.float64)
+        self._steps = np.zeros(n_envs, dtype=np.int64)
+        self._total_mass = self.MASSPOLE + self.MASSCART
+        self._polemass_length = self.MASSPOLE * self.LENGTH
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, size=(self.n_envs, 4))
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def _reset_envs(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._state[mask] = self._rng.uniform(-0.05, 0.05, size=(n, 4))
+            self._steps[mask] = 0
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = np.cos(theta)
+        sintheta = np.sin(theta)
+        temp = (force + self._polemass_length * theta_dot ** 2 * sintheta
+                ) / self._total_mass
+        theta_acc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASSPOLE * costheta ** 2 / self._total_mass))
+        x_acc = temp - self._polemass_length * theta_acc * costheta \
+            / self._total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * theta_acc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+
+        terminated = (np.abs(x) > self.X_LIMIT) | \
+            (np.abs(theta) > self.THETA_LIMIT)
+        truncated = (self._steps >= self.max_episode_steps) & ~terminated
+        dones = terminated | truncated
+        rewards = np.ones(self.n_envs, dtype=np.float32)
+        # Auto-reset finished episodes; the truncated flag marks boundaries
+        # where GAE should bootstrap V(next). Termination takes precedence
+        # when both land on the same step (gymnasium/RLlib semantics).
+        infos = {"truncated": truncated.copy()}
+        self._reset_envs(dones)
+        return (self._state.astype(np.float32), rewards, dones, infos)
+
+
+class GymnasiumVectorEnv(VectorEnv):
+    """Adapter over `gymnasium.make_vec` for arbitrary env ids."""
+
+    def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0, **kw):
+        import gymnasium as gym
+
+        # SAME_STEP autoreset so the obs returned at a done step is the new
+        # episode's first observation (gymnasium 1.x defaults to NEXT_STEP,
+        # which would inject a bogus no-op transition after every episode).
+        # Native vector entry points reject vector_kwargs, so pin the sync
+        # vectorizer, which honors autoreset_mode.
+        try:
+            kw.setdefault("vectorization_mode", "sync")
+            kw.setdefault("vector_kwargs",
+                          {"autoreset_mode": gym.vector.AutoresetMode.SAME_STEP})
+        except AttributeError:
+            pass  # older gymnasium: same-step is already the behavior
+        self._env = gym.make_vec(env_id, num_envs=n_envs, **kw)
+        self.n_envs = n_envs
+        space = self._env.single_observation_space
+        self.obs_dim = int(np.prod(space.shape))
+        self.n_actions = int(self._env.single_action_space.n)
+        self._seed = seed
+        spec = getattr(self._env, "spec", None)
+        self.max_episode_steps = getattr(spec, "max_episode_steps", 500) or 500
+
+    def reset(self) -> np.ndarray:
+        obs, _ = self._env.reset(seed=self._seed)
+        return obs.reshape(self.n_envs, -1).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        obs, rewards, terminated, truncated, infos = self._env.step(actions)
+        terminated = np.asarray(terminated)
+        truncated = np.asarray(truncated) & ~terminated  # termination wins
+        dones = terminated | truncated
+        return (obs.reshape(self.n_envs, -1).astype(np.float32),
+                np.asarray(rewards, dtype=np.float32), dones,
+                {"truncated": truncated})
+
+
+def make_env(env: Any, n_envs: int, seed: int = 0) -> VectorEnv:
+    """env may be a VectorEnv factory, a VectorEnv, or a gymnasium id."""
+    if isinstance(env, VectorEnv):
+        return env
+    if callable(env):
+        out = env(n_envs=n_envs, seed=seed)
+        assert isinstance(out, VectorEnv)
+        return out
+    if env in ("CartPole-v1", "CartPole"):
+        return CartPoleVectorEnv(n_envs=n_envs, seed=seed)
+    return GymnasiumVectorEnv(env, n_envs=n_envs, seed=seed)

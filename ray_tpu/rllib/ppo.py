@@ -1,0 +1,263 @@
+"""PPO on the new stack: config -> algorithm -> learner loss.
+
+Equivalent of the reference's `rllib/algorithms/ppo/ppo.py:368,394`
+(`PPOConfig`, `PPO.training_step`) and the clip-surrogate loss of
+`ppo_torch_policy.py`, on the jitted JAX Learner: sample via WorkerSet,
+GAE + standardized advantages, minibatch SGD epochs on the learner (the
+XLA-compiled hot loop), then weight broadcast back to workers.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+from ray_tpu.rllib.rollout import WorkerSet
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PPOConfig:
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 64
+    train_batch_size: int = 0          # 0 = workers * envs * fragment
+    sgd_minibatch_size: int = 256
+    num_sgd_iter: int = 8
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    kl_target: float = 0.2
+    grad_clip: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    learner_mode: str = "local"        # local | remote
+    learner_resources: Optional[Dict[str, float]] = None
+    num_cpus_per_worker: float = 0.4
+    # Pin sampler processes to a jax platform ("cpu" keeps the chip free
+    # for the learner); None inherits the ambient platform.
+    rollout_platform: Optional[str] = "cpu"
+
+    # Fluent API parity with the reference's AlgorithmConfig builder.
+    def environment(self, env) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None) -> "PPOConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for k, v in kwargs.items():
+            key = "lambda_" if k == "lambda" else k
+            if not hasattr(self, key):
+                raise ValueError(f"unknown PPO option {k}")
+            setattr(self, key, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPOLearner(Learner):
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        out = self.module.forward_train(params, batch)
+        logp_ratio = jnp.exp(out["logp"] - batch[sb.LOGP])
+        advantages = batch[sb.ADVANTAGES]
+        surrogate = jnp.minimum(
+            advantages * logp_ratio,
+            advantages * jnp.clip(logp_ratio, 1 - cfg.clip_param,
+                                  1 + cfg.clip_param))
+        policy_loss = -jnp.mean(surrogate)
+        # Clipped value loss (reference ppo_torch_policy vf_clip_param).
+        vf = out["vf"]
+        vf_old = batch[sb.VF_PREDS]
+        vf_clipped = vf_old + jnp.clip(vf - vf_old, -cfg.vf_clip_param,
+                                       cfg.vf_clip_param)
+        vf_loss = jnp.mean(jnp.maximum(
+            (vf - batch[sb.VALUE_TARGETS]) ** 2,
+            (vf_clipped - batch[sb.VALUE_TARGETS]) ** 2))
+        entropy = jnp.mean(out["entropy"])
+        kl = jnp.mean(batch[sb.LOGP] - out["logp"])
+        loss = policy_loss + cfg.vf_loss_coeff * vf_loss \
+            - cfg.entropy_coeff * entropy
+        return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                      "entropy": entropy, "kl": kl}
+
+
+class PPO:
+    """The Algorithm: train() runs one iteration (reference
+    `Algorithm.train` -> `PPO.training_step`)."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        if config.train_batch_size:
+            # Derive the per-worker fragment so one sampling round yields
+            # the configured train batch (reference train_batch_size).
+            per_step = config.num_rollout_workers * config.num_envs_per_worker
+            config.rollout_fragment_length = max(
+                1, config.train_batch_size // per_step)
+        self.workers = WorkerSet(
+            config.env, num_workers=config.num_rollout_workers,
+            n_envs=config.num_envs_per_worker, hidden=config.hidden,
+            seed=config.seed,
+            num_cpus_per_worker=config.num_cpus_per_worker,
+            jax_platform=config.rollout_platform)
+        spec = self.workers.env_spec()
+        module = DiscretePolicyModule(
+            SpecDict(spec["obs_dim"], spec["n_actions"]),
+            hidden=config.hidden)
+        self.learner_group = LearnerGroup(
+            lambda: PPOLearner(module, config, seed=config.seed),
+            mode=config.learner_mode,
+            resources=config.learner_resources)
+        self.workers.sync_weights(self.learner_group.get_weights())
+        self.iteration = 0
+        self._timesteps = 0
+        self._rng = np.random.default_rng(config.seed)
+
+    # ------------------------------------------------------------- training
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        raw_batches = self.workers.sample(cfg.rollout_fragment_length)
+        sample_s = time.perf_counter() - t0
+
+        processed = [self._postprocess(b) for b in raw_batches]
+        batch = sb.concat_batches(processed)
+        batch[sb.ADVANTAGES] = sb.standardize(batch[sb.ADVANTAGES])
+        self._timesteps += sb.batch_size(batch)
+
+        t1 = time.perf_counter()
+        metrics: Dict[str, float] = {}
+        sgd_steps = 0
+        for _ in range(cfg.num_sgd_iter):
+            shuffled = sb.shuffle_batch(batch, self._rng)
+            for mb in sb.minibatches(shuffled, cfg.sgd_minibatch_size):
+                if sb.batch_size(mb) < 2:
+                    continue
+                metrics = self.learner_group.update(self._learner_view(mb))
+                sgd_steps += 1
+            if metrics.get("kl", 0.0) > cfg.kl_target:
+                break  # early stop like the reference's KL guard
+        learn_s = time.perf_counter() - t1
+        self.workers.sync_weights(self.learner_group.get_weights())
+        return {"sample_s": sample_s, "learn_s": learn_s,
+                "sgd_steps": sgd_steps, **metrics}
+
+    @staticmethod
+    def _learner_view(mb: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {k: v for k, v in mb.items()
+                if not k.startswith("_") and k not in (sb.DONES, sb.TRUNCATEDS,
+                                                       sb.REWARDS)}
+
+    def _postprocess(self, batch: Dict[str, np.ndarray]
+                     ) -> Dict[str, np.ndarray]:
+        cfg = self.config
+        T, n = batch.pop("_shape")
+        rewards = batch[sb.REWARDS].reshape(T, n)
+        values = batch[sb.VF_PREDS].reshape(T, n)
+        dones = batch[sb.DONES].reshape(T, n)
+        truncs = batch[sb.TRUNCATEDS].reshape(T, n)
+        next_values = batch.pop("_next_vf").reshape(T, n)
+        adv, targets = sb.compute_gae(rewards, values, dones, truncs,
+                                      next_values, gamma=cfg.gamma,
+                                      lam=cfg.lambda_)
+        batch[sb.ADVANTAGES] = adv.reshape(-1)
+        batch[sb.VALUE_TARGETS] = targets.reshape(-1)
+        return batch
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        step_metrics = self.training_step()
+        stats = self.workers.episode_stats()
+        rewards = [s["episode_reward_mean"] for s in stats
+                   if s["episode_reward_mean"] is not None]
+        lens = [s["episode_len_mean"] for s in stats
+                if s["episode_len_mean"] is not None]
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else None,
+            "episode_len_mean": float(np.mean(lens)) if lens else None,
+            **step_metrics,
+        }
+
+    # --------------------------------------------------------- checkpointing
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm.pkl"), "wb") as f:
+            pickle.dump({"learner": self.learner_group.get_state(),
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps}, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+        self.workers.sync_weights(self.learner_group.get_weights())
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self):
+        self.workers.shutdown()
+        self.learner_group.shutdown()
+
+    # ------------------------------------------------------- Tune trainable
+
+    @staticmethod
+    def as_trainable(base_config: PPOConfig) -> Callable:
+        def trainable(config: Dict[str, Any]):
+            import copy
+
+            from ray_tpu import tune
+
+            cfg = copy.deepcopy(base_config)
+            for k, v in (config or {}).items():
+                key = "lambda_" if k == "lambda" else k
+                if hasattr(cfg, key):
+                    setattr(cfg, key, v)
+            algo = PPO(cfg)
+            try:
+                while True:
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        trainable.__name__ = "PPO"
+        return trainable

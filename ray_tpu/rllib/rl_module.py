@@ -1,0 +1,115 @@
+"""RLModule: the neural-network abstraction of the new RLlib stack, in flax.
+
+Equivalent of the reference's `RLModule.forward_{inference,exploration,train}`
+(`rllib/core/rl_module/rl_module.py:215,383-427`) — redesigned functionally:
+a module owns its flax model and exposes pure functions over an explicit
+params pytree, so the Learner can jit/grad them and rollout workers can run
+them with synced host params.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SpecDict:
+    obs_dim: int
+    n_actions: int
+
+
+class _PolicyValueNet(nn.Module):
+    """Shared torso -> (logits, value) heads (reference Catalog's default
+    fcnet encoder + pi/vf heads)."""
+
+    hidden: Sequence[int]
+    n_actions: int
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(jnp.float32)
+        for i, width in enumerate(self.hidden):
+            x = nn.Dense(width, name=f"torso_{i}",
+                         kernel_init=nn.initializers.orthogonal(np.sqrt(2)))(x)
+            x = nn.tanh(x)
+        logits = nn.Dense(self.n_actions, name="pi",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        value = nn.Dense(1, name="vf",
+                         kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return logits, value[..., 0]
+
+
+class RLModule:
+    """Base class; subclasses define the flax model + forward semantics."""
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs, rng) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class DiscretePolicyModule(RLModule):
+    """Categorical-action policy+value module (PPO's default)."""
+
+    def __init__(self, spec: SpecDict, hidden: Sequence[int] = (64, 64)):
+        self.spec = spec
+        self.model = _PolicyValueNet(hidden=tuple(hidden),
+                                     n_actions=spec.n_actions)
+        self._sample = jax.jit(self._sample_impl)
+        self._greedy = jax.jit(self._greedy_impl)
+
+    def init_params(self, rng) -> Any:
+        obs = jnp.zeros((1, self.spec.obs_dim), jnp.float32)
+        return self.model.init(rng, obs)
+
+    # -- pure functions (jit-safe) -------------------------------------------
+
+    def forward_train(self, params, batch):
+        logits, value = self.model.apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return {"logits": logits, "vf": value, "logp": logp,
+                "entropy": entropy}
+
+    def _sample_impl(self, params, obs, rng):
+        logits, value = self.model.apply(params, obs)
+        actions = jax.random.categorical(rng, logits)
+        logp = jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                   actions[..., None], axis=-1)[..., 0]
+        return actions, logp, value
+
+    def _greedy_impl(self, params, obs):
+        logits, value = self.model.apply(params, obs)
+        return jnp.argmax(logits, axis=-1), value
+
+    # -- convenience wrappers -------------------------------------------------
+
+    def forward_exploration(self, params, obs, rng):
+        actions, logp, value = self._sample(params, obs, rng)
+        return {"actions": actions, "logp": logp, "vf": value}
+
+    def forward_inference(self, params, obs):
+        actions, value = self._greedy(params, obs)
+        return {"actions": actions, "vf": value}
+
+    def get_state(self, params) -> Any:
+        return jax.device_get(params)
+
+    def __reduce__(self):
+        return (DiscretePolicyModule, (self.spec, tuple(self.model.hidden)))

@@ -1,0 +1,188 @@
+"""RolloutWorker / WorkerSet: the sampling half of the algorithm.
+
+Equivalent of the reference's `RolloutWorker.sample`
+(`rllib/evaluation/rollout_worker.py:166,879`) + `WorkerSet`
+(`worker_set.py:79`, `sync_weights` :384): each worker steps a vectorized
+env with the exploration policy, records [T, n_envs] trajectories, computes
+per-step next-state values for GAE bootstrapping, and returns a flat
+SampleBatch. Workers run as actors; sampling fans out with one task each.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+
+logger = logging.getLogger(__name__)
+
+
+class RolloutWorker:
+    """Stateful sampler: keeps env state between sample() calls so rollout
+    fragments stitch into full episodes across iterations."""
+
+    def __init__(self, env: Any, n_envs: int = 8, seed: int = 0,
+                 hidden=(64, 64), module: Optional[Any] = None,
+                 jax_platform: Optional[str] = None):
+        import os
+
+        from ray_tpu._jax_env import apply_jax_platform_env
+
+        if jax_platform:
+            # Samplers are tiny MLP forwards: pin them to host CPU so the
+            # chip belongs to the learner (one JAX process per chip —
+            # SURVEY.md §7 TPU process model).
+            os.environ["RAY_TPU_JAX_PLATFORM"] = jax_platform
+        apply_jax_platform_env()
+        import jax
+
+        self.env = make_env(env, n_envs=n_envs, seed=seed)
+        self.module = module or DiscretePolicyModule(
+            SpecDict(self.env.obs_dim, self.env.n_actions), hidden=hidden)
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._rng = jax.random.PRNGKey(seed + 1000)
+        self._obs = self.env.reset()
+        # Episode-return tracking (for episode_reward_mean).
+        self._ep_returns = np.zeros(self.env.n_envs, dtype=np.float64)
+        self._ep_lens = np.zeros(self.env.n_envs, dtype=np.int64)
+        self._completed: List[float] = []
+        self._completed_lens: List[int] = []
+
+    def set_weights(self, weights: Any):
+        self.params = weights
+
+    def env_spec(self) -> Dict[str, int]:
+        return {"obs_dim": self.env.obs_dim, "n_actions": self.env.n_actions,
+                "n_envs": self.env.n_envs}
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect `num_steps` env steps (x n_envs transitions), flattened."""
+        import jax
+
+        n = self.env.n_envs
+        obs_buf = np.empty((num_steps, n, self.env.obs_dim), dtype=np.float32)
+        act_buf = np.empty((num_steps, n), dtype=np.int64)
+        rew_buf = np.empty((num_steps, n), dtype=np.float32)
+        done_buf = np.empty((num_steps, n), dtype=bool)
+        trunc_buf = np.empty((num_steps, n), dtype=bool)
+        logp_buf = np.empty((num_steps, n), dtype=np.float32)
+        vf_buf = np.empty((num_steps, n), dtype=np.float32)
+        next_vf_buf = np.empty((num_steps, n), dtype=np.float32)
+
+        obs = self._obs
+        for t in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            out = self.module.forward_exploration(self.params, obs, key)
+            actions = np.asarray(out["actions"])
+            next_obs, rewards, dones, infos = self.env.step(actions)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            trunc_buf[t] = infos.get("truncated", np.zeros(n, dtype=bool))
+            logp_buf[t] = np.asarray(out["logp"])
+            vf_buf[t] = np.asarray(out["vf"])
+            # V(next_obs): needed for GAE deltas; auto-reset means next_obs
+            # at a done step is the NEW episode's obs, but for terminated
+            # steps GAE zeroes the bootstrap so only truncation uses this
+            # (approximation: value of the reset obs; the reference stores
+            # the true final obs — CartPole truncation values are near-
+            # identical and this keeps the hot loop allocation-free).
+            self._ep_returns += rewards
+            self._ep_lens += 1
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._completed_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+            obs = next_obs
+        self._obs = obs
+
+        # One batched value pass for all next-state values: V(s_{t+1}) is
+        # V(s_t) shifted, with the tail row evaluated on the final obs.
+        next_vf_buf[:-1] = vf_buf[1:]
+        tail = self.module.forward_inference(self.params, obs)
+        next_vf_buf[-1] = np.asarray(tail["vf"])
+        # At done steps the shifted value belongs to the next episode; GAE
+        # masks terminated steps, and truncated steps use the reset-obs value
+        # (see note above).
+
+        batch = {
+            sb.OBS: obs_buf.reshape(num_steps * n, -1),
+            sb.ACTIONS: act_buf.reshape(-1),
+            sb.REWARDS: rew_buf.reshape(-1),
+            sb.DONES: done_buf.reshape(-1),
+            sb.TRUNCATEDS: trunc_buf.reshape(-1),
+            sb.LOGP: logp_buf.reshape(-1),
+            sb.VF_PREDS: vf_buf.reshape(-1),
+            "_next_vf": next_vf_buf.reshape(-1),
+            "_shape": np.array([num_steps, n]),
+        }
+        return batch
+
+    def episode_stats(self, clear: bool = True) -> Dict[str, Any]:
+        stats = {
+            "episodes": len(self._completed),
+            "episode_reward_mean": float(np.mean(self._completed))
+            if self._completed else None,
+            "episode_len_mean": float(np.mean(self._completed_lens))
+            if self._completed_lens else None,
+        }
+        if clear:
+            self._completed = self._completed[-100:]
+            self._completed_lens = self._completed_lens[-100:]
+        return stats
+
+
+class WorkerSet:
+    """N rollout-worker actors + weight broadcast (reference worker_set.py)."""
+
+    def __init__(self, env: Any, num_workers: int = 2, n_envs: int = 8,
+                 hidden=(64, 64), seed: int = 0,
+                 num_cpus_per_worker: float = 0.5,
+                 jax_platform: Optional[str] = None):
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            actor_cls.options(num_cpus=num_cpus_per_worker).remote(
+                env, n_envs=n_envs, seed=seed + i, hidden=tuple(hidden),
+                jax_platform=jax_platform)
+            for i in range(num_workers)]
+        self.num_workers = num_workers
+
+    def sync_weights(self, weights: Any):
+        import ray_tpu
+
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([w.set_weights.remote(ref) for w in self.workers])
+
+    def sample(self, steps_per_worker: int) -> List[Dict[str, np.ndarray]]:
+        import ray_tpu
+
+        return ray_tpu.get([w.sample.remote(steps_per_worker)
+                            for w in self.workers])
+
+    def episode_stats(self) -> List[Dict[str, Any]]:
+        import ray_tpu
+
+        return ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+
+    def env_spec(self) -> Dict[str, int]:
+        import ray_tpu
+
+        return ray_tpu.get(self.workers[0].env_spec.remote())
+
+    def shutdown(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -13,6 +13,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Worker subprocesses: sitecustomize may force an accelerator platform at
+# interpreter start; this framework knob re-pins them to CPU (see
+# ray_tpu/_jax_env.py).
+os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"
 
 # Worker subprocesses must resolve functions defined in test modules (pytest
 # puts tests/ on the driver's sys.path; spawned workers inherit PYTHONPATH).
