@@ -38,8 +38,8 @@ class GPT2Config:
     use_flash: bool = True
     use_ring: bool = False           # sequence parallelism (sp axis)
     remat: bool = False              # jax.checkpoint each block
-    flash_block_q: int = 512
-    flash_block_k: int = 512
+    flash_block_q: int = 0   # 0 = pick_block_sizes auto heuristic
+    flash_block_k: int = 0
 
     @staticmethod
     def small() -> "GPT2Config":

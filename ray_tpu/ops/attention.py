@@ -1,20 +1,25 @@
-"""Flash attention: Pallas TPU kernel with online softmax.
+"""Flash attention: Pallas TPU kernels, forward AND backward.
 
-Net-new TPU capability (the reference has no kernel code — SURVEY.md §5.7):
-a blocked attention forward that never materializes the S x S score matrix.
-Blocks of Q sit in VMEM while K/V blocks stream through the innermost grid
-dimension with running (max, denominator, accumulator) statistics; causal
-blocks above the diagonal are skipped entirely.
+Net-new TPU capability (the reference has no kernel code — SURVEY.md §5.7).
+Forward: blocked online softmax, never materializing the S x S score
+matrix; saves per-row logsumexp for the backward. Backward: two blocked
+kernels (dQ with K/V streaming; dK/dV with Q streaming) recomputing
+probabilities from the saved logsumexp — memory stays O(block^2) for
+training too, which is the whole point for long context.
 
-Training uses a custom VJP whose backward recomputes attention under XLA
-(flash-style backward kernel lands later; the forward is the inference and
-benchmark hot path).
+Layout: q,k,v [batch, heads, seq, head_dim]; grids put batch*heads and the
+output-block dim as parallel dimensions and stream the contraction dim as
+the innermost "arbitrary" dim with VMEM scratch accumulators.
+
+Set RAY_TPU_PALLAS_INTERPRET=1 to run the kernels in interpreter mode on
+CPU (used by tests to cover kernel logic without a chip).
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -22,6 +27,10 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 _STATS_LANES = 128  # TPU lane width: stats scratch is (block_q, 128)
+
+
+def _interpret() -> bool:
+    return os.environ.get("RAY_TPU_PALLAS_INTERPRET") == "1"
 
 
 def mha_reference(q, k, v, causal: bool = True,
@@ -38,8 +47,13 @@ def mha_reference(q, k, v, causal: bool = True,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+# --------------------------------------------------------------------------- #
+# Forward kernel
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -87,10 +101,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        # Row stats stored 1-wide: lse is (bh, seq) in HBM, not broadcast
+        # over lanes (the long-context residual must stay O(seq)).
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(denom[:, 0])).astype(jnp.float32)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
-                   block_q: int, block_k: int) -> jax.Array:
+                   block_q: int, block_k: int):
+    """Returns (out [b,h,sq,d], lse [bh, sq, LANES])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -102,9 +120,9 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     v3 = v.reshape(bh, seq_k, d)
     nq = pl.cdiv(seq_q, block_q)
     nk = pl.cdiv(seq_k, block_k)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -112,8 +130,14 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
@@ -122,17 +146,230 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
+        interpret=_interpret(),
     )(q3, k3, v3)
-    return out.reshape(batch, heads, seq_q, d)
+    return out.reshape(batch, heads, seq_q, d), lse
+
+
+# --------------------------------------------------------------------------- #
+# Backward kernels
+# --------------------------------------------------------------------------- #
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                      # [bq, 1]
+        delta = delta_ref[0][:, None]                  # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                  # [bq, bk]
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        def _run():
+            body()
+    else:
+        body()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),           # p^T @ do -> [bk, d]
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                  # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),           # ds^T @ q -> [bk, d]
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Q blocks strictly above the diagonal contribute nothing to this
+        # K block: skip when the last q row < first k row.
+        @pl.when(qi * block_q + (block_q - 1) >= ki * block_k)
+        def _run():
+            body()
+    else:
+        body()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
+                    block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    bh = batch * heads
+    q3 = q.reshape(bh, seq_q, d)
+    k3 = k.reshape(bh, seq_k, d)
+    v3 = v.reshape(bh, seq_k, d)
+    do3 = g.reshape(bh, seq_q, d)
+    # delta_i = rowsum(dO * O) (the softmax-jacobian diagonal term),
+    # broadcast over stats lanes like lse.
+    delta = jnp.sum(do3.astype(jnp.float32)
+                    * out.reshape(bh, seq_q, d).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (bh, seq_q, _STATS_LANES))
+    nq = pl.cdiv(seq_q, block_q)
+    nk = pl.cdiv(seq_k, block_k)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _STATS_LANES),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _STATS_LANES),
+                         lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _STATS_LANES),
+                         lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _STATS_LANES),
+                         lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    shape_q = (batch, heads, seq_q, d)
+    shape_k = (batch, heads, seq_k, d)
+    return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k))
+
+
+# --------------------------------------------------------------------------- #
+# Dispatch + custom VJP
+# --------------------------------------------------------------------------- #
+
+
+def pick_block_sizes(seq: int, d: int) -> tuple:
+    """Block-size heuristic: biggest blocks that fit VMEM comfortably.
+    VMEM budget ~16 MiB; fwd scratch ~ block_q*(2*LANES + d)*4B plus the
+    q/k/v/o blocks. 512 works to d=128; shrink for bigger heads."""
+    if d <= 128:
+        b = 512
+    elif d <= 256:
+        b = 256
+    else:
+        b = 128
+    while seq % b and b > 128:
+        b //= 2
+    return b, b
 
 
 def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
-    try:
-        platform = q.devices().pop().platform if hasattr(q, "devices") else \
-            jax.devices()[0].platform
-    except Exception:
-        platform = jax.default_backend()
-    if platform != "tpu":
+    if _interpret():
+        ok_platform = True
+    else:
+        try:
+            platform = q.devices().pop().platform if hasattr(q, "devices") \
+                else jax.devices()[0].platform
+        except Exception:
+            platform = jax.default_backend()
+        ok_platform = platform == "tpu"
+    if not ok_platform:
         return False
     _, _, seq_q, d = q.shape
     seq_k = k.shape[2]
@@ -147,32 +384,43 @@ def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512) -> jax.Array:
+                    block_q: int = 0, block_k: int = 0) -> jax.Array:
     """Blocked attention. q,k,v: [batch, heads, seq, head_dim].
 
-    Dispatches to the Pallas kernel on TPU (shapes permitting) and the XLA
-    reference elsewhere. Differentiable: backward recomputes via XLA.
+    Dispatches to the Pallas kernels on TPU (shapes permitting; block size 0
+    = auto) and the XLA reference elsewhere. Fully differentiable with a
+    flash backward — training memory stays O(seq * block).
     """
-    return _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
+    return out
 
 
-def _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k):
+def _resolve(q, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     seq = q.shape[2]
-    bq, bk = min(block_q, seq), min(block_k, seq)
+    if not block_q or not block_k:
+        block_q, block_k = pick_block_sizes(seq, q.shape[-1])
+    return scale, min(block_q, seq), min(block_k, seq)
+
+
+def _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k):
+    scale, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas(q, k, bq, bk):
         return _flash_forward(q, k, v, causal, scale, bq, bk)
-    return mha_reference(q, k, v, causal=causal, scale=scale)
+    return mha_reference(q, k, v, causal=causal, scale=scale), None
 
 
 def _attn_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _attn_bwd(causal, scale, block_q, block_k, residuals, g):
-    q, k, v = residuals
+    q, k, v, out, lse = residuals
+    scale_v, bq, bk = _resolve(q, scale, block_q, block_k)
+    if lse is not None and _use_pallas(q, k, bq, bk):
+        return _flash_backward(q, k, v, out, lse, g, causal, scale_v, bq, bk)
     _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale),
                      q, k, v)
     return vjp(g)
