@@ -101,14 +101,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
-        # Row stats stored 1-wide: lse is (bh, seq) in HBM, not broadcast
-        # over lanes (the long-context residual must stay O(seq)).
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(denom[:, 0])).astype(jnp.float32)
+        # Row stats kept lane-broadcast: lse is (bh, seq, LANES) in HBM so
+        # its blocks are (8, 128)-tileable on TPU; the backward kernels read
+        # lane 0. Costs seq*LANES*4B per (b,h) — negligible vs the KV cache
+        # and the price of a layout XLA can tile.
+        lse_ref[0] = m_scr[...] + jnp.log(
+            jnp.maximum(l_scr[...], 1e-30))
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
                    block_q: int, block_k: int):
-    """Returns (out [b,h,sq,d], lse [bh, sq, LANES])."""
+    """Returns (out [b,h,sq,d], lse [bh, sq, 1]).
+
+    The kernel writes lse lane-broadcast as (bh, sq, LANES) so its blocks
+    are (8,128)-tileable, but only lane 0 is returned — the saved training
+    residual stays O(seq), not O(seq*128); the backward re-broadcasts
+    transiently."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -132,11 +140,12 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _STATS_LANES),
+                         lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, _STATS_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
@@ -148,7 +157,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
         ),
         interpret=_interpret(),
     )(q3, k3, v3)
-    return out.reshape(batch, heads, seq_q, d), lse
+    return out.reshape(batch, heads, seq_q, d), lse[..., :1]
 
 
 # --------------------------------------------------------------------------- #
@@ -173,8 +182,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]                      # [bq, 1]
-        delta = delta_ref[0][:, None]                  # [bq, 1]
+        lse = lse_ref[0][:, :1]                        # [bq, 1] (lane 0)
+        delta = delta_ref[0][:, :1]                    # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -221,8 +230,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                        # lane 0
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -270,11 +279,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
     v3 = v.reshape(bh, seq_k, d)
     do3 = g.reshape(bh, seq_q, d)
     # delta_i = rowsum(dO * O) (the softmax-jacobian diagonal term),
-    # broadcast over stats lanes like lse.
+    # broadcast over stats lanes like lse. Both broadcasts are transient
+    # kernel inputs, not saved residuals.
     delta = jnp.sum(do3.astype(jnp.float32)
                     * out.reshape(bh, seq_q, d).astype(jnp.float32),
                     axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta, (bh, seq_q, _STATS_LANES))
+    lse = jnp.broadcast_to(lse, (bh, seq_q, _STATS_LANES))
     nq = pl.cdiv(seq_q, block_q)
     nk = pl.cdiv(seq_k, block_k)
 
@@ -347,16 +358,60 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
 def pick_block_sizes(seq: int, d: int) -> tuple:
     """Block-size heuristic: biggest blocks that fit VMEM comfortably.
     VMEM budget ~16 MiB; fwd scratch ~ block_q*(2*LANES + d)*4B plus the
-    q/k/v/o blocks. 512 works to d=128; shrink for bigger heads."""
+    q/k/v/o blocks. Asymmetric q=512/k=1024 measured fastest on v5e for
+    d<=128 (fewer grid steps on the streamed contraction dim); shrink for
+    bigger heads."""
     if d <= 128:
-        b = 512
+        bq, bk = 512, 1024
     elif d <= 256:
-        b = 256
+        bq, bk = 256, 256
     else:
-        b = 128
-    while seq % b and b > 128:
-        b //= 2
-    return b, b
+        bq, bk = 128, 128
+    while seq % bq and bq > 128:
+        bq //= 2
+    while seq % bk and bk > 128:
+        bk //= 2
+    return bq, bk
+
+
+_PALLAS_STATUS: dict = {}  # (platform, bq, bk, d, dtype) -> bool
+
+
+def _pallas_selfcheck(platform: str, block_q: int, block_k: int,
+                      d: int, dtype) -> bool:
+    """Compile+run the kernels once at the exact production configuration
+    (block sizes, head dim, dtype); on any failure disable the Pallas path
+    for that configuration. A lowering bug must degrade to the XLA
+    fallback, never take down training (round-2 postmortem).
+
+    The probe runs in a fresh thread: JAX's trace state is thread-local, so
+    this executes eagerly (and can really catch compile errors) even when
+    the caller is mid-trace inside the user's jit."""
+    key = (platform, block_q, block_k, d, jnp.dtype(dtype).name)
+    if key in _PALLAS_STATUS:
+        return _PALLAS_STATUS[key]
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            seq = max(2 * block_k, 2 * block_q)
+            q = jnp.ones((1, 1, seq, d), dtype)
+            out, lse = _flash_forward(q, q, q, True, 0.125,
+                                      block_q, block_k)
+            grads = _flash_backward(q, q, q, out, lse, out, True, 0.125,
+                                    block_q, block_k)
+            jax.block_until_ready(grads)
+            result["ok"] = True
+        except Exception:  # noqa: BLE001 — any lowering/runtime error
+            result["ok"] = False
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join()
+    _PALLAS_STATUS[key] = result.get("ok", False)
+    return _PALLAS_STATUS[key]
 
 
 def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
@@ -368,7 +423,8 @@ def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
                 else jax.devices()[0].platform
         except Exception:
             platform = jax.default_backend()
-        ok_platform = platform == "tpu"
+        ok_platform = platform == "tpu" and _pallas_selfcheck(
+            platform, block_q, block_k, q.shape[-1], q.dtype)
     if not ok_platform:
         return False
     _, _, seq_q, d = q.shape

@@ -82,7 +82,7 @@ def test_pallas_kernels_interpret_mode(monkeypatch):
 def test_pick_block_sizes():
     from ray_tpu.ops.attention import pick_block_sizes
 
-    assert pick_block_sizes(4096, 64) == (512, 512)
+    assert pick_block_sizes(4096, 64) == (512, 1024)
     assert pick_block_sizes(4096, 256) == (256, 256)
     bq, bk = pick_block_sizes(384, 64)
     assert 384 % bq == 0
