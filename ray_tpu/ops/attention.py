@@ -375,10 +375,17 @@ def pick_block_sizes(seq: int, d: int) -> tuple:
 
 
 _PALLAS_STATUS: dict = {}  # (platform, bq, bk, d, dtype) -> bool
+_PALLAS_ERRORS: dict = {}  # same key -> repr of the probe failure
+
+
+def pallas_status() -> dict:
+    """Observability for the kernel self-check: {config-key: ok} plus any
+    probe errors. Empty until the first TPU dispatch attempt."""
+    return {"status": dict(_PALLAS_STATUS), "errors": dict(_PALLAS_ERRORS)}
 
 
 def _pallas_selfcheck(platform: str, block_q: int, block_k: int,
-                      d: int, dtype) -> bool:
+                      d: int, dtype, causal: bool) -> bool:
     """Compile+run the kernels once at the exact production configuration
     (block sizes, head dim, dtype); on any failure disable the Pallas path
     for that configuration. A lowering bug must degrade to the XLA
@@ -387,7 +394,7 @@ def _pallas_selfcheck(platform: str, block_q: int, block_k: int,
     The probe runs in a fresh thread: JAX's trace state is thread-local, so
     this executes eagerly (and can really catch compile errors) even when
     the caller is mid-trace inside the user's jit."""
-    key = (platform, block_q, block_k, d, jnp.dtype(dtype).name)
+    key = (platform, block_q, block_k, d, jnp.dtype(dtype).name, causal)
     if key in _PALLAS_STATUS:
         return _PALLAS_STATUS[key]
     import threading
@@ -398,23 +405,34 @@ def _pallas_selfcheck(platform: str, block_q: int, block_k: int,
         try:
             seq = max(2 * block_k, 2 * block_q)
             q = jnp.ones((1, 1, seq, d), dtype)
-            out, lse = _flash_forward(q, q, q, True, 0.125,
+            out, lse = _flash_forward(q, q, q, causal, 0.125,
                                       block_q, block_k)
-            grads = _flash_backward(q, q, q, out, lse, out, True, 0.125,
+            grads = _flash_backward(q, q, q, out, lse, out, causal, 0.125,
                                     block_q, block_k)
             jax.block_until_ready(grads)
             result["ok"] = True
-        except Exception:  # noqa: BLE001 — any lowering/runtime error
+        except Exception as e:  # noqa: BLE001 — any lowering/runtime error
             result["ok"] = False
+            result["err"] = f"{type(e).__name__}: {e}"
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join()
     _PALLAS_STATUS[key] = result.get("ok", False)
+    if not _PALLAS_STATUS[key]:
+        # Loud degradation: falling back to the O(S^2) XLA path is ~2x
+        # slower and must be diagnosable after the fact.
+        import logging
+
+        _PALLAS_ERRORS[key] = result.get("err", "probe thread died")
+        logging.getLogger("ray_tpu.ops.attention").warning(
+            "Pallas flash-attention self-check FAILED for %s — using the "
+            "XLA fallback for this config: %s", key, _PALLAS_ERRORS[key])
     return _PALLAS_STATUS[key]
 
 
-def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
+def _use_pallas(q, k, block_q: int, block_k: int,
+                causal: bool = True) -> bool:
     if _interpret():
         ok_platform = True
     else:
@@ -424,7 +442,7 @@ def _use_pallas(q, k, block_q: int, block_k: int) -> bool:
         except Exception:
             platform = jax.default_backend()
         ok_platform = platform == "tpu" and _pallas_selfcheck(
-            platform, block_q, block_k, q.shape[-1], q.dtype)
+            platform, block_q, block_k, q.shape[-1], q.dtype, causal)
     if not ok_platform:
         return False
     _, _, seq_q, d = q.shape
@@ -462,7 +480,7 @@ def _resolve(q, scale, block_q, block_k):
 
 def _attn_fwd_impl(q, k, v, causal, scale, block_q, block_k):
     scale, bq, bk = _resolve(q, scale, block_q, block_k)
-    if _use_pallas(q, k, bq, bk):
+    if _use_pallas(q, k, bq, bk, causal):
         return _flash_forward(q, k, v, causal, scale, bq, bk)
     return mha_reference(q, k, v, causal=causal, scale=scale), None
 
@@ -475,7 +493,7 @@ def _attn_fwd(q, k, v, causal, scale, block_q, block_k):
 def _attn_bwd(causal, scale, block_q, block_k, residuals, g):
     q, k, v, out, lse = residuals
     scale_v, bq, bk = _resolve(q, scale, block_q, block_k)
-    if lse is not None and _use_pallas(q, k, bq, bk):
+    if lse is not None and _use_pallas(q, k, bq, bk, causal):
         return _flash_backward(q, k, v, out, lse, g, causal, scale_v, bq, bk)
     _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale),
                      q, k, v)

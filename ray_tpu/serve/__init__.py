@@ -1,0 +1,242 @@
+"""ray_tpu.serve — model serving on the actor substrate.
+
+API parity with the reference's `ray.serve` (`serve/api.py:267`,
+`deployment.py:97`): ``@serve.deployment``, ``.bind()``, ``serve.run``,
+``serve.shutdown``, ``serve.status``, ``get_deployment_handle``, and
+``@serve.batch``. TPU-first: a deployment's replicas are actors scheduled
+with their own resource grants (``num_tpus=1`` replicas own a chip and run
+batched jitted inference; see `batching.py`), the controller reconciles
+replica actors and autoscales on queue depth, and per-node aiohttp proxies
+front HTTP traffic.
+
+Typical flow:
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4)
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    app = Echo.bind()
+    handle = serve.run(app)
+    out = ray_tpu.get(handle.remote({"x": 1}))
+    serve.shutdown()
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import replace as _dc_replace
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle, _drop_process_router
+
+logger = logging.getLogger(__name__)
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+class Application:
+    """A bound deployment (class + init args), ready for serve.run."""
+
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target: Union[type, Callable], name: str,
+                 config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_concurrent_queries: Optional[int] = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                route_prefix: Optional[str] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None
+                ) -> "Deployment":
+        cfg = _dc_replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_concurrent_queries is not None:
+            cfg.max_concurrent_queries = max_concurrent_queries
+        if autoscaling_config is not None:
+            cfg.autoscaling = autoscaling_config
+        if route_prefix is not None:
+            cfg.route_prefix = route_prefix
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(self._target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    @property
+    def user_callable(self):
+        if isinstance(self._target, type):
+            return self._target
+        from ray_tpu.serve.replica import make_function_wrapper
+
+        return make_function_wrapper(self._target)
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 8,
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               route_prefix: Optional[str] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
+    """`@serve.deployment` on a class or function."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            autoscaling=autoscaling_config,
+            route_prefix=route_prefix,
+            ray_actor_options=dict(ray_actor_options or {}),
+        )
+        return Deployment(target, name or target.__name__, cfg)
+
+    return wrap(_target) if _target is not None else wrap
+
+
+# --------------------------------------------------------------------------- #
+# Cluster-facing operations
+# --------------------------------------------------------------------------- #
+
+
+def _get_or_create_controller(create: bool = True):
+    import ray_tpu
+    from ray_tpu.serve.controller import (
+        CONTROLLER_NAME,
+        SERVE_NAMESPACE,
+        ServeController,
+    )
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except Exception:  # noqa: BLE001 — not started yet
+        if not create:
+            raise
+    controller = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
+        lifetime="detached", max_concurrency=64, num_cpus=0.1,
+    ).remote()
+    controller.reconcile_forever.remote()
+    return controller
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 8000,
+          detached: bool = True) -> None:
+    """Start the Serve control plane (controller + HTTP proxy)."""
+    _get_or_create_controller()
+    _ensure_proxy(http_host, http_port)
+
+
+def _ensure_proxy(host: str, port: int) -> int:
+    import ray_tpu
+    from ray_tpu.serve.controller import SERVE_NAMESPACE
+    from ray_tpu.serve.proxy import HTTPProxy
+
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+    except Exception:  # noqa: BLE001
+        proxy = ray_tpu.remote(HTTPProxy).options(
+            name=_PROXY_NAME, namespace=SERVE_NAMESPACE,
+            lifetime="detached", max_concurrency=256, num_cpus=0.1,
+        ).remote(host, port)
+    return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+
+
+def run(app: Union[Application, Deployment], *, _blocking: bool = False,
+        http: bool = False, http_host: str = "127.0.0.1",
+        http_port: int = 8000, timeout_s: float = 60.0
+        ) -> DeploymentHandle:
+    """Deploy and wait until at least the initial replicas are RUNNING."""
+    import ray_tpu
+
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep.user_callable, app.init_args, app.init_kwargs,
+        dep.config), timeout=timeout_s)
+    ok = ray_tpu.get(controller.wait_ready.remote(dep.name, timeout_s),
+                     timeout=timeout_s + 5.0)
+    if not ok:
+        raise TimeoutError(
+            f"deployment {dep.name!r} did not become ready in {timeout_s}s")
+    if http:
+        _ensure_proxy(http_host, http_port)
+    return DeploymentHandle(dep.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = _get_or_create_controller(create=False)
+    return ray_tpu.get(controller.status.remote(), timeout=10.0)
+
+
+def http_port() -> int:
+    """The bound port of the local HTTP proxy (starts it if needed)."""
+    return _ensure_proxy("127.0.0.1", 0)
+
+
+def delete(name: str, timeout_s: float = 30.0) -> None:
+    import ray_tpu
+
+    controller = _get_or_create_controller(create=False)
+    ray_tpu.get(controller.delete.remote(name), timeout=timeout_s)
+
+
+def shutdown() -> None:
+    """Tear down all deployments, the proxy, and the controller."""
+    import ray_tpu
+    from ray_tpu.serve.controller import (
+        CONTROLLER_NAME,
+        SERVE_NAMESPACE,
+    )
+
+    _drop_process_router()
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        try:
+            ray_tpu.get(proxy.stop.remote(), timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=10.0)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "batch", "delete", "deployment",
+    "get_deployment_handle", "http_port", "run", "shutdown", "start",
+    "status",
+]

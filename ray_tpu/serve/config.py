@@ -1,0 +1,58 @@
+"""Serve configuration dataclasses.
+
+Mirrors the reference's deployment/autoscaling config surface
+(`python/ray/serve/config.py`, `_private/autoscaling_policy.py:54-127`) as
+plain dataclasses: num_replicas or an AutoscalingConfig, per-replica
+max_concurrent_queries (admission control at the router), and the actor
+resources a replica runs with (a TPU inference replica asks for
+``num_tpus=1`` and owns the chip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-depth driven replica autoscaling.
+
+    The controller compares the mean number of ongoing requests per replica
+    against ``target_ongoing_requests`` each reconcile tick and moves
+    ``num_replicas`` toward ``ceil(total_ongoing / target)``, bounded by
+    [min_replicas, max_replicas]. Upscale reacts after
+    ``upscale_delay_s`` of sustained pressure, downscale after
+    ``downscale_delay_s`` of sustained idleness (reference policy:
+    `serve/_private/autoscaling_policy.py:127`).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.25
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    autoscaling: Optional[AutoscalingConfig] = None
+    route_prefix: Optional[str] = None       # default: "/<deployment name>"
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    health_check_period_s: float = 1.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling is not None:
+            return max(self.autoscaling.min_replicas, 1)
+        return self.num_replicas
+
+
+# Replica lifecycle states (reference: `_private/deployment_state.py` —
+# STARTING/RUNNING/STOPPING collapsed to what the reconcile loop needs).
+REPLICA_STARTING = "STARTING"
+REPLICA_RUNNING = "RUNNING"
+REPLICA_STOPPING = "STOPPING"

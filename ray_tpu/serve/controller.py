@@ -1,0 +1,349 @@
+"""Serve controller: the reconcile loop that owns deployment state.
+
+Equivalent of the reference's `ServeController` (`serve/controller.py:75`)
++ `DeploymentState` (`_private/deployment_state.py:1037`): a named async
+actor holding desired deployment specs, reconciling actual replica actors
+toward them (spawn / drain+kill / replace-on-failed-health-check), applying
+the queue-depth autoscaling policy, and long-poll-pushing a versioned
+routing table to routers (`_private/long_poll.py` equivalent via an
+asyncio.Condition — our actor RPC already multiplexes concurrent method
+calls onto the replica's asyncio loop, so a parked long-poll costs one
+coroutine, not a thread).
+
+All blocking cluster calls (ray_tpu.get/wait) run in the default executor
+so the reconcile loop never stalls the actor's event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.config import (
+    REPLICA_RUNNING,
+    REPLICA_STARTING,
+    DeploymentConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+
+class _ReplicaInfo:
+    def __init__(self, handle, replica_id: str):
+        self.handle = handle
+        self.replica_id = replica_id
+        self.state = REPLICA_STARTING
+        self.last_ongoing = 0
+        self.started_at = time.time()
+
+
+class _DeploymentInfo:
+    def __init__(self, user_cls, init_args, init_kwargs,
+                 config: DeploymentConfig):
+        self.user_cls = user_cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.replicas: List[_ReplicaInfo] = []
+        self.target = config.initial_replicas()
+        self.next_replica_seq = 0
+        # Autoscaling bookkeeping: when pressure/idleness began.
+        self.pressure_since: Optional[float] = None
+        self.idle_since: Optional[float] = None
+        self.last_health_check = 0.0
+
+
+class ServeController:
+    """Async actor; create with max_concurrency >> 1 (long-polls park)."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._version = 0
+        self._routing_table: Dict[str, Any] = {}
+        self._shutdown = False
+        self._change: Optional[asyncio.Condition] = None
+
+    # ---------------------------------------------------------------- API
+    # All public methods are async so every mutation runs on the actor's
+    # single event loop — no cross-thread races with the reconcile task.
+
+    async def deploy(self, name: str, user_cls, init_args, init_kwargs,
+                     config: DeploymentConfig) -> None:
+        info = self._deployments.get(name)
+        if info is None:
+            self._deployments[name] = _DeploymentInfo(
+                user_cls, init_args, init_kwargs, config)
+        else:
+            # Config-only update (replica count, concurrency); new code or
+            # args means new replicas — drain all and let reconcile respawn.
+            changed_code = (user_cls is not info.user_cls
+                            or init_args != info.init_args
+                            or init_kwargs != info.init_kwargs)
+            info.user_cls = user_cls
+            info.init_args = init_args
+            info.init_kwargs = init_kwargs
+            info.config = config
+            info.target = config.initial_replicas()
+            if changed_code:
+                for rep in info.replicas:
+                    self._stop_replica(rep)
+                info.replicas = []
+        logger.info("serve: deployed %s (target=%d)", name,
+                    self._deployments[name].target)
+
+    async def delete(self, name: str) -> None:
+        info = self._deployments.pop(name, None)
+        if info is not None:
+            for rep in info.replicas:
+                self._stop_replica(rep)
+            self._bump()
+
+    async def wait_ready(self, name: str, timeout_s: float = 60.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            info = self._deployments.get(name)
+            if info is not None:
+                running = sum(1 for r in info.replicas
+                              if r.state == REPLICA_RUNNING)
+                if running >= max(1, min(info.target, 1)) \
+                        and running >= (1 if info.config.autoscaling
+                                        else info.target):
+                    return True
+            await asyncio.sleep(0.05)
+        return False
+
+    async def get_routing_table(self) -> tuple:
+        return self._version, self._routing_table
+
+    async def listen_for_change(self, known_version: int,
+                                timeout_s: float = 30.0) -> tuple:
+        """Long-poll: parks until the routing table moves past
+        known_version (or times out, returning the current view)."""
+        if self._change is None:
+            self._change = asyncio.Condition()
+        deadline = time.time() + timeout_s
+        async with self._change:
+            while self._version <= known_version and not self._shutdown:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(self._change.wait(),
+                                           timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+        return self._version, self._routing_table
+
+    async def status(self) -> Dict[str, Any]:
+        out = {}
+        for name, info in self._deployments.items():
+            out[name] = {
+                "target": info.target,
+                "replicas": {
+                    r.replica_id: r.state for r in info.replicas},
+                "ongoing": sum(r.last_ongoing for r in info.replicas),
+            }
+        return out
+
+    async def graceful_shutdown(self) -> None:
+        self._shutdown = True
+        import ray_tpu
+
+        for info in self._deployments.values():
+            for rep in info.replicas:
+                self._stop_replica(rep)
+        self._deployments.clear()
+        self._bump()
+        del ray_tpu
+
+    # ----------------------------------------------------------- reconcile
+
+    async def reconcile_forever(self, period_s: float = 0.1) -> None:
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("serve reconcile error")
+            await asyncio.sleep(period_s)
+
+    async def _reconcile_once(self) -> None:
+        loop = asyncio.get_running_loop()
+        changed = False
+        for name, info in list(self._deployments.items()):
+            # 1. Promote STARTING replicas that answer ping.
+            for rep in [r for r in info.replicas
+                        if r.state == REPLICA_STARTING]:
+                ok = await loop.run_in_executor(
+                    None, functools.partial(_try_ping, rep.handle, 0.05))
+                if ok:
+                    rep.state = REPLICA_RUNNING
+                    changed = True
+
+            # 2. Health-check RUNNING replicas; replace the dead.
+            if (time.time() - info.last_health_check
+                    >= info.config.health_check_period_s):
+                info.last_health_check = time.time()
+                stats = await loop.run_in_executor(
+                    None, functools.partial(_gather_stats, info.replicas))
+                dead = []
+                for rep, st in zip(list(info.replicas), stats):
+                    if rep.state != REPLICA_RUNNING:
+                        continue
+                    if st is None:
+                        dead.append(rep)
+                    else:
+                        rep.last_ongoing = st.get("ongoing", 0)
+                for rep in dead:
+                    logger.warning("serve: replica %s of %s failed health "
+                                   "check — replacing", rep.replica_id, name)
+                    self._stop_replica(rep, graceful=False)
+                    info.replicas.remove(rep)
+                    changed = True
+
+            # 3. Autoscaling decision.
+            if info.config.autoscaling is not None:
+                new_target = self._autoscale_decision(info)
+                if new_target != info.target:
+                    logger.info("serve: autoscaling %s %d -> %d",
+                                name, info.target, new_target)
+                    info.target = new_target
+
+            # 4. Converge replica count toward target.
+            live = [r for r in info.replicas]
+            if len(live) < info.target:
+                for _ in range(info.target - len(live)):
+                    info.replicas.append(self._start_replica(name, info))
+                changed = True
+            elif len(live) > info.target:
+                # Drain the newest first (stable prefix keeps warm caches).
+                excess = live[info.target:]
+                for rep in excess:
+                    self._stop_replica(rep)
+                    info.replicas.remove(rep)
+                changed = True
+
+        if changed:
+            self._rebuild_routing_table()
+
+    def _autoscale_decision(self, info: _DeploymentInfo) -> int:
+        cfg = info.config.autoscaling
+        running = [r for r in info.replicas if r.state == REPLICA_RUNNING]
+        if not running:
+            return info.target
+        total_ongoing = sum(r.last_ongoing for r in running)
+        desired = math.ceil(total_ongoing / cfg.target_ongoing_requests) \
+            if total_ongoing else cfg.min_replicas
+        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
+        now = time.time()
+        if desired > info.target:
+            info.idle_since = None
+            if info.pressure_since is None:
+                info.pressure_since = now
+            if now - info.pressure_since >= cfg.upscale_delay_s:
+                info.pressure_since = None
+                return desired
+        elif desired < info.target:
+            info.pressure_since = None
+            if info.idle_since is None:
+                info.idle_since = now
+            if now - info.idle_since >= cfg.downscale_delay_s:
+                info.idle_since = None
+                return desired
+        else:
+            info.pressure_since = None
+            info.idle_since = None
+        return info.target
+
+    # ------------------------------------------------------------- helpers
+
+    def _start_replica(self, name: str, info: _DeploymentInfo):
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+
+        replica_id = f"{name}#{info.next_replica_seq}"
+        info.next_replica_seq += 1
+        opts = dict(info.config.ray_actor_options)
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = info.config.max_concurrent_queries + 8
+        opts["name"] = f"SERVE_REPLICA::{replica_id}"
+        opts["namespace"] = SERVE_NAMESPACE
+        actor_cls = ray_tpu.remote(Replica)
+        handle = actor_cls.options(**opts).remote(
+            name, info.user_cls, info.init_args, info.init_kwargs)
+        logger.info("serve: starting replica %s", replica_id)
+        return _ReplicaInfo(handle, replica_id)
+
+    def _stop_replica(self, rep: _ReplicaInfo, graceful: bool = True):
+        import ray_tpu
+
+        rep.state = "STOPPING"
+        try:
+            if graceful:
+                rep.handle.prepare_shutdown.remote(1.0)
+            ray_tpu.kill(rep.handle)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+
+    def _rebuild_routing_table(self) -> None:
+        table = {}
+        for name, info in self._deployments.items():
+            running = [r for r in info.replicas
+                       if r.state == REPLICA_RUNNING]
+            prefix = info.config.route_prefix or f"/{name}"
+            table[name] = {
+                "replicas": [(r.replica_id, r.handle) for r in running],
+                "max_concurrent_queries":
+                    info.config.max_concurrent_queries,
+                "route_prefix": prefix,
+            }
+        self._routing_table = table
+        self._bump()
+
+    def _bump(self) -> None:
+        self._version += 1
+        if self._change is not None:
+            async def notify():
+                async with self._change:
+                    self._change.notify_all()
+            try:
+                asyncio.get_running_loop().create_task(notify())
+            except RuntimeError:
+                pass  # called outside the loop (sync method): next bump
+
+
+def _try_ping(handle, timeout_s: float) -> bool:
+    import ray_tpu
+
+    try:
+        ref = handle.ping.remote()
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout_s)
+        return bool(ready)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _gather_stats(replicas) -> list:
+    import ray_tpu
+
+    refs, out = [], []
+    for rep in replicas:
+        try:
+            refs.append(rep.handle.stats.remote())
+        except Exception:  # noqa: BLE001
+            refs.append(None)
+    for ref in refs:
+        if ref is None:
+            out.append(None)
+            continue
+        try:
+            out.append(ray_tpu.get(ref, timeout=1.0))
+        except Exception:  # noqa: BLE001
+            out.append(None)
+    return out

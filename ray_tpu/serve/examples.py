@@ -1,0 +1,95 @@
+"""Reference deployments: batched JAX inference replicas.
+
+The serving counterpart of the flagship model (BASELINE.json names a Serve
+LLM deployment): a GPT-2 sampler replica that owns its accelerator, pads
+incoming prompts into fixed shape buckets (stable shapes = one XLA
+compilation), and rides `@serve.batch` so concurrent HTTP requests share
+one MXU forward pass per decode step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu import serve
+
+
+@serve.deployment(max_concurrent_queries=32)
+class GPT2Sampler:
+    """Greedy sampler over a GPT-2 checkpoint (randomly initialized by
+    default — serving-path benchmarking doesn't need trained weights).
+
+    Request: {"ids": [int, ...], "max_new_tokens": int} -> {"ids": [...]}.
+    """
+
+    def __init__(self, model_size: str = "tiny", max_seq: int = 256,
+                 default_new_tokens: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gpt2 import GPT2, GPT2Config
+
+        cfg = {"tiny": GPT2Config.tiny(seq=max_seq),
+               "small": GPT2Config.small(),
+               "medium": GPT2Config.medium()}[model_size]
+        self._cfg = cfg
+        self._max_seq = min(max_seq, cfg.n_positions)
+        self._default_new = default_new_tokens
+        self._model = GPT2(cfg)
+        rng = jax.random.PRNGKey(0)
+        sample = jnp.zeros((1, self._max_seq), jnp.int32)
+        self._params = jax.jit(
+            lambda: self._model.init(rng, sample))()
+
+        def next_token(params, ids, lengths):
+            # ids: [b, max_seq] padded; lengths: [b] current lengths.
+            logits = self._model.apply(params, ids)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        self._next_token = jax.jit(next_token)
+        self._batches_served = 0
+        self._batch_size_sum = 0
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def __call__(self, requests: List[Dict[str, Any]]):
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._batches_served += 1
+        self._batch_size_sum += len(requests)
+        prompts = [list(r.get("ids", []))[: self._max_seq - 1]
+                   or [0] for r in requests]
+        new_tokens = max(int(r.get("max_new_tokens", self._default_new))
+                         for r in requests)
+        new_tokens = min(new_tokens,
+                         self._max_seq - max(len(p) for p in prompts))
+        # Pad the batch dim to max_batch_size too: one XLA compilation for
+        # every batch the flusher can produce, not one per distinct size.
+        padded_b = 8
+        while padded_b < len(prompts):
+            padded_b *= 2
+        ids = np.zeros((padded_b, self._max_seq), np.int32)
+        lengths = np.ones(padded_b, np.int32)
+        lengths[: len(prompts)] = [len(p) for p in prompts]
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+        ids = jnp.asarray(ids)
+        lengths = jnp.asarray(lengths)
+        for _ in range(max(new_tokens, 1)):
+            nxt = self._next_token(self._params, ids, lengths)
+            ids = ids.at[jnp.arange(ids.shape[0]), lengths].set(nxt)
+            lengths = jnp.minimum(lengths + 1, self._max_seq - 1)
+        out_ids = np.asarray(ids)
+        out_lens = np.asarray(lengths)
+        return [{"ids": out_ids[i, : out_lens[i]].tolist()}
+                for i in range(len(prompts))]
+
+    def metrics(self, _=None) -> Dict[str, Any]:
+        served = self._batches_served
+        return {
+            "batches_served": served,
+            "mean_batch_size":
+                (self._batch_size_sum / served) if served else 0.0,
+        }

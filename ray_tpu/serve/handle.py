@@ -1,0 +1,67 @@
+"""DeploymentHandle: Python-side entry into a deployment.
+
+Equivalent of the reference's `RayServeHandle` (`serve/handle.py:78`).
+``handle.remote(arg)`` routes through the process-local Router (admission
+control + least-loaded choice) and returns an ObjectRef; composition
+between deployments works because handles pickle down to their deployment
+name and rebuild their router lazily inside the borrowing process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+_local = threading.local()
+
+
+def _process_router():
+    """One Router per process, shared by every handle (shared in-flight
+    accounting keeps max_concurrent_queries global to the process)."""
+    import ray_tpu
+    from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+    from ray_tpu.serve.router import Router
+
+    router = getattr(_local, "router", None)
+    if router is None or router._stopped:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+        router = Router(controller)
+        _local.router = router
+    return router
+
+
+def _drop_process_router():
+    router = getattr(_local, "router", None)
+    if router is not None:
+        router.stop()
+        _local.router = None
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._deployment = deployment_name
+        self._method = method_name
+
+    def options(self, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self._deployment,
+                                method_name or self._method)
+
+    def method(self, name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._deployment, name)
+
+    def remote(self, *args, **kwargs) -> Any:
+        return _process_router().assign(
+            self._deployment, self._method, args, kwargs)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._deployment, name)
+
+    def __reduce__(self):
+        return DeploymentHandle, (self._deployment, self._method)
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._deployment!r}, {self._method!r})"

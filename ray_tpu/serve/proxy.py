@@ -1,0 +1,119 @@
+"""HTTP proxy actor: aiohttp front door routing to deployment replicas.
+
+Equivalent of the reference's `HTTPProxyActor`
+(`serve/_private/http_proxy.py:250,463`): an async actor running an
+aiohttp server; each request is matched against deployment route prefixes
+from the (long-poll refreshed) routing table and dispatched through the
+proxy's Router. ``ray_tpu.get`` on the response ref runs in the default
+executor so the event loop keeps accepting connections while replicas
+work — request-level parallelism is bounded by the router's
+max_concurrent_queries admission control, not the proxy.
+
+Wire format: request body is JSON (or raw text) → the deployment callable
+receives the decoded payload; dict/list/str/number results come back as
+JSON. Matches what a JAX text-generation replica needs without dragging in
+an ASGI framework.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._runner = None
+        self._router = None
+
+    async def ready(self) -> int:
+        """Start the server; returns the bound port."""
+        if self._runner is not None:
+            return self._port
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.serve.controller import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+        from ray_tpu.serve.router import Router
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+        self._router = Router(controller)
+        # First table fetch is blocking — keep it off the event loop.
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._router._ensure_started)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        # Port 0 = ephemeral: recover the real one.
+        if self._port == 0:
+            self._port = self._runner.addresses[0][1]
+        logger.info("serve proxy listening on %s:%d", self._host, self._port)
+        return self._port
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        deployment = self._match(path)
+        if deployment is None:
+            return web.json_response(
+                {"error": f"no deployment for path {path!r}"}, status=404)
+        if request.can_read_body:
+            raw = await request.read()
+            try:
+                payload = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                payload = raw.decode("utf-8", "replace")
+        else:
+            payload = dict(request.query) or None
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, self._dispatch, deployment, payload)
+        except Exception as e:  # noqa: BLE001 — user code error → 500
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=500)
+        if isinstance(result, (dict, list, int, float, bool)) \
+                or result is None:
+            return web.json_response({"result": result})
+        return web.Response(text=str(result))
+
+    def _dispatch(self, deployment: str, payload):
+        import ray_tpu
+
+        ref = self._router.assign(deployment, "__call__", (payload,), {},
+                                  timeout_s=30.0)
+        return ray_tpu.get(ref, timeout=60.0)
+
+    def _match(self, path: str) -> Optional[str]:
+        with self._router._lock:
+            table = dict(self._router._table)
+        best, best_len = None, -1
+        for name, entry in table.items():
+            prefix = entry["route_prefix"]
+            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
+                    or (prefix == "/" and path.startswith("/"))):
+                if len(prefix) > best_len:
+                    best, best_len = name, len(prefix)
+        return best
+
+    async def stop(self):
+        if self._router is not None:
+            self._router.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

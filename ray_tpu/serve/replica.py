@@ -1,0 +1,103 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Equivalent of the reference's `RayServeReplica`
+(`serve/_private/replica.py:285`, `handle_request` :508) — an async actor
+whose asyncio loop gives request-level concurrency (the reference uses the
+same design), tracks ongoing/processed counts for the controller's
+autoscaler, and answers health checks. JAX inference runs on the replica's
+chip: the replica actor is scheduled with the deployment's
+``ray_actor_options`` (e.g. ``num_tpus=1``) so the raylet grants it the
+accelerator env before the process initializes JAX.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict
+
+
+class Replica:
+    """Generic wrapper actor; instantiated via ActorClass options with
+    max_concurrency > max_concurrent_queries so control-plane calls
+    (stats/ping/prepare_shutdown) never starve behind user requests."""
+
+    def __init__(self, deployment_name: str, user_cls, init_args,
+                 init_kwargs):
+        self._deployment = deployment_name
+        self._user = user_cls(*init_args, **(init_kwargs or {}))
+        self._ongoing = 0
+        self._processed = 0
+        self._errored = 0
+        self._started_at = time.time()
+        self._draining = False
+
+    async def handle_request(self, method_name: str, args, kwargs) -> Any:
+        if self._draining:
+            raise RuntimeError(
+                f"replica of {self._deployment} is draining")
+        self._ongoing += 1
+        try:
+            method = getattr(self._user, method_name)
+            if inspect.iscoroutinefunction(method) or (
+                    getattr(method, "__serve_is_batched__", False)):
+                out = await method(*args, **(kwargs or {}))
+            else:
+                # Sync user callables must not block the replica's event
+                # loop — request concurrency (and honest queue-depth stats
+                # for the autoscaler) depends on it.
+                import functools
+
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, functools.partial(method, *args,
+                                            **(kwargs or {})))
+                if inspect.iscoroutine(out):
+                    out = await out
+            self._processed += 1
+            return out
+        except Exception:
+            self._errored += 1
+            raise
+        finally:
+            self._ongoing -= 1
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "deployment": self._deployment,
+            "ongoing": self._ongoing,
+            "processed": self._processed,
+            "errored": self._errored,
+            "uptime_s": time.time() - self._started_at,
+        }
+
+    def ping(self) -> str:
+        return "pong"
+
+    async def prepare_shutdown(self, timeout_s: float = 5.0) -> int:
+        """Graceful drain: refuse new requests, wait for ongoing ones."""
+        self._draining = True
+        deadline = time.time() + timeout_s
+        while self._ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.02)
+        return self._ongoing
+
+    def reconfigure(self, user_config: Any) -> None:
+        hook = getattr(self._user, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+
+
+def make_function_wrapper(fn):
+    """Adapt a bare function deployment into a callable class."""
+
+    class _FunctionDeployment:
+        def __init__(self, *args, **kwargs):
+            self._args = args
+            self._kwargs = kwargs
+
+        def __call__(self, request):
+            return fn(request, *self._args, **self._kwargs)
+
+    _FunctionDeployment.__name__ = getattr(fn, "__name__", "function")
+    return _FunctionDeployment

@@ -1,0 +1,143 @@
+"""Client-side router: replica choice + per-replica admission control.
+
+Equivalent of the reference's `Router`/`ReplicaSet.assign_replica`
+(`serve/_private/router.py:274,227`): keeps a local snapshot of the
+controller's routing table (refreshed by a background long-poll thread),
+picks the least-loaded replica whose local in-flight count is under
+``max_concurrent_queries``, and blocks when all replicas are saturated.
+In-flight counts are decremented by a reaper thread that waits on the
+outstanding ObjectRefs — the framework has no future callbacks by design
+(completion events ride the worker push channel), so one thread per router
+amortizes completion tracking across all requests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class Router:
+    def __init__(self, controller_handle, poll_timeout_s: float = 5.0):
+        self._controller = controller_handle
+        self._poll_timeout_s = poll_timeout_s
+        self._lock = threading.Condition()
+        self._version = -1
+        self._table: Dict[str, dict] = {}
+        # replica_id -> local in-flight count
+        self._inflight: Dict[str, int] = {}
+        # outstanding ref -> replica_id (reaped for decrements)
+        self._outstanding: Dict[object, str] = {}
+        self._stopped = False
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="serve-router-poll", daemon=True)
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="serve-router-reap", daemon=True)
+        self._started = False
+
+    def _ensure_started(self):
+        if not self._started:
+            self._started = True
+            # Synchronous first fetch so the first request sees a table.
+            self._refresh_once(timeout=10.0)
+            self._poller.start()
+            self._reaper.start()
+
+    def stop(self):
+        self._stopped = True
+
+    # ------------------------------------------------------------- routing
+
+    def assign(self, deployment: str, method_name: str, args, kwargs,
+               timeout_s: Optional[float] = None):
+        """Pick a replica and submit; returns the ObjectRef. Blocks while
+        every replica is at max_concurrent_queries (backpressure)."""
+        import time
+
+        self._ensure_started()
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                entry = self._table.get(deployment)
+                if entry and entry["replicas"]:
+                    choice = self._pick(entry)
+                    if choice is not None:
+                        replica_id, handle = choice
+                        self._inflight[replica_id] = \
+                            self._inflight.get(replica_id, 0) + 1
+                        break
+                # No replicas yet or all saturated: wait for a table change
+                # or a completion (reaper notifies).
+                wait_t = 1.0
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no replica of {deployment!r} available within "
+                            f"{timeout_s}s")
+                    wait_t = min(wait_t, remaining)
+                self._lock.wait(timeout=wait_t)
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        with self._lock:
+            self._outstanding[ref] = replica_id
+        return ref
+
+    def _pick(self, entry: dict) -> Optional[Tuple[str, object]]:
+        limit = entry["max_concurrent_queries"]
+        best, best_load = None, None
+        for replica_id, handle in entry["replicas"]:
+            load = self._inflight.get(replica_id, 0)
+            if load >= limit:
+                continue
+            if best_load is None or load < best_load:
+                best, best_load = (replica_id, handle), load
+        return best
+
+    # ------------------------------------------------------- background IO
+
+    def _refresh_once(self, timeout: float):
+        import ray_tpu
+
+        try:
+            version, table = ray_tpu.get(
+                self._controller.listen_for_change.remote(
+                    self._version, self._poll_timeout_s),
+                timeout=timeout)
+        except Exception:  # noqa: BLE001 — controller busy/briefly down
+            return
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._table = table
+                self._lock.notify_all()
+
+    def _poll_loop(self):
+        while not self._stopped:
+            self._refresh_once(timeout=self._poll_timeout_s + 10.0)
+
+    def _reap_loop(self):
+        import ray_tpu
+
+        while not self._stopped:
+            with self._lock:
+                refs = list(self._outstanding.keys())
+            if not refs:
+                with self._lock:
+                    self._lock.wait(timeout=0.05)
+                continue
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0.05)
+            except Exception:  # noqa: BLE001
+                continue
+            if ready:
+                with self._lock:
+                    for ref in ready:
+                        replica_id = self._outstanding.pop(ref, None)
+                        if replica_id is not None:
+                            n = self._inflight.get(replica_id, 0)
+                            self._inflight[replica_id] = max(0, n - 1)
+                    self._lock.notify_all()
