@@ -286,6 +286,37 @@ def bench_ppo(quick: bool) -> dict:
         algo.stop()
 
 
+def bench_impala(quick: bool) -> dict:
+    from ray_tpu.rllib import IMPALA, IMPALAConfig
+
+    algo = IMPALA(IMPALAConfig(
+        env="CartPole-v1",
+        num_rollout_workers=1 if quick else 2,
+        num_envs_per_worker=8 if quick else 16,
+        rollout_fragment_length=32 if quick else 64,
+        fragments_per_batch=2,
+        replay_fragments=2,
+        updates_per_iteration=4 if quick else 8,
+        rollout_platform="cpu",
+    ))
+    try:
+        algo.train()  # warm compile
+        iters = 1 if quick else 3
+        t0 = time.perf_counter()
+        frames0 = algo._timesteps
+        learner_sps = 0.0
+        for _ in range(iters):
+            m = algo.train()
+            learner_sps = m.get("learner_sps", 0.0)
+        dt = time.perf_counter() - t0
+        return {
+            "impala_env_steps_per_s": (algo._timesteps - frames0) / dt,
+            "impala_learner_sps": learner_sps,
+        }
+    finally:
+        algo.stop()
+
+
 # --------------------------------------------------------------------------- #
 # Serve: batched GPT-2 sampler behind HTTP under concurrent load
 # --------------------------------------------------------------------------- #
@@ -385,6 +416,10 @@ def main(out=None):
             extra.update(bench_ppo(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["ppo_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_impala(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["impala_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_serve:
         try:
             extra.update(bench_serve(args.quick))

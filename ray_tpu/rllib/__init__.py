@@ -12,6 +12,12 @@ from ray_tpu.rllib.env import (
     VectorEnv,
     make_env,
 )
+from ray_tpu.rllib.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+    vtrace_returns,
+)
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.rl_module import DiscretePolicyModule, RLModule, SpecDict
@@ -22,4 +28,5 @@ __all__ = [
     "RLModule", "DiscretePolicyModule", "SpecDict",
     "Learner", "LearnerGroup", "RolloutWorker", "WorkerSet",
     "PPO", "PPOConfig", "PPOLearner",
+    "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace_returns",
 ]

@@ -99,7 +99,12 @@ class CartPoleVectorEnv(VectorEnv):
         # Auto-reset finished episodes; the truncated flag marks boundaries
         # where GAE should bootstrap V(next). Termination takes precedence
         # when both land on the same step (gymnasium/RLlib semantics).
+        # final_obs carries the TRUE pre-reset state at done rows so value
+        # bootstrapping at truncation uses the right state. Only built when
+        # an episode actually ended — the hot loop stays allocation-lean.
         infos = {"truncated": truncated.copy()}
+        if dones.any():
+            infos["final_obs"] = self._state.astype(np.float32)
         self._reset_envs(dones)
         return (self._state.astype(np.float32), rewards, dones, infos)
 
@@ -139,9 +144,23 @@ class GymnasiumVectorEnv(VectorEnv):
         terminated = np.asarray(terminated)
         truncated = np.asarray(truncated) & ~terminated  # termination wins
         dones = terminated | truncated
-        return (obs.reshape(self.n_envs, -1).astype(np.float32),
-                np.asarray(rewards, dtype=np.float32), dones,
-                {"truncated": truncated})
+        obs = obs.reshape(self.n_envs, -1).astype(np.float32)
+        out_infos = {"truncated": truncated}
+        if dones.any():
+            # Gymnasium SAME_STEP autoreset reports the pre-reset
+            # observation per done env (key name varies across versions);
+            # default to the returned obs where absent. Built only on steps
+            # with an episode end — the hot loop stays allocation-lean.
+            final_obs = obs.copy()
+            raw_final = infos.get("final_obs",
+                                  infos.get("final_observation"))
+            if raw_final is not None:
+                for i in np.nonzero(dones)[0]:
+                    fo = raw_final[i]
+                    if fo is not None:
+                        final_obs[i] = np.asarray(fo, np.float32).reshape(-1)
+            out_infos["final_obs"] = final_obs
+        return (obs, np.asarray(rewards, dtype=np.float32), dones, out_infos)
 
 
 def make_env(env: Any, n_envs: int, seed: int = 0) -> VectorEnv:

@@ -179,6 +179,7 @@ class PPO:
                      ) -> Dict[str, np.ndarray]:
         cfg = self.config
         T, n = batch.pop("_shape")
+        batch.pop("_last_obs", None)  # IMPALA-only bootstrap obs
         rewards = batch[sb.REWARDS].reshape(T, n)
         values = batch[sb.VF_PREDS].reshape(T, n)
         dones = batch[sb.DONES].reshape(T, n)

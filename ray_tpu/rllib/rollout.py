@@ -75,6 +75,7 @@ class RolloutWorker:
         next_vf_buf = np.empty((num_steps, n), dtype=np.float32)
 
         obs = self._obs
+        final_obs_fixups: List = []  # (t, rows, final_obs[rows])
         for t in range(num_steps):
             self._rng, key = jax.random.split(self._rng)
             out = self.module.forward_exploration(self.params, obs, key)
@@ -87,19 +88,23 @@ class RolloutWorker:
             trunc_buf[t] = infos.get("truncated", np.zeros(n, dtype=bool))
             logp_buf[t] = np.asarray(out["logp"])
             vf_buf[t] = np.asarray(out["vf"])
-            # V(next_obs): needed for GAE deltas; auto-reset means next_obs
-            # at a done step is the NEW episode's obs, but for terminated
-            # steps GAE zeroes the bootstrap so only truncation uses this
-            # (approximation: value of the reset obs; the reference stores
-            # the true final obs — CartPole truncation values are near-
-            # identical and this keeps the hot loop allocation-free).
             self._ep_returns += rewards
             self._ep_lens += 1
-            for i in np.nonzero(dones)[0]:
-                self._completed.append(float(self._ep_returns[i]))
-                self._completed_lens.append(int(self._ep_lens[i]))
-                self._ep_returns[i] = 0.0
-                self._ep_lens[i] = 0
+            done_rows = np.nonzero(dones)[0]
+            if done_rows.size:
+                # Auto-reset replaces the episode's true final obs with the
+                # new episode's first obs; keep the real one so truncation
+                # bootstraps V(final), not V(reset) (reference stores the
+                # final obs the same way).
+                fo = infos.get("final_obs")
+                if fo is not None:
+                    final_obs_fixups.append(
+                        (t, done_rows, np.asarray(fo)[done_rows]))
+                for i in done_rows:
+                    self._completed.append(float(self._ep_returns[i]))
+                    self._completed_lens.append(int(self._ep_lens[i]))
+                    self._ep_returns[i] = 0.0
+                    self._ep_lens[i] = 0
             obs = next_obs
         self._obs = obs
 
@@ -108,12 +113,29 @@ class RolloutWorker:
         next_vf_buf[:-1] = vf_buf[1:]
         tail = self.module.forward_inference(self.params, obs)
         next_vf_buf[-1] = np.asarray(tail["vf"])
-        # At done steps the shifted value belongs to the next episode; GAE
-        # masks terminated steps, and truncated steps use the reset-obs value
-        # (see note above).
+        # Patch done rows with V(true final obs): one padded batched
+        # forward over every done row in the fragment (padding to a power
+        # of two bounds the number of distinct jit shapes).
+        if final_obs_fixups:
+            all_fo = np.concatenate([f[2] for f in final_obs_fixups])
+            k = len(all_fo)
+            padded_k = 1
+            while padded_k < k:
+                padded_k *= 2
+            padded = np.zeros((padded_k, all_fo.shape[-1]), np.float32)
+            padded[:k] = all_fo
+            vals = np.asarray(self.module.forward_inference(
+                self.params, padded)["vf"])[:k]
+            pos = 0
+            for t, rows, _ in final_obs_fixups:
+                next_vf_buf[t, rows] = vals[pos: pos + rows.size]
+                pos += rows.size
 
         batch = {
             sb.OBS: obs_buf.reshape(num_steps * n, -1),
+            # Tail observation: lets an off-policy learner (IMPALA) compute
+            # its own bootstrap V(x_{T}) with current params.
+            "_last_obs": np.asarray(obs, dtype=np.float32),
             sb.ACTIONS: act_buf.reshape(-1),
             sb.REWARDS: rew_buf.reshape(-1),
             sb.DONES: done_buf.reshape(-1),
@@ -148,6 +170,9 @@ class WorkerSet:
                  jax_platform: Optional[str] = None):
         import ray_tpu
 
+        self._ctor = dict(env=env, n_envs=n_envs, hidden=tuple(hidden),
+                          jax_platform=jax_platform, seed=seed,
+                          num_cpus=num_cpus_per_worker)
         actor_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
             actor_cls.options(num_cpus=num_cpus_per_worker).remote(
@@ -155,6 +180,23 @@ class WorkerSet:
                 jax_platform=jax_platform)
             for i in range(num_workers)]
         self.num_workers = num_workers
+
+    def restart_worker(self, idx: int):
+        """Replace a dead worker actor in place (fault tolerance —
+        reference `FaultTolerantActorManager`)."""
+        import ray_tpu
+
+        c = self._ctor
+        try:
+            ray_tpu.kill(self.workers[idx])
+        except Exception:  # noqa: BLE001 — already gone
+            pass
+        actor_cls = ray_tpu.remote(RolloutWorker)
+        self.workers[idx] = actor_cls.options(
+            num_cpus=c["num_cpus"]).remote(
+            c["env"], n_envs=c["n_envs"], seed=c["seed"] + idx,
+            hidden=c["hidden"], jax_platform=c["jax_platform"])
+        return self.workers[idx]
 
     def sync_weights(self, weights: Any):
         import ray_tpu

@@ -44,6 +44,7 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     health_check_period_s: float = 1.0
     graceful_shutdown_timeout_s: float = 5.0
+    replica_startup_timeout_s: float = 60.0
 
     def initial_replicas(self) -> int:
         if self.autoscaling is not None:

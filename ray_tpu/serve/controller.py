@@ -95,6 +95,9 @@ class ServeController:
                 for rep in info.replicas:
                     self._stop_replica(rep)
                 info.replicas = []
+        # Config-only updates (route_prefix, max_concurrent_queries) must
+        # reach routers even when the replica set doesn't change.
+        self._rebuild_routing_table()
         logger.info("serve: deployed %s (target=%d)", name,
                     self._deployments[name].target)
 
@@ -103,7 +106,7 @@ class ServeController:
         if info is not None:
             for rep in info.replicas:
                 self._stop_replica(rep)
-            self._bump()
+            self._rebuild_routing_table()
 
     async def wait_ready(self, name: str, timeout_s: float = 60.0) -> bool:
         deadline = time.time() + timeout_s
@@ -112,9 +115,10 @@ class ServeController:
             if info is not None:
                 running = sum(1 for r in info.replicas
                               if r.state == REPLICA_RUNNING)
-                if running >= max(1, min(info.target, 1)) \
-                        and running >= (1 if info.config.autoscaling
-                                        else info.target):
+                # Autoscaled deployments are ready at one replica; fixed
+                # deployments wait for the full target.
+                need = 1 if info.config.autoscaling else info.target
+                if running >= need:
                     return True
             await asyncio.sleep(0.05)
         return False
@@ -177,13 +181,24 @@ class ServeController:
         loop = asyncio.get_running_loop()
         changed = False
         for name, info in list(self._deployments.items()):
-            # 1. Promote STARTING replicas that answer ping.
+            # 1. Promote STARTING replicas that answer ping; cull ones that
+            # died in __init__ (ping resolves to an actor error) or never
+            # came up within the startup timeout.
             for rep in [r for r in info.replicas
                         if r.state == REPLICA_STARTING]:
-                ok = await loop.run_in_executor(
+                state = await loop.run_in_executor(
                     None, functools.partial(_try_ping, rep.handle, 0.05))
-                if ok:
+                if state == "ok":
                     rep.state = REPLICA_RUNNING
+                    changed = True
+                elif state == "dead" or (
+                        time.time() - rep.started_at
+                        > info.config.replica_startup_timeout_s):
+                    logger.warning(
+                        "serve: replica %s of %s failed to start — "
+                        "replacing", rep.replica_id, name)
+                    self._stop_replica(rep, graceful=False)
+                    info.replicas.remove(rep)
                     changed = True
 
             # 2. Health-check RUNNING replicas; replace the dead.
@@ -318,15 +333,20 @@ class ServeController:
                 pass  # called outside the loop (sync method): next bump
 
 
-def _try_ping(handle, timeout_s: float) -> bool:
+def _try_ping(handle, timeout_s: float) -> str:
+    """Returns "ok" | "pending" | "dead" — a resolved-but-errored ping is a
+    dead replica, not a slow one."""
     import ray_tpu
 
     try:
         ref = handle.ping.remote()
         ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout_s)
-        return bool(ready)
+        if not ready:
+            return "pending"
+        ray_tpu.get(ready[0])
+        return "ok"
     except Exception:  # noqa: BLE001
-        return False
+        return "dead"
 
 
 def _gather_stats(replicas) -> list:

@@ -61,10 +61,13 @@ class GPT2Sampler:
         self._batch_size_sum += len(requests)
         prompts = [list(r.get("ids", []))[: self._max_seq - 1]
                    or [0] for r in requests]
-        new_tokens = max(int(r.get("max_new_tokens", self._default_new))
-                         for r in requests)
-        new_tokens = min(new_tokens,
-                         self._max_seq - max(len(p) for p in prompts))
+        # Per-request decode budget: rows stop advancing at their own
+        # max_new_tokens; the loop runs to the batch max.
+        budgets = np.zeros(len(prompts), np.int32)
+        for i, r in enumerate(requests):
+            budgets[i] = max(1, min(
+                int(r.get("max_new_tokens", self._default_new)),
+                self._max_seq - 1 - len(prompts[i])))
         # Pad the batch dim to max_batch_size too: one XLA compilation for
         # every batch the flusher can produce, not one per distinct size.
         padded_b = 8
@@ -75,12 +78,17 @@ class GPT2Sampler:
         lengths[: len(prompts)] = [len(p) for p in prompts]
         for i, p in enumerate(prompts):
             ids[i, : len(p)] = p
+        full_budgets = np.zeros(padded_b, np.int32)
+        full_budgets[: len(prompts)] = budgets
         ids = jnp.asarray(ids)
         lengths = jnp.asarray(lengths)
-        for _ in range(max(new_tokens, 1)):
+        full_budgets = jnp.asarray(full_budgets)
+        for step in range(int(budgets.max())):
             nxt = self._next_token(self._params, ids, lengths)
-            ids = ids.at[jnp.arange(ids.shape[0]), lengths].set(nxt)
-            lengths = jnp.minimum(lengths + 1, self._max_seq - 1)
+            active = (step < full_budgets) & (lengths < self._max_seq - 1)
+            new_ids = ids.at[jnp.arange(ids.shape[0]), lengths].set(nxt)
+            ids = jnp.where(active[:, None], new_ids, ids)
+            lengths = jnp.where(active, lengths + 1, lengths)
         out_ids = np.asarray(ids)
         out_lens = np.asarray(lengths)
         return [{"ids": out_ids[i, : out_lens[i]].tolist()}

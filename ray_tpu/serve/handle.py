@@ -12,30 +12,33 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-_local = threading.local()
+_router_lock = threading.Lock()
+_router = None
 
 
 def _process_router():
-    """One Router per process, shared by every handle (shared in-flight
-    accounting keeps max_concurrent_queries global to the process)."""
+    """One Router per process, shared by every handle and thread (shared
+    in-flight accounting keeps max_concurrent_queries global to the
+    process)."""
+    global _router
     import ray_tpu
     from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
     from ray_tpu.serve.router import Router
 
-    router = getattr(_local, "router", None)
-    if router is None or router._stopped:
-        controller = ray_tpu.get_actor(CONTROLLER_NAME,
-                                       namespace=SERVE_NAMESPACE)
-        router = Router(controller)
-        _local.router = router
-    return router
+    with _router_lock:
+        if _router is None or _router._stopped:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+            _router = Router(controller)
+        return _router
 
 
 def _drop_process_router():
-    router = getattr(_local, "router", None)
-    if router is not None:
-        router.stop()
-        _local.router = None
+    global _router
+    with _router_lock:
+        if _router is not None:
+            _router.stop()
+            _router = None
 
 
 class DeploymentHandle:

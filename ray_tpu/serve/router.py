@@ -21,6 +21,8 @@ logger = logging.getLogger(__name__)
 
 
 class Router:
+    UNKNOWN_GRACE_S = 5.0  # deploy-in-progress grace before KeyError
+
     def __init__(self, controller_handle, poll_timeout_s: float = 5.0):
         self._controller = controller_handle
         self._poll_timeout_s = poll_timeout_s
@@ -37,14 +39,19 @@ class Router:
         self._reaper = threading.Thread(
             target=self._reap_loop, name="serve-router-reap", daemon=True)
         self._started = False
+        self._start_lock = threading.Lock()
 
     def _ensure_started(self):
-        if not self._started:
-            self._started = True
-            # Synchronous first fetch so the first request sees a table.
-            self._refresh_once(timeout=10.0)
-            self._poller.start()
-            self._reaper.start()
+        # The router is process-global (handle.py), so first use can race
+        # across threads: only one may start the background threads, and
+        # latecomers must wait for the synchronous first table fetch.
+        with self._start_lock:
+            if not self._started:
+                # Synchronous first fetch so the first request sees a table.
+                self._refresh_once(timeout=10.0)
+                self._poller.start()
+                self._reaper.start()
+                self._started = True
 
     def stop(self):
         self._stopped = True
@@ -58,7 +65,8 @@ class Router:
         import time
 
         self._ensure_started()
-        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        start = time.monotonic()
+        deadline = None if timeout_s is None else start + timeout_s
         with self._lock:
             while True:
                 entry = self._table.get(deployment)
@@ -69,6 +77,14 @@ class Router:
                         self._inflight[replica_id] = \
                             self._inflight.get(replica_id, 0) + 1
                         break
+                # A name absent from the table is (after a short grace for
+                # an in-progress deploy) an error, not backpressure — don't
+                # park forever on a typo.
+                if entry is None and \
+                        time.monotonic() - start > self.UNKNOWN_GRACE_S:
+                    raise KeyError(
+                        f"no deployment named {deployment!r} "
+                        f"(known: {sorted(self._table)})")
                 # No replicas yet or all saturated: wait for a table change
                 # or a completion (reaper notifies).
                 wait_t = 1.0

@@ -1,88 +1,122 @@
-"""ActorPool: load-balance tasks over a fixed set of actors.
+"""ActorPool: load-balance a stream of work items over a fixed set of actors.
 
-Equivalent of `python/ray/util/actor_pool.py:8`.
+API surface matches the reference utility (`python/ray/util/actor_pool.py:8`);
+the implementation is built around per-item ``_Slot`` records rather than
+parallel index maps: every submission gets a slot with a monotonically
+increasing sequence number, slots move backlog -> running -> harvested, and
+the two consumption orders (submission order vs completion order) are just
+two ways of picking the next slot to harvest.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List
+import collections
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+@dataclass
+class _Slot:
+    seq: int
+    ref: Any  # in-flight object ref
+    actor: Any
 
 
 class ActorPool:
     def __init__(self, actors: List[Any]):
-        self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits = []
+        self._free: collections.deque = collections.deque(actors)
+        self._backlog: collections.deque = collections.deque()  # (fn, arg)
+        self._running: dict = {}  # ref -> _Slot
+        self._slots: dict = {}  # seq -> _Slot, until harvested
+        self._submitted = 0  # total slots ever created
+        self._harvest_seq = 0  # next seq get_next() will return
 
-    def submit(self, fn: Callable, value: Any):
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+    # -- submission ----------------------------------------------------- #
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """Schedule ``fn(actor, value)`` on the next free actor (or queue it)."""
+        if not self._free:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free.popleft()
+        slot = _Slot(seq=self._submitted, ref=fn(actor, value), actor=actor)
+        self._submitted += 1
+        self._running[slot.ref] = slot
+        self._slots[slot.seq] = slot
+
+    def _recycle(self, slot: _Slot) -> None:
+        self._running.pop(slot.ref, None)
+        self._slots.pop(slot.seq, None)
+        self._free.append(slot.actor)
+        if self._backlog:
+            fn, value = self._backlog.popleft()
+            self.submit(fn, value)
+
+    # -- harvesting ----------------------------------------------------- #
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._slots) or bool(self._backlog)
 
-    def get_next(self, timeout: float | None = None):
-        """Next result in submission order."""
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Block for the result of the oldest unharvested submission.
+
+        A timeout leaves the slot unharvested (retry with another
+        get_next); a task error consumes the slot and re-raises.
+        """
         import ray_tpu
+        from ray_tpu.exceptions import GetTimeoutError
 
-        if self._next_return_index >= self._next_task_index:
+        if self._harvest_seq >= self._submitted:
             raise StopIteration("No more results to get")
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        value = ray_tpu.get(future, timeout=timeout)
-        self._return_actor(future)
+        slot = self._slots[self._harvest_seq]
+        try:
+            value = ray_tpu.get(slot.ref, timeout=timeout)
+        except (GetTimeoutError, TimeoutError):
+            raise
+        except Exception:
+            self._harvest_seq += 1
+            self._recycle(slot)
+            raise
+        self._harvest_seq += 1
+        self._recycle(slot)
         return value
 
-    def get_next_unordered(self, timeout: float | None = None):
-        """Next completed result, any order."""
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Block for whichever in-flight submission finishes first."""
         import ray_tpu
 
-        if not self._future_to_actor:
+        if not self._running:
             raise StopIteration("No more results to get")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+        ready, _ = ray_tpu.wait(list(self._running), num_returns=1,
                                 timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
-        future = ready[0]
-        i, _actor = self._future_to_actor[future]
-        self._index_to_future.pop(i, None)
-        value = ray_tpu.get(future)
-        self._return_actor(future)
+        slot = self._running[ready[0]]
+        value = ray_tpu.get(slot.ref)
+        self._recycle(slot)
         return value
 
-    def _return_actor(self, future):
-        _, actor = self._future_to_actor.pop(future)
-        self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+    # -- bulk helpers --------------------------------------------------- #
 
-    def map(self, fn: Callable, values: Iterable[Any]):
+    def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
         for v in values:
             self.submit(fn, v)
         while self.has_next():
             yield self.get_next()
 
-    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+    def map_unordered(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
         for v in values:
             self.submit(fn, v)
         while self.has_next():
             yield self.get_next_unordered()
 
+    # -- direct actor management ---------------------------------------- #
+
     def has_free(self) -> bool:
-        return bool(self._idle)
+        return bool(self._free)
 
-    def pop_idle(self):
-        return self._idle.pop() if self._idle else None
+    def pop_idle(self) -> Optional[Any]:
+        return self._free.pop() if self._free else None
 
-    def push(self, actor):
-        self._idle.append(actor)
+    def push(self, actor: Any) -> None:
+        self._free.append(actor)

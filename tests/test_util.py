@@ -1,0 +1,69 @@
+"""Utility-library tests: ActorPool, Queue.
+
+Coverage mirrors the reference's `python/ray/util/` unit tests.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+class PoolWorker:
+    def __init__(self, slow_on=None):
+        self.slow_on = slow_on
+
+    def double(self, x):
+        import time
+
+        if self.slow_on is not None and x == self.slow_on:
+            time.sleep(0.3)
+        return 2 * x
+
+
+def _make_pool(n=2, **kw):
+    cls = ray_tpu.remote(PoolWorker)
+    return ActorPool([cls.remote(**kw) for _ in range(n)])
+
+
+def test_actor_pool_map_ordered(ray_start_shared):
+    pool = _make_pool(2)
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(8))) == [
+        2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered_completes_all(ray_start_shared):
+    # Item 0 is slow on one actor: unordered results must still be complete,
+    # and a fast item should be able to finish before the slow one.
+    pool = _make_pool(2, slow_on=0)
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(6)))
+    assert sorted(out) == [2 * i for i in range(6)]
+
+
+def test_actor_pool_backlog_exceeds_actors(ray_start_shared):
+    # More submissions than actors: backlog drains as actors free up.
+    pool = _make_pool(2)
+    for i in range(10):
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    results = []
+    while pool.has_next():
+        results.append(pool.get_next())
+    assert results == [2 * i for i in range(10)]
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_get_next_unordered_empty_raises(ray_start_shared):
+    pool = _make_pool(1)
+    with pytest.raises(StopIteration):
+        pool.get_next_unordered()
+
+
+def test_actor_pool_push_pop_idle(ray_start_shared):
+    pool = _make_pool(2)
+    a = pool.pop_idle()
+    assert a is not None
+    assert pool.has_free()  # one left
+    pool.push(a)
+    assert len(pool._free) == 2
