@@ -63,6 +63,9 @@ _flag("memory_monitor_refresh_ms", int, 0, "Memory monitor period; 0 disables")
 _flag("gcs_storage", str, "memory", "GCS table storage backend: memory | file")
 _flag("gcs_storage_path", str, "", "Persistence path for the file storage backend")
 _flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
+_flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creating task after object loss")
+_flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")
+_flag("object_transfer_chunk_bytes", int, 16 * 1024 * 1024, "Node-to-node object transfer chunk size")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 
 # --- TPU / JAX specifics ----------------------------------------------------

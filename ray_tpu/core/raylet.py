@@ -33,7 +33,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.common import CPU, TPU, NodeInfo, TaskSpec
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
-from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.object_store import ObjectStoreFullError, SharedMemoryStore
 from ray_tpu.core.rpc import Connection, RpcClient, RpcServer
 from ray_tpu.exceptions import RaySystemError
 
@@ -336,6 +336,12 @@ class Raylet:
         self._pending_actor_creates: Dict[ActorID, Dict[str, Any]] = {}
         self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}  # (pgid, idx) -> record
         self._pulls_inflight: Set[ObjectID] = set()
+        # Local clients blocked on an object (event-driven get: the raylet
+        # pushes object_ready/object_unavailable instead of clients polling).
+        self._object_waiters: Dict[ObjectID, List[Connection]] = defaultdict(list)
+        # Non-retryable local pull failures (e.g. object exceeds store
+        # capacity): surfaced through get_or_pull instead of endless retry.
+        self._pull_errors: Dict[ObjectID, str] = {}
         self._stopped = threading.Event()
         self._dispatch_event = threading.Event()
         # GCS client with pubsub push handling
@@ -418,7 +424,9 @@ class Raylet:
         elif channel == "OBJECT":
             oid = ObjectID(data["key"])
             with self._lock:
-                has_waiters = oid in self._waiting_deps or oid in self._pulls_inflight
+                has_waiters = (oid in self._waiting_deps
+                               or oid in self._pulls_inflight
+                               or oid in self._object_waiters)
             if has_waiters:
                 entry = data["message"]
                 if entry.get("inline") is not None:
@@ -518,21 +526,39 @@ class Raylet:
             except Exception:
                 logger.exception("dispatch loop error")
 
+    # Dispatch policy (reference picks from a scored top-k rather than
+    # strict FIFO, `hybrid_scheduling_policy.h:61`): scan past tasks whose
+    # resources aren't available right now, so an infeasible or busy head
+    # never wedges the node. Anti-starvation: once a *feasible* task has
+    # waited past the aging threshold, nothing younger may jump it — the
+    # node drains until its resources fit. Never-feasible tasks (requests
+    # exceeding node total) can't age-block since they can't drain-to-fit.
+    _DISPATCH_SCAN_LIMIT = 128
+    _DISPATCH_AGING_S = 10.0
+
     def _dispatch_once(self):
         progressed = True
         while progressed and not self._stopped.is_set():
             progressed = False
             with self._lock:
+                now = time.monotonic()
                 ready_idx = None
+                scanned = 0
                 for i, qt in enumerate(self._queue):
-                    if not qt.deps_remaining:
+                    if qt.deps_remaining:
+                        continue
+                    if self.resources.try_acquire(qt.spec.resources):
                         ready_idx = i
+                        break
+                    if (now - qt.queued_at > self._DISPATCH_AGING_S
+                            and self.resources.feasible(qt.spec.resources)):
+                        break  # aged feasible task: reserve, don't bypass
+                    scanned += 1
+                    if scanned >= self._DISPATCH_SCAN_LIMIT:
                         break
                 if ready_idx is None:
                     return
                 qt = self._queue[ready_idx]
-                if not self.resources.try_acquire(qt.spec.resources):
-                    return  # FIFO head-of-line; resources busy
                 del self._queue[ready_idx]
             env = self._env_for(qt.spec)
             worker = self.pool.pop_idle(env)
@@ -778,6 +804,12 @@ class Raylet:
             doomed = [t for t, c in self._task_submitters.items() if c is conn]
             for t in doomed:
                 del self._task_submitters[t]
+            for oid in list(self._object_waiters):
+                ws = self._object_waiters[oid]
+                if conn in ws:
+                    ws.remove(conn)
+                    if not ws:
+                        del self._object_waiters[oid]
 
     # ------------------------------------------------------ actor creation
 
@@ -901,29 +933,75 @@ class Raylet:
                     except StopIteration:
                         continue
                 try:
-                    peer = self._peer(addr)
-                    resp = peer.call("pull_object", {"object_id": oid},
-                                     timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
-                    if resp.get("data") is not None:
-                        if not self.store.contains(oid):
-                            buf = self.store.create(oid, len(resp["data"]))
-                            buf[:] = resp["data"]
-                            self.store.seal(oid)
+                    if self._pull_from_peer(oid, addr):
                         self.gcs.call("object_location_add",
                                       {"object_id": oid, "node_id": self.node_id,
-                                       "size": len(resp["data"])}, timeout=10)
+                                       "size": entry.get("size", 0)}, timeout=10)
                         with self._lock:
                             self._pulls_inflight.discard(oid)
                         self._on_object_local(oid)
                         return
                 except Exception:
                     logger.warning("pull of %s from %s failed", oid, addr, exc_info=True)
+            # Every advertised location failed (or there were none): wake
+            # blocked owners so they can reconstruct instead of hanging.
             with self._lock:
                 self._pulls_inflight.discard(oid)
+            self._notify_object_waiters(oid, "object_unavailable")
         except Exception:
             with self._lock:
                 self._pulls_inflight.discard(oid)
             logger.exception("pull worker failed for %s", oid)
+
+    def _pull_from_peer(self, oid: ObjectID, addr: str) -> bool:
+        """Stream one object from a peer raylet in bounded chunks.
+
+        The reference moves objects as flow-controlled chunk streams
+        (`object_manager.h:206`, `object_buffer_pool.h`) so a 1 GiB object
+        never materializes as a single RPC frame on either side; same here:
+        per-chunk RPCs into a pre-created store buffer.
+        """
+        chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+        peer = self._peer(addr)
+        first = peer.call("pull_object",
+                          {"object_id": oid, "offset": 0, "length": chunk},
+                          timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
+        if first.get("data") is None:
+            return False
+        size = first.get("size", len(first["data"]))
+        if self.store.contains(oid):
+            return True
+        try:
+            buf = self.store.create(oid, size)
+        except ObjectStoreFullError as e:
+            # Non-retryable for this node: remember it so get_or_pull can
+            # surface a typed error instead of the client retrying forever.
+            with self._lock:
+                self._pull_errors[oid] = str(e)
+            raise
+        ok = False
+        try:
+            data = first["data"]
+            buf[: min(len(data), size)] = data[:size]
+            pos = min(len(data), size)
+            while pos < size:
+                resp = peer.call(
+                    "pull_object",
+                    {"object_id": oid, "offset": pos, "length": chunk},
+                    timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
+                data = resp.get("data")
+                if not data:
+                    return False
+                buf[pos: pos + len(data)] = data
+                pos += len(data)
+            self.store.seal(oid)
+            ok = True
+            with self._lock:
+                self._pull_errors.pop(oid, None)
+            return True
+        finally:
+            if not ok:
+                self.store.delete(oid)  # never leave an unsealed buffer
 
     def _peer(self, address: str) -> RpcClient:
         with self._lock:
@@ -934,14 +1012,27 @@ class Raylet:
             return client
 
     def handle_pull_object(self, conn: Connection, data: Dict[str, Any]):
+        """Serve one chunk (or, without offset, the whole object)."""
         oid: ObjectID = data["object_id"]
-        raw = self.store.get_bytes(oid)
-        return {"data": raw}
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            return {"data": None}
+        if "offset" not in data:
+            return {"data": bytes(buf), "size": len(buf)}
+        off = int(data["offset"])
+        length = int(data.get("length") or len(buf))
+        return {"data": bytes(buf[off: off + length]), "size": len(buf)}
 
     def handle_get_or_pull(self, conn: Connection, data: Dict[str, Any]):
-        """Local client wants this object available in the node store."""
+        """Local client wants this object available in the node store.
+
+        Event-driven (no server-side poll loop — a blocking handler would
+        also head-of-line-block every other RPC on the caller's
+        connection): answers immediately with local/inline, or registers
+        the connection as a waiter, starts a pull, and later pushes
+        `object_ready` / `object_unavailable` down the caller's channel.
+        """
         oid: ObjectID = data["object_id"]
-        timeout = data.get("timeout", 60.0)
         # get_buffer (not contains) so spilled objects are restored to shm
         # before we tell the client to attach the segment.
         if self.store.get_buffer(oid) is not None:
@@ -949,23 +1040,41 @@ class Raylet:
         entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=10)
         if entry.get("known") and entry.get("inline") is not None:
             return {"status": "inline", "data": entry["inline"]}
+        with self._lock:
+            pull_error = self._pull_errors.get(oid)
+            if pull_error is not None:
+                return {"status": "error", "error": pull_error}
+            waiters = self._object_waiters[oid]
+            if conn not in waiters:
+                waiters.append(conn)
         self._start_pull(oid)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.store.get_buffer(oid) is not None:
-                return {"status": "local"}
-            entry = None
-            time.sleep(0.005)
+        # Re-check after registration: the pull may have completed between
+        # the first check and the waiter insert (notify already fired).
+        if self.store.get_buffer(oid) is not None:
             with self._lock:
-                inflight = oid in self._pulls_inflight
-            if not inflight and not self.store.contains(oid):
-                # Check for inline that appeared meanwhile, else retry pull.
-                e = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=10)
-                if e.get("known") and e.get("inline") is not None:
-                    return {"status": "inline", "data": e["inline"]}
-                self._start_pull(oid)
-                time.sleep(0.05)
-        return {"status": "timeout"}
+                ws = self._object_waiters.get(oid)
+                if ws is not None:
+                    try:
+                        ws.remove(conn)
+                    except ValueError:
+                        pass
+                    if not ws:
+                        self._object_waiters.pop(oid, None)
+            return {"status": "local"}
+        # has_copies tells the owner whether reconstruction is needed: the
+        # entry exists but every holding node is gone.
+        return {"status": "pending", "known": bool(entry.get("known")),
+                "has_copies": bool(entry.get("nodes"))}
+
+    def _notify_object_waiters(self, oid: ObjectID, method: str):
+        with self._lock:
+            conns = self._object_waiters.pop(oid, [])
+        for conn in conns:
+            if conn.alive:
+                try:
+                    conn.push(method, {"object_id": oid})
+                except Exception:  # noqa: BLE001 — client gone
+                    pass
 
     def _on_object_local(self, oid: ObjectID):
         """Dependency became available locally (or inline): unblock tasks."""
@@ -975,6 +1084,22 @@ class Raylet:
                 qt.deps_remaining.discard(oid)
         if waiters:
             self._dispatch_event.set()
+        self._notify_object_waiters(oid, "object_ready")
+
+    def handle_cancel_object_wait(self, conn: Connection, data: Dict[str, Any]):
+        """Client gave up on a get (timeout): drop its waiter entry so the
+        raylet stops pulling on behalf of nobody."""
+        oid: ObjectID = data["object_id"]
+        with self._lock:
+            ws = self._object_waiters.get(oid)
+            if ws is not None:
+                try:
+                    ws.remove(conn)
+                except ValueError:
+                    pass
+                if not ws:
+                    del self._object_waiters[oid]
+        return {}
 
     def handle_delete_objects(self, conn: Connection, data: Dict[str, Any]):
         for oid in data["object_ids"]:

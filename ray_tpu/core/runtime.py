@@ -40,7 +40,8 @@ _PENDING = object()
 
 
 class _TaskRecord:
-    __slots__ = ("event", "results", "error", "crashed", "spec", "attempts")
+    __slots__ = ("event", "results", "error", "crashed", "spec", "attempts",
+                 "reconstructions")
 
     def __init__(self, spec: Optional[TaskSpec] = None):
         self.event = threading.Event()
@@ -49,6 +50,7 @@ class _TaskRecord:
         self.crashed = False
         self.spec = spec
         self.attempts = 0
+        self.reconstructions = 0  # lineage re-executions after object loss
 
 
 class ReconnectingClient:
@@ -157,6 +159,14 @@ class CoreRuntime:
         self._ref_counts: Dict[bytes, int] = defaultdict(int)
         self._dep_pins: Dict[bytes, int] = defaultdict(int)
         self._deferred_free: set = set()
+        # Event-driven object availability: the raylet pushes
+        # object_ready/object_unavailable instead of this process polling.
+        # oid -> [Event, refcount]; refcounted so concurrent getters of the
+        # same object share wakeups and the entry outlives the first getter.
+        self._object_events: Dict[bytes, list] = {}
+        # Any-completion signal for wait(): set on every task result and
+        # object event so waiters wake immediately instead of sleeping.
+        self._completion_event = threading.Event()
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
@@ -206,6 +216,12 @@ class CoreRuntime:
                     except Exception:
                         pass
             rec.event.set()
+            self._completion_event.set()
+        elif method in ("object_ready", "object_unavailable"):
+            entry = self._object_events.get(data["object_id"].binary())
+            if entry is not None:
+                entry[0].set()
+            self._completion_event.set()
         elif method == "execute_task":
             # Only workers receive this; WorkerLoop overrides via subclassing hook.
             self.on_execute_task(data["spec"])
@@ -486,12 +502,12 @@ class CoreRuntime:
 
     def _get_one(self, oid: ObjectID, deadline: Optional[float], on_block=None) -> Any:
         key = oid.binary()
-        cached = self._object_cache.get(key, _PENDING)
-        if cached is not _PENDING:
-            return self._maybe_raise(cached)
-        task_key = self._object_to_task.get(key)
-        if task_key is not None:
-            rec = self._tasks.get(task_key)
+        while True:
+            cached = self._object_cache.get(key, _PENDING)
+            if cached is not _PENDING:
+                return self._maybe_raise(cached)
+            task_key = self._object_to_task.get(key)
+            rec = self._tasks.get(task_key) if task_key is not None else None
             if rec is not None:
                 if not rec.event.is_set():
                     if on_block:
@@ -510,26 +526,159 @@ class CoreRuntime:
                 if cached is not _PENDING:
                     return self._maybe_raise(cached)
                 # Large result: fall through to store fetch.
-        # Store / directory path
-        value = self.store.get_value(oid) if self.store.contains(oid) else _PENDING
-        if value is not _PENDING:
+            value = self.store.get_value(oid) if self.store.contains(oid) else _PENDING
+            if value is not _PENDING:
+                self._object_cache[key] = value
+                return self._maybe_raise(value)
+            if on_block:
+                on_block()
+            status, data = self._fetch_via_raylet(oid, deadline)
+            if status == "local":
+                value = self.store.get_value(oid)
+            elif status == "inline":
+                value = serialization.deserialize(data)
+            elif status == "lost" and self._try_reconstruct(oid):
+                # Creating task resubmitted: loop back and wait on it.
+                continue
+            else:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"Timed out getting {oid}")
+                raise ObjectLostError(oid)
             self._object_cache[key] = value
             return self._maybe_raise(value)
-        if on_block:
-            on_block()
-        remaining = 3600.0 if deadline is None else max(0.0, deadline - time.monotonic())
-        resp = self.raylet.call("get_or_pull", {"object_id": oid, "timeout": remaining},
-                                timeout=remaining + 10)
-        if resp["status"] == "local":
-            value = self.store.get_value(oid)
-        elif resp["status"] == "inline":
-            value = serialization.deserialize(resp["data"])
-        else:
-            if deadline is not None and time.monotonic() >= deadline:
-                raise GetTimeoutError(f"Timed out getting {oid}")
-            raise ObjectLostError(oid)
-        self._object_cache[key] = value
-        return self._maybe_raise(value)
+
+    def _fetch_via_raylet(self, oid: ObjectID, deadline: Optional[float]
+                          ) -> Tuple[str, Any]:
+        """Make the object available via the local raylet, event-driven.
+
+        get_or_pull answers local/inline immediately or registers this
+        process as a waiter and returns "pending"; the raylet then pushes
+        object_ready / object_unavailable (no 5 ms poll loops on either
+        side — reference pull manager behavior, `pull_manager.h:52`).
+        Returns (status, inline_data|None); status in
+        {local, inline, lost, error, timeout}.
+        """
+        key = oid.binary()
+        with self._lock:
+            entry = self._object_events.get(key)
+            if entry is None:
+                entry = self._object_events[key] = [threading.Event(), 0]
+            entry[1] += 1
+        ev = entry[0]
+        status = "timeout"
+        try:
+            while True:
+                ev.clear()
+                resp = self.raylet.call("get_or_pull", {"object_id": oid},
+                                        timeout=30)
+                status = resp["status"]
+                if status in ("local", "inline"):
+                    return status, resp.get("data")
+                if status == "error":
+                    # Non-retryable local failure (e.g. object larger than
+                    # the node store) — raise, don't loop.
+                    raise RaySystemError(
+                        f"cannot materialize {oid}: {resp.get('error')}")
+                # "pending": a known entry with zero copies means every
+                # holder died — the owner should reconstruct, not wait.
+                if resp.get("known") and not resp.get("has_copies"):
+                    return "lost", None
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return "timeout", None
+                # Wake instantly on the raylet's push; the 1 s cap is a
+                # safety net for transitions with no push (e.g. the holding
+                # node died while we waited).
+                wait_t = 1.0 if remaining is None else min(1.0, remaining)
+                ev.wait(wait_t)
+        finally:
+            with self._lock:
+                entry[1] -= 1
+                if entry[1] <= 0:
+                    self._object_events.pop(key, None)
+            if status in ("timeout", "lost"):
+                # Deregister from the raylet so it stops pulling for nobody.
+                try:
+                    self.raylet.call("cancel_object_wait",
+                                     {"object_id": oid}, timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _try_reconstruct(self, oid: ObjectID, depth: int = 0) -> bool:
+        """Owner-side lineage reconstruction: re-execute the creating task
+        when every copy of one of its returns is gone (reference
+        `object_recovery_manager.h:106`; bounded like `task_manager.h:97`).
+
+        Only the owner holds the spec, so only the owner can recover; puts
+        and actor-task results are not replayable. Missing dependencies are
+        rebuilt first, bottom-up, capped by depth and per-task attempt
+        budget. Returns True if a re-execution is (already) in flight.
+        """
+        if depth > GLOBAL_CONFIG.max_reconstruction_depth:
+            return False
+        key = oid.binary()
+        with self._lock:
+            task_key = self._object_to_task.get(key)
+            rec = self._tasks.get(task_key) if task_key is not None else None
+            if rec is None or rec.spec is None:
+                return False  # not ours, or a put: unrecoverable
+            spec = rec.spec
+            if spec.actor_id is not None or spec.actor_creation:
+                return False  # actor state is not replayable
+            if not rec.event.is_set():
+                return True  # concurrent getter already resubmitted
+            if rec.reconstructions >= GLOBAL_CONFIG.max_object_reconstructions:
+                return False
+            rec.reconstructions += 1
+            rec.event.clear()
+            rec.results = None
+            rec.error = None
+            for r in spec.return_ids():
+                self._object_cache.pop(r.binary(), None)
+        logger.warning("object %s lost: re-executing task %s (attempt %d)",
+                       oid.hex()[:12], spec.name, rec.reconstructions)
+        for dep in spec.dependencies():
+            if not self._dep_alive(dep) and not self._try_reconstruct(dep, depth + 1):
+                self._fail_task_record(rec, spec, serialization.serialize_exception(
+                    ObjectLostError(dep)))
+                return True  # the error record is the answer
+        self._pin_deps(spec)
+        try:
+            self._submit_spec(spec)
+        except Exception as e:  # noqa: BLE001
+            self._unpin_deps(spec)
+            self._fail_task_record(rec, spec, serialization.serialize_exception(
+                RaySystemError(f"reconstruction submit failed: {e}")))
+        return True
+
+    def _fail_task_record(self, rec: _TaskRecord, spec: TaskSpec, blob: bytes):
+        """Record a terminal error AND materialize it as the task's return
+        objects in the directory, so tasks elsewhere that depend on them
+        get scheduled and re-raise instead of waiting forever (same
+        contract as the normal completion path in _on_raylet_push)."""
+        with self._lock:
+            rec.error = blob
+            rec.event.set()
+        for oid in spec.return_ids():
+            try:
+                self.gcs.call("object_location_add",
+                              {"object_id": oid, "inline": blob,
+                               "size": len(blob)}, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        self._completion_event.set()
+
+    def _dep_alive(self, oid: ObjectID) -> bool:
+        """Cluster-visible existence: inline in the directory or at least
+        one live node holds a copy."""
+        try:
+            e = self.gcs.call("object_locations_get", {"object_id": oid},
+                              timeout=5)
+        except Exception:  # noqa: BLE001
+            return False
+        return bool(e.get("known")
+                    and (e.get("inline") is not None or e.get("nodes")))
 
     # ---------------------------------------------------------------- wait
 
@@ -538,8 +687,10 @@ class CoreRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectID] = []
         pending = list(object_ids)
-        sleep = 0.001
         while True:
+            # Clear-then-scan: a completion landing during the scan re-sets
+            # the event, so the next wait() returns immediately.
+            self._completion_event.clear()
             still = []
             for oid in pending:
                 if self._is_ready(oid):
@@ -551,8 +702,12 @@ class CoreRuntime:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(sleep)
-            sleep = min(sleep * 2, 0.05)
+            # Task results and object events set _completion_event (pushed
+            # over the raylet channel) — wake instantly on progress; the
+            # 100 ms cap covers store-only transitions with no push.
+            wait_t = 0.1 if deadline is None \
+                else min(0.1, max(0.0, deadline - time.monotonic()))
+            self._completion_event.wait(wait_t)
         # Preserve input order; cap ready at num_returns (overflow stays
         # in the pending list, matching the reference wait() contract).
         ready_set = {r.binary() for r in ready}

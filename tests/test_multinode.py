@@ -1,0 +1,174 @@
+"""Multi-node behaviors: cross-node transfer, lineage reconstruction,
+scheduling fairness.
+
+Mirrors the reference's `python/ray/tests/test_reconstruction.py` and
+object-manager transfer tests, on the in-process Cluster sim.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import ObjectLostError
+
+
+@pytest.fixture()
+def two_node_cluster():
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    cluster.wait_for_nodes()
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def make_blob(mb: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, size=mb * 1024 * 1024, dtype=np.uint8)
+
+
+@ray_tpu.remote
+def bump_and_blob(counter_path: str, mb: int):
+    # Side-effect counter proves re-execution (not a cached copy).
+    with open(counter_path, "a") as f:
+        f.write("x")
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 255, size=mb * 1024 * 1024, dtype=np.uint8)
+
+
+@ray_tpu.remote
+def add_one(arr):
+    return arr.astype(np.int64) + 1
+
+
+@ray_tpu.remote
+def checksum(arr):
+    return int(arr.astype(np.int64).sum())
+
+
+def test_cross_node_chunked_pull(two_node_cluster):
+    """A multi-chunk object produced on node B is readable from the driver
+    (pulled to the head store in bounded chunks, not one giant RPC)."""
+    ref = make_blob.options(resources={"side": 1}).remote(40)
+    arr = ray_tpu.get(ref, timeout=120)
+    expect = np.random.default_rng(0).integers(
+        0, 255, size=40 * 1024 * 1024, dtype=np.uint8)
+    assert arr.nbytes == 40 * 1024 * 1024
+    np.testing.assert_array_equal(arr[:4096], expect[:4096])
+    np.testing.assert_array_equal(arr[-4096:], expect[-4096:])
+
+
+def test_lineage_reconstruction_after_node_death(two_node_cluster):
+    """Reference object_recovery_manager behavior: when the only copy of a
+    task return dies with its node, the owner re-executes the creating
+    task and get() succeeds."""
+    cluster = two_node_cluster
+    counter = os.path.join(tempfile.mkdtemp(), "execs")
+    ref = bump_and_blob.options(resources={"side": 1}).remote(counter, 2)
+    # Materialize via a consumer ON node B: the driver must never fetch
+    # the value (a driver-side cached copy would satisfy the later get
+    # without recovery).
+    ray_tpu.get(checksum.options(resources={"side": 1}).remote(ref),
+                timeout=60)
+    assert open(counter).read() == "x"
+
+    side_node = cluster.raylets[1]
+    cluster.remove_node(side_node)          # the only copy dies with it
+    cluster.add_node(num_cpus=2, resources={"side": 2})  # re-exec target
+    cluster.wait_for_nodes()
+
+    again = ray_tpu.get(ref, timeout=120)
+    assert open(counter).read() == "xx", "task was not re-executed"
+    assert again.nbytes == 2 * 1024 * 1024
+    np.testing.assert_array_equal(
+        again[:1024],
+        np.random.default_rng(7).integers(
+            0, 255, size=2 * 1024 * 1024, dtype=np.uint8)[:1024])
+
+
+def test_recursive_reconstruction_of_missing_dep(two_node_cluster):
+    """If the lost object's dependency is ALSO lost, the owner rebuilds the
+    lineage bottom-up (dep first, then the consumer)."""
+    cluster = two_node_cluster
+    base = make_blob.options(resources={"side": 1}).remote(1, seed=3)
+    out = add_one.options(resources={"side": 1}).remote(base)
+    # Materialize both on node B without pulling either to the driver.
+    ray_tpu.get(checksum.options(resources={"side": 1}).remote(out),
+                timeout=60)
+
+    side_node = cluster.raylets[1]
+    cluster.remove_node(side_node)          # loses BOTH objects
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    cluster.wait_for_nodes()
+
+    val = ray_tpu.get(out, timeout=120)
+    expect = np.random.default_rng(3).integers(
+        0, 255, size=1024 * 1024, dtype=np.uint8).astype(np.int64) + 1
+    np.testing.assert_array_equal(val[:1024], expect[:1024])
+
+
+def test_put_objects_are_not_reconstructable(two_node_cluster):
+    """ray.put has no lineage: losing every copy surfaces ObjectLostError
+    (reference semantics — only task returns are recoverable)."""
+    cluster = two_node_cluster
+
+    @ray_tpu.remote
+    def put_on_node():
+        import numpy as _np
+
+        import ray_tpu as _rt
+
+        inner = _rt.put(_np.ones(1024 * 1024, dtype=_np.uint8))
+        return [inner]  # keep the inner ref alive via the outer list
+
+    (inner_ref,) = ray_tpu.get(
+        put_on_node.options(resources={"side": 1}).remote(), timeout=60)
+    side_node = cluster.raylets[1]
+    cluster.remove_node(side_node)
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    cluster.wait_for_nodes()
+    with pytest.raises((ObjectLostError, ray_tpu.exceptions.GetTimeoutError)):
+        ray_tpu.get(inner_ref, timeout=15)
+
+
+def test_oversized_pull_raises_instead_of_hanging():
+    """An object larger than the destination store surfaces a typed error
+    (non-retryable) rather than retrying the pull forever."""
+    from ray_tpu.exceptions import RaySystemError
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2,
+                                      "object_store_memory": 4 * 1024 * 1024})
+    try:
+        cluster.add_node(num_cpus=2, resources={"side": 2},
+                         object_store_memory=64 * 1024 * 1024)
+        cluster.wait_for_nodes()
+        cluster.connect()
+        ref = make_blob.options(resources={"side": 1}).remote(16)
+        with pytest.raises((RaySystemError, ray_tpu.exceptions.GetTimeoutError)):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        cluster.shutdown()
+
+
+def test_small_tasks_schedule_past_infeasible_head(ray_start_regular):
+    """No FIFO head-of-line blocking: a queued task whose resources can
+    never be satisfied must not stall feasible work behind it (reference
+    scored top-k selection, hybrid_scheduling_policy.h)."""
+
+    @ray_tpu.remote
+    def quick(i):
+        return i * 2
+
+    blocked = quick.options(num_cpus=99).remote(0)  # infeasible forever
+    results = ray_tpu.get([quick.remote(i) for i in range(20)], timeout=60)
+    assert results == [2 * i for i in range(20)]
+    ready, not_ready = ray_tpu.wait([blocked], timeout=0.1)
+    assert not ready and not_ready == [blocked]
