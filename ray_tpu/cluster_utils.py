@@ -19,8 +19,10 @@ from ray_tpu.core.raylet import Raylet
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[Dict] = None):
-        self.gcs = GcsServer()
+                 head_node_args: Optional[Dict] = None,
+                 gcs_storage_path: Optional[str] = None):
+        self._gcs_storage_path = gcs_storage_path
+        self.gcs = GcsServer(storage_path=gcs_storage_path)
         self.gcs.start()
         self.session_dir = default_session_dir()
         self.raylets: List[Raylet] = []
@@ -68,6 +70,21 @@ class Cluster:
             pass
         self.raylets = [r for r in self.raylets if r is not raylet]
 
+    def kill_gcs(self):
+        """Stop the GCS process (fault injection). Raylets and drivers keep
+        running and reconnect when `restart_gcs` brings it back."""
+        self.gcs.stop()
+
+    def restart_gcs(self):
+        """Bring the GCS back at the SAME address, restoring tables from the
+        persistence path (requires `gcs_storage_path`)."""
+        if not self._gcs_storage_path:
+            raise ValueError("restart_gcs requires gcs_storage_path")
+        host, port = self.gcs.address.rsplit(":", 1)
+        self.gcs = GcsServer(host=host, port=int(port),
+                             storage_path=self._gcs_storage_path)
+        self.gcs.start()
+
     def wait_for_nodes(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout
         want = len(self.raylets)
@@ -98,3 +115,52 @@ class Cluster:
                 pass
         self.raylets = []
         self.gcs.stop()
+
+
+class NodeKiller:
+    """Chaos fault injector: kill a random non-head node every `period_s`,
+    optionally replacing it so capacity recovers (reference
+    `python/ray/_private/test_utils.py` NodeKillerActor).
+
+    Use as a context manager around a workload that must survive node
+    churn (task retries + actor restarts + lineage reconstruction).
+    """
+
+    def __init__(self, cluster: Cluster, period_s: float = 2.0,
+                 replace: bool = True, max_kills: int = 1000,
+                 node_args: Optional[Dict] = None):
+        self.cluster = cluster
+        self.period_s = period_s
+        self.replace = replace
+        self.max_kills = max_kills
+        self.node_args = node_args or {}
+        self.kills = 0
+        self._stop = None
+        self._thread = None
+
+    def _loop(self):
+        import random
+
+        while not self._stop.wait(self.period_s):
+            victims = [r for r in self.cluster.raylets if not r.is_head]
+            if not victims or self.kills >= self.max_kills:
+                continue
+            victim = random.choice(victims)
+            self.cluster.remove_node(victim)
+            self.kills += 1
+            if self.replace:
+                self.cluster.add_node(**self.node_args)
+
+    def __enter__(self):
+        import threading as _t
+
+        self._stop = _t.Event()
+        self._thread = _t.Thread(target=self._loop, name="node-killer",
+                                 daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        return False

@@ -90,8 +90,11 @@ class Pubsub:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.server = RpcServer(host=host, port=port, name="gcs")
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
+        # reuse_port so a failover GCS can rebind the previous address.
+        self.server = RpcServer(host=host, port=port, name="gcs",
+                                reuse_port=True)
         self.server.register_instance(self)
         self.server.on_disconnect = self._on_disconnect
         self.pubsub = Pubsub()
@@ -116,6 +119,17 @@ class GcsServer:
         self._job_counter = 1
         self._stopped = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # Table persistence (reference GCS fault tolerance keeps its tables
+        # in an external store, `redis_store_client.h:28`; here: periodic
+        # atomic snapshots to disk, reloaded by a restarted GCS at the same
+        # address). Enabled by an explicit path or the file storage flag.
+        if storage_path is None and GLOBAL_CONFIG.gcs_storage == "file":
+            storage_path = GLOBAL_CONFIG.gcs_storage_path or None
+        self._storage_path = storage_path
+        self._persist_thread: Optional[threading.Thread] = None
+        self._persist_lock = threading.Lock()  # one snapshot writer at a time
+        if self._storage_path:
+            self._load_tables()
 
     # ------------------------------------------------------------------ util
 
@@ -129,13 +143,79 @@ class GcsServer:
             target=self._health_check_loop, name="gcs-health", daemon=True
         )
         self._health_thread.start()
+        if self._storage_path:
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, name="gcs-persist", daemon=True)
+            self._persist_thread.start()
 
     def stop(self):
         self._stopped.set()
+        if self._storage_path:
+            try:
+                self._persist_tables()
+            except Exception:
+                logger.exception("final GCS table persist failed")
         self.server.stop()
         for c in self._raylet_clients.values():
             c.close()
         self._exec.shutdown(wait=False)
+
+    # ------------------------------------------------------ table persistence
+
+    _PERSIST_PERIOD_S = 0.5
+
+    def _persist_loop(self):
+        while not self._stopped.wait(self._PERSIST_PERIOD_S):
+            try:
+                self._persist_tables()
+            except Exception:
+                logger.exception("GCS table persist failed")
+
+    def _persist_tables(self):
+        import os
+        import pickle
+
+        with self._lock:
+            snapshot = pickle.dumps({
+                "nodes": self.nodes,
+                "actors": self.actors,
+                "named_actors": self.named_actors,
+                "jobs": self.jobs,
+                "kv": self.kv,
+                "placement_groups": self.placement_groups,
+                "job_counter": self._job_counter,
+            })
+        # Serialized writers (stop() vs the persist loop) + atomic replace:
+        # a reader never sees a torn or interleaved snapshot.
+        with self._persist_lock:
+            tmp = self._storage_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(snapshot)
+            os.replace(tmp, self._storage_path)
+
+    def _load_tables(self):
+        import os
+        import pickle
+
+        if not os.path.exists(self._storage_path):
+            return
+        with open(self._storage_path, "rb") as f:
+            state = pickle.load(f)
+        self.nodes = state["nodes"]
+        self.actors = state["actors"]
+        self.named_actors = state["named_actors"]
+        self.jobs = state["jobs"]
+        self.kv = state["kv"]
+        self.placement_groups = state["placement_groups"]
+        self._job_counter = state["job_counter"]
+        # The outage shouldn't count against liveness: give every node a
+        # fresh heartbeat window before health checks may declare it dead.
+        now = time.time()
+        for info in self.nodes.values():
+            info.last_heartbeat = now
+        logger.info("GCS restored %d nodes / %d actors / %d kv entries from %s",
+                    len(self.nodes), len(self.actors), len(self.kv),
+                    self._storage_path)
 
     def _raylet(self, node_id: NodeID) -> RpcClient:
         with self._lock:
@@ -166,7 +246,9 @@ class GcsServer:
         node_id: NodeID = data["node_id"]
         with self._lock:
             info = self.nodes.get(node_id)
-            if info is None:
+            if info is None or info.state == "DEAD":
+                # Unknown (GCS restarted without state) or declared dead
+                # during an outage: make the raylet re-register itself.
                 return {"registered": False}
             info.last_heartbeat = time.time()
             info.resources_available = data["resources_available"]
@@ -250,6 +332,17 @@ class GcsServer:
             conn.meta["job_id"] = job_id
         return {"job_id": job_id}
 
+    def handle_reattach_job(self, conn: Connection, data: Dict[str, Any]):
+        """A driver reconnecting after a GCS restart re-binds its job to the
+        new connection, so driver-exit cleanup (_on_disconnect ->
+        _finish_job) keeps working across failovers."""
+        job_id: JobID = data["job_id"]
+        with self._lock:
+            if job_id in self.jobs:
+                conn.meta["job_id"] = job_id
+                return {"ok": True}
+        return {"ok": False}
+
     def handle_get_jobs(self, conn: Connection, data=None):
         with self._lock:
             return [
@@ -281,6 +374,12 @@ class GcsServer:
             pass  # executor already shut down
 
     def _on_disconnect(self, conn: Connection):
+        if self._stopped.is_set():
+            # GCS itself is going down (shutdown or failover): connections
+            # dropping is OUR fault, not the peers' — declaring every node
+            # dead here would poison the persisted tables and kill actors
+            # that are still perfectly alive.
+            return
         self.pubsub.drop_connection(conn)
         job_id = conn.meta.get("job_id")
         if job_id is not None:

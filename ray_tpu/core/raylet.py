@@ -34,7 +34,7 @@ from ray_tpu.core.common import CPU, TPU, NodeInfo, TaskSpec
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import ObjectStoreFullError, SharedMemoryStore
-from ray_tpu.core.rpc import Connection, RpcClient, RpcServer
+from ray_tpu.core.rpc import Connection, ReconnectingClient, RpcClient, RpcServer
 from ray_tpu.exceptions import RaySystemError
 
 logger = logging.getLogger(__name__)
@@ -344,9 +344,14 @@ class Raylet:
         self._pull_errors: Dict[ObjectID, str] = {}
         self._stopped = threading.Event()
         self._dispatch_event = threading.Event()
-        # GCS client with pubsub push handling
-        self.gcs = RpcClient(gcs_address, name=f"raylet-{self.node_id.hex()[:8]}->gcs",
-                             push_handler=self._on_gcs_push)
+        # GCS client with pubsub push handling; reconnects (and re-registers
+        # this node + its subscriptions) after a GCS restart — the raylet
+        # half of GCS fault tolerance.
+        self.gcs = ReconnectingClient(
+            gcs_address, name=f"raylet-{self.node_id.hex()[:8]}->gcs",
+            push_handler=self._on_gcs_push,
+            resubscribe=self._register_with_gcs)
+        self._node_info: Optional[NodeInfo] = None
         self._peer_clients: Dict[str, RpcClient] = {}
         self._threads: List[threading.Thread] = []
 
@@ -354,7 +359,7 @@ class Raylet:
 
     def start(self):
         self.server.start()
-        info = NodeInfo(
+        self._node_info = NodeInfo(
             node_id=self.node_id,
             address=self.server.address,
             object_manager_address=self.server.address,
@@ -366,9 +371,7 @@ class Raylet:
             labels=self.labels,
             is_head=self.is_head,
         )
-        self.gcs.call("register_node", {"info": info})
-        self.gcs.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
-        self.gcs.call("subscribe", {"channel": "OBJECT", "key": b"*"})
+        self._register_with_gcs(self.gcs)
         for name, target in [
             ("raylet-dispatch", self._dispatch_loop),
             ("raylet-heartbeat", self._heartbeat_loop),
@@ -388,17 +391,28 @@ class Raylet:
             c.close()
         self.store.shutdown()
 
+    def _register_with_gcs(self, client):
+        """Announce this node and (re)establish its subscriptions. Called at
+        startup and again by the reconnecting client after a GCS restart."""
+        client.call("register_node", {"info": self._node_info})
+        client.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
+        client.call("subscribe", {"channel": "OBJECT", "key": b"*"})
+
     def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
         while not self._stopped.wait(period):
             try:
                 total, avail = self.resources.snapshot()
-                self.gcs.call(
+                resp = self.gcs.call(
                     "heartbeat",
                     {"node_id": self.node_id, "resources_available": avail,
                      "resources_total": total},
                     timeout=5,
                 )
+                if not resp.get("registered"):
+                    # A GCS that restarted without persisted node state (or
+                    # that marked us dead during the outage): re-announce.
+                    self._register_with_gcs(self.gcs)
             except Exception:
                 if self._stopped.is_set():
                     return

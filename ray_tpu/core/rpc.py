@@ -101,9 +101,16 @@ class RpcServer:
     error string to the caller, which re-raises RaySystemError.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "rpc"):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "rpc",
+                 reuse_port: bool = False):
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Opt-in REUSEPORT lets a restarted server (GCS failover) rebind its
+        # old port while the previous incarnation's accepted sockets are
+        # still draining through FIN_WAIT/TIME_WAIT. Off by default so an
+        # accidental double-bind stays a loud EADDRINUSE.
+        if reuse_port and hasattr(socket, "SO_REUSEPORT"):
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._listener.bind((host, port))
         self._listener.listen(512)
         self.host, self.port = self._listener.getsockname()
@@ -137,6 +144,14 @@ class RpcServer:
             try:
                 sock, addr = self._listener.accept()
             except OSError:
+                return
+            if self._stopped.is_set():
+                # Stopped while blocked in accept: this connection belongs
+                # to our successor (same port via REUSEPORT), not to us.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = Connection(sock, f"{addr[0]}:{addr[1]}")
@@ -191,10 +206,20 @@ class RpcServer:
 
     def stop(self):
         self._stopped.set()
+        # shutdown() (not just close) wakes a thread blocked in accept();
+        # a closed-but-still-blocked listener would otherwise keep its
+        # kernel socket in LISTEN state and steal connections from a
+        # restarted server on the same port.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
         with self._lock:
             conns = list(self._conns.values())
         for c in conns:
@@ -272,7 +297,7 @@ class RpcClient:
             self._closed.set()
             with self._pending_lock:
                 for slot in self._pending.values():
-                    slot["env"] = {"e": "connection lost"}
+                    slot["env"] = {"e": "connection lost", "_lost": True}
                     slot["payload"] = b""
                     slot["event"].set()
                 self._pending.clear()
@@ -300,6 +325,11 @@ class RpcClient:
                 self._pending.pop(msg_id, None)
             raise TimeoutError(f"{self._name}: RPC '{method}' to {self.address} timed out")
         env = slot["env"]
+        if env.get("_lost"):
+            # The connection died with this request in flight: typed as a
+            # transport failure so reconnecting callers retry.
+            raise ConnectionLost(
+                f"{self._name}: connection lost during RPC '{method}'")
         if env.get("e"):
             raise RaySystemError(f"RPC '{method}' failed remotely: {env['e']}")
         return serialization.loads(slot["payload"]) if slot["payload"] else None
@@ -314,6 +344,61 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class ReconnectingClient:
+    """RPC client that re-dials on connection loss (one retry per call).
+
+    The GCS link must survive transient drops — GCS fault tolerance lets
+    raylets and workers reconnect after a GCS restart (reference
+    `gcs_failover_worker_reconnect_timeout`); this is the client half. The
+    optional `resubscribe` callback re-establishes per-connection state
+    (pubsub subscriptions, node registration) on the fresh connection.
+    """
+
+    def __init__(self, address: str, name: str, push_handler=None,
+                 resubscribe=None):
+        self.address = address
+        self._name = name
+        self._push_handler = push_handler
+        self._resubscribe = resubscribe
+        self._lock = threading.Lock()
+        self._terminal = False  # close() is final: no resurrection
+        self._client = RpcClient(address, name=name, push_handler=push_handler)
+
+    @property
+    def is_closed(self) -> bool:
+        return self._terminal or self._client.is_closed
+
+    def _reconnect(self) -> RpcClient:
+        with self._lock:
+            if self._terminal:
+                raise ConnectionLost(f"{self._name}: client closed")
+            if self._client.is_closed:
+                self._client = RpcClient(self.address, name=self._name,
+                                         push_handler=self._push_handler)
+                if self._resubscribe is not None:
+                    try:
+                        self._resubscribe(self._client)
+                    except Exception:
+                        logger.warning("%s: resubscribe failed", self._name)
+            return self._client
+
+    def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
+        if self._terminal:
+            # A racing in-flight call must not re-dial after an intentional
+            # close — e.g. a stopped raylet's heartbeat would re-register
+            # the dead node with the GCS as ALIVE.
+            raise ConnectionLost(f"{self._name}: client closed")
+        try:
+            return self._client.call(method, data, timeout=timeout)
+        except ConnectionLost:
+            client = self._reconnect()
+            return client.call(method, data, timeout=timeout)
+
+    def close(self):
+        self._terminal = True
+        self._client.close()
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:

@@ -23,7 +23,7 @@ from ray_tpu.core.common import TaskSpec
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import ObjectStoreClient, _segment_name
-from ray_tpu.core.rpc import ConnectionLost, RpcClient
+from ray_tpu.core.rpc import ConnectionLost, ReconnectingClient, RpcClient
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -41,7 +41,7 @@ _PENDING = object()
 
 class _TaskRecord:
     __slots__ = ("event", "results", "error", "crashed", "spec", "attempts",
-                 "reconstructions")
+                 "reconstructions", "submitted_addr")
 
     def __init__(self, spec: Optional[TaskSpec] = None):
         self.event = threading.Event()
@@ -51,50 +51,7 @@ class _TaskRecord:
         self.spec = spec
         self.attempts = 0
         self.reconstructions = 0  # lineage re-executions after object loss
-
-
-class ReconnectingClient:
-    """RPC client that re-dials on connection loss (one retry per call).
-
-    The GCS link must survive transient drops — the reference's GCS fault
-    tolerance lets raylets and workers reconnect after a GCS restart
-    (`gcs_failover_worker_reconnect_timeout`); this is the client half.
-    """
-
-    def __init__(self, address: str, name: str, push_handler=None,
-                 resubscribe=None):
-        self.address = address
-        self._name = name
-        self._push_handler = push_handler
-        self._resubscribe = resubscribe
-        self._lock = threading.Lock()
-        self._client = RpcClient(address, name=name, push_handler=push_handler)
-
-    @property
-    def is_closed(self) -> bool:
-        return self._client.is_closed
-
-    def _reconnect(self) -> RpcClient:
-        with self._lock:
-            if self._client.is_closed:
-                self._client = RpcClient(self.address, name=self._name,
-                                         push_handler=self._push_handler)
-                if self._resubscribe is not None:
-                    try:
-                        self._resubscribe(self._client)
-                    except Exception:
-                        logger.warning("%s: resubscribe failed", self._name)
-            return self._client
-
-    def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
-        try:
-            return self._client.call(method, data, timeout=timeout)
-        except ConnectionLost:
-            client = self._reconnect()
-            return client.call(method, data, timeout=timeout)
-
-    def close(self):
-        self._client.close()
+        self.submitted_addr: Optional[str] = None  # raylet holding the task
 
 
 class ActorClient:
@@ -230,6 +187,13 @@ class CoreRuntime:
         raise RaySystemError("driver runtime received execute_task")
 
     def _resubscribe_gcs(self, client: RpcClient):
+        # Re-bind this driver's job to the fresh connection so driver-exit
+        # cleanup still fires after a GCS failover.
+        if self.is_driver and getattr(self, "job_id", None) is not None:
+            try:
+                client.call("reattach_job", {"job_id": self.job_id}, timeout=5)
+            except Exception:  # noqa: BLE001 — older GCS or racing restart
+                pass
         with self._lock:
             actor_keys = [k for k in self._actor_clients] + \
                 [k for k in self._actor_states]
@@ -329,17 +293,33 @@ class CoreRuntime:
 
     def _submit_spec(self, spec: TaskSpec):
         target = self.raylet
+        target_addr = self.raylet.address
+        spilled = False  # first spillback hop must accept, not bounce
         for _hop in range(8):
             try:
                 resp = target.call("submit_task",
                                    {"spec": spec,
-                                    "grant_or_reject": _hop > 0})
+                                    "grant_or_reject": spilled})
             except ConnectionLost:
-                raise RaySystemError("lost connection to raylet")
+                if target is self.raylet:
+                    raise RaySystemError("lost connection to raylet")
+                # A spillback target died mid-submit: route through the
+                # local raylet again, which may spill to another live node
+                # (so grant_or_reject resets — queueing an infeasible task
+                # locally would wedge it forever).
+                target = self.raylet
+                target_addr = self.raylet.address
+                spilled = False
+                continue
             if resp["status"] == "queued":
+                rec = self._tasks.get(spec.task_id.binary())
+                if rec is not None:
+                    rec.submitted_addr = target_addr
                 return
             if resp["status"] == "spillback":
-                target = self._raylet_for(resp["address"])
+                target_addr = resp["address"]
+                target = self._raylet_for(target_addr)
+                spilled = True
                 continue
             raise RaySystemError(f"unexpected submit status {resp}")
         raise RaySystemError("task spillback loop exceeded 8 hops")
@@ -348,10 +328,61 @@ class CoreRuntime:
         with self._lock:
             client = self._raylet_clients.get(address)
             if client is None or client.is_closed:
-                client = RpcClient(address, name="runtime->raylet-remote",
-                                   push_handler=self._on_raylet_push)
+                client = RpcClient(
+                    address, name="runtime->raylet-remote",
+                    push_handler=self._on_raylet_push,
+                    on_close=lambda: self._on_remote_raylet_lost(address))
                 self._raylet_clients[address] = client
             return client
+
+    def _on_remote_raylet_lost(self, address: str):
+        """A remote raylet holding our submitted tasks died: fail over every
+        pending task that was queued there by resubmitting through the
+        local raylet (which routes around the dead node). Reference: the
+        owner's lease tracking resubmits on node failure."""
+        if self._closed:
+            return
+        with self._lock:
+            pending = [rec for rec in self._tasks.values()
+                       if rec.submitted_addr == address
+                       and rec.spec is not None and not rec.event.is_set()]
+        if not pending:
+            return
+        # Resubmission off the dying client's reader thread: the cluster
+        # view is stale right after a node death, so submits may need
+        # several attempts while the GCS propagates the update.
+        threading.Thread(target=self._failover_tasks,
+                         args=(address, pending), daemon=True).start()
+
+    def _failover_tasks(self, address: str, pending: List[_TaskRecord]):
+        for rec in pending:
+            rec.attempts += 1
+            if rec.attempts > rec.spec.max_retries:
+                # The user's retry budget (0 = never re-execute a possibly
+                # non-idempotent task) governs failover too.
+                self._fail_task_record(rec, rec.spec, serialization.serialize_exception(
+                    RaySystemError(
+                        f"node at {address} died with task {rec.spec.name} "
+                        f"(max_retries={rec.spec.max_retries} exhausted)")))
+                continue
+            logger.warning("raylet %s died; resubmitting task %s "
+                           "(attempt %d)", address, rec.spec.name,
+                           rec.attempts)
+            rec.submitted_addr = None
+            last_err: Optional[Exception] = None
+            for _try in range(5):
+                if self._closed:
+                    return
+                try:
+                    self._submit_spec(rec.spec)
+                    last_err = None
+                    break
+                except Exception as e:  # noqa: BLE001 — stale view, retry
+                    last_err = e
+                    time.sleep(0.5)
+            if last_err is not None:
+                self._fail_task_record(rec, rec.spec, serialization.serialize_exception(
+                    RaySystemError(f"failover resubmit failed: {last_err}")))
 
     # -------------------------------------------------------------- actors
 
