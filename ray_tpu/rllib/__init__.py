@@ -6,8 +6,17 @@ SURVEY.md §7 "keep the new stack only"), with the torch/DDP learner replaced
 by a jitted JAX learner.
 """
 
+from ray_tpu.rllib.connectors import (
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    GrayscaleResize,
+    atari_connectors,
+)
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
+    CatchVectorEnv,
+    ConnectorVectorEnv,
     GymnasiumVectorEnv,
     VectorEnv,
     make_env,
@@ -20,12 +29,22 @@ from ray_tpu.rllib.impala import (
 )
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
-from ray_tpu.rllib.rl_module import DiscretePolicyModule, RLModule, SpecDict
+from ray_tpu.rllib.rl_module import (
+    ConvPolicyModule,
+    DiscretePolicyModule,
+    RLModule,
+    SpecDict,
+    build_module,
+)
 from ray_tpu.rllib.rollout import RolloutWorker, WorkerSet
 
 __all__ = [
-    "VectorEnv", "CartPoleVectorEnv", "GymnasiumVectorEnv", "make_env",
-    "RLModule", "DiscretePolicyModule", "SpecDict",
+    "VectorEnv", "CartPoleVectorEnv", "CatchVectorEnv",
+    "ConnectorVectorEnv", "GymnasiumVectorEnv", "make_env",
+    "Connector", "ConnectorPipeline", "FrameStack", "GrayscaleResize",
+    "atari_connectors",
+    "RLModule", "DiscretePolicyModule", "ConvPolicyModule", "SpecDict",
+    "build_module",
     "Learner", "LearnerGroup", "RolloutWorker", "WorkerSet",
     "PPO", "PPOConfig", "PPOLearner",
     "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace_returns",

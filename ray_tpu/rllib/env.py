@@ -29,6 +29,16 @@ class VectorEnv:
     n_actions: int
     max_episode_steps: int = 500
 
+    @property
+    def obs_shape(self) -> Tuple[int, ...]:
+        """Per-env observation shape; (obs_dim,) for flat envs, [H, W] or
+        [H, W, C] for image envs."""
+        return (self.obs_dim,)
+
+    @property
+    def obs_dtype(self):
+        return np.float32
+
     def reset(self) -> np.ndarray:
         raise NotImplementedError
 
@@ -130,21 +140,41 @@ class GymnasiumVectorEnv(VectorEnv):
         self.n_envs = n_envs
         space = self._env.single_observation_space
         self.obs_dim = int(np.prod(space.shape))
+        # Image spaces (rank >= 2, e.g. Atari [210, 160, 3] uint8) keep
+        # their native shape and dtype for the connector pipeline; flat
+        # spaces normalize to [n, obs_dim] float32.
+        self._image = len(space.shape) >= 2
+        self._shape = tuple(space.shape) if self._image else (self.obs_dim,)
+        self._dtype = np.uint8 if (self._image
+                                   and space.dtype == np.uint8) else np.float32
         self.n_actions = int(self._env.single_action_space.n)
         self._seed = seed
         spec = getattr(self._env, "spec", None)
         self.max_episode_steps = getattr(spec, "max_episode_steps", 500) or 500
 
+    @property
+    def obs_shape(self):
+        return self._shape
+
+    @property
+    def obs_dtype(self):
+        return self._dtype
+
+    def _cast(self, obs: np.ndarray) -> np.ndarray:
+        if self._image:
+            return np.asarray(obs, dtype=self._dtype)
+        return obs.reshape(self.n_envs, -1).astype(np.float32)
+
     def reset(self) -> np.ndarray:
         obs, _ = self._env.reset(seed=self._seed)
-        return obs.reshape(self.n_envs, -1).astype(np.float32)
+        return self._cast(obs)
 
     def step(self, actions: np.ndarray):
         obs, rewards, terminated, truncated, infos = self._env.step(actions)
         terminated = np.asarray(terminated)
         truncated = np.asarray(truncated) & ~terminated  # termination wins
         dones = terminated | truncated
-        obs = obs.reshape(self.n_envs, -1).astype(np.float32)
+        obs = self._cast(obs)
         out_infos = {"truncated": truncated}
         if dones.any():
             # Gymnasium SAME_STEP autoreset reports the pre-reset
@@ -158,19 +188,184 @@ class GymnasiumVectorEnv(VectorEnv):
                 for i in np.nonzero(dones)[0]:
                     fo = raw_final[i]
                     if fo is not None:
-                        final_obs[i] = np.asarray(fo, np.float32).reshape(-1)
+                        final_obs[i] = np.asarray(
+                            fo, final_obs.dtype).reshape(self._shape)
             out_infos["final_obs"] = final_obs
         return (obs, np.asarray(rewards, dtype=np.float32), dones, out_infos)
 
 
-def make_env(env: Any, n_envs: int, seed: int = 0) -> VectorEnv:
-    """env may be a VectorEnv factory, a VectorEnv, or a gymnasium id."""
+class CatchVectorEnv(VectorEnv):
+    """Synthetic image env (uint8 [H, W] frames): a pellet falls from a
+    random column; the agent moves a paddle along the bottom row
+    (left/stay/right) and gets +1 for catching it, -1 for missing.
+
+    Serves as the Atari-shaped workload for the image pipeline (CNN module
+    + connectors) in environments without ale_py — same dtype, obs rank,
+    and reward sparsity class as Pong-like games, but cheap enough for CI.
+    """
+
+    def __init__(self, n_envs: int = 8, seed: int = 0, size: int = 21,
+                 shaped: bool = False):
+        self.n_envs = n_envs
+        self.size = size
+        self.obs_dim = size * size
+        self.n_actions = 3
+        self.max_episode_steps = size  # one drop per episode
+        # shaped=True adds a small per-step reward for closing the
+        # paddle-ball gap — turns the sparse terminal signal into a dense
+        # one for quick CI-scale learning checks.
+        self.shaped = shaped
+        self._rng = np.random.default_rng(seed)
+        self._ball_col = np.zeros(n_envs, dtype=np.int64)
+        self._ball_row = np.zeros(n_envs, dtype=np.int64)
+        self._paddle = np.zeros(n_envs, dtype=np.int64)
+
+    @property
+    def obs_shape(self):
+        return (self.size, self.size)
+
+    @property
+    def obs_dtype(self):
+        return np.uint8
+
+    def _render(self) -> np.ndarray:
+        frames = np.zeros((self.n_envs, self.size, self.size), dtype=np.uint8)
+        idx = np.arange(self.n_envs)
+        frames[idx, self._ball_row, self._ball_col] = 255
+        frames[idx, self.size - 1, self._paddle] = 128
+        return frames
+
+    def _spawn(self, mask: np.ndarray):
+        n = int(mask.sum())
+        if n:
+            self._ball_col[mask] = self._rng.integers(0, self.size, n)
+            self._ball_row[mask] = 0
+            self._paddle[mask] = self._rng.integers(0, self.size, n)
+
+    def reset(self) -> np.ndarray:
+        self._spawn(np.ones(self.n_envs, dtype=bool))
+        return self._render()
+
+    def step(self, actions: np.ndarray):
+        gap_before = np.abs(self._paddle - self._ball_col)
+        self._paddle = np.clip(self._paddle + (actions - 1), 0, self.size - 1)
+        self._ball_row += 1
+        landed = self._ball_row >= self.size - 1
+        caught = landed & (self._paddle == self._ball_col)
+        rewards = np.where(caught, 1.0, np.where(landed, -1.0, 0.0)
+                           ).astype(np.float32)
+        if self.shaped:
+            gap_after = np.abs(self._paddle - self._ball_col)
+            rewards += 0.1 * np.sign(gap_before - gap_after).astype(np.float32)
+        dones = landed.copy()
+        infos: Dict[str, Any] = {"truncated": np.zeros(self.n_envs, bool)}
+        if dones.any():
+            infos["final_obs"] = self._render()
+        self._spawn(dones)
+        return self._render(), rewards, dones, infos
+
+
+class ConnectorVectorEnv(VectorEnv):
+    """Wraps a VectorEnv with an observation connector pipeline (reference
+    agent connectors run in this position inside the rollout worker).
+
+    Handles the stateful FrameStack correctly across auto-resets: done rows
+    restart their stacks from the new episode's first frame, and the
+    true-final-obs bootstrap gets the stack as it WOULD have continued.
+    """
+
+    def __init__(self, inner: VectorEnv, pipeline):
+        from ray_tpu.rllib.connectors import FrameStack
+
+        self.inner = inner
+        self.pipeline = pipeline
+        self._stateless = [c for c in pipeline.connectors
+                           if not isinstance(c, FrameStack)]
+        stacks = [c for c in pipeline.connectors if isinstance(c, FrameStack)]
+        assert len(stacks) <= 1, "at most one FrameStack per pipeline"
+        if stacks:
+            # Application order is stateless-then-stack; a FrameStack
+            # anywhere but last would make the declared output_shape
+            # contradict what step() actually emits.
+            assert isinstance(pipeline.connectors[-1], FrameStack), \
+                "FrameStack must be the LAST connector in the pipeline"
+        self._stack = stacks[0] if stacks else None
+        self.n_envs = inner.n_envs
+        self.n_actions = inner.n_actions
+        self.max_episode_steps = inner.max_episode_steps
+        self._shape = tuple(pipeline.output_shape(inner.obs_shape))
+        self._dtype = pipeline.output_dtype(inner.obs_dtype)
+        self.obs_dim = int(np.prod(self._shape))
+
+    @property
+    def obs_shape(self):
+        return self._shape
+
+    @property
+    def obs_dtype(self):
+        return self._dtype
+
+    def _pre(self, obs: np.ndarray) -> np.ndarray:
+        for c in self._stateless:
+            obs = c(obs)
+        return obs
+
+    def reset(self) -> np.ndarray:
+        x = self._pre(self.inner.reset())
+        if self._stack is not None:
+            self._stack._stack = None  # fresh episodes everywhere
+            x = self._stack(x)
+        return x
+
+    def step(self, actions: np.ndarray):
+        raw, rewards, dones, infos = self.inner.step(actions)
+        x = self._pre(raw)
+        out_infos: Dict[str, Any] = {
+            "truncated": infos.get("truncated",
+                                   np.zeros(self.n_envs, bool))}
+        done_rows = np.nonzero(dones)[0]
+        raw_final = infos.get("final_obs")
+        if self._stack is None:
+            if done_rows.size and raw_final is not None:
+                out_infos["final_obs"] = self._pre(raw_final)
+            return x, rewards, dones, out_infos
+        # Stack the final obs BEFORE committing this step's frame: the
+        # bootstrap sees frames [..t-k+2, final], not the reset frame.
+        if done_rows.size and raw_final is not None:
+            out_infos["final_obs"] = self._stack.peek(self._pre(raw_final))
+        obs = self._stack(x)
+        if done_rows.size:
+            # Done rows' x is already the NEW episode's first frame
+            # (auto-reset); their stacks restart from it.
+            self._stack.reset_rows(done_rows, x)
+            obs[done_rows] = self._stack._stack[done_rows]
+        return obs, rewards, dones, out_infos
+
+
+def make_env(env: Any, n_envs: int, seed: int = 0,
+             connectors: Any = None) -> VectorEnv:
+    """env may be a VectorEnv factory, a VectorEnv, or a gymnasium id
+    (Atari "ALE/..." ids get the standard preprocessing pipeline)."""
     if isinstance(env, VectorEnv):
-        return env
-    if callable(env):
+        out = env
+    elif callable(env):
         out = env(n_envs=n_envs, seed=seed)
         assert isinstance(out, VectorEnv)
-        return out
-    if env in ("CartPole-v1", "CartPole"):
-        return CartPoleVectorEnv(n_envs=n_envs, seed=seed)
-    return GymnasiumVectorEnv(env, n_envs=n_envs, seed=seed)
+    elif env in ("CartPole-v1", "CartPole"):
+        out = CartPoleVectorEnv(n_envs=n_envs, seed=seed)
+    elif env in ("Catch-v0", "Catch"):
+        out = CatchVectorEnv(n_envs=n_envs, seed=seed)
+    else:
+        out = GymnasiumVectorEnv(env, n_envs=n_envs, seed=seed)
+        if connectors is None and isinstance(env, str) and \
+                (env.startswith("ALE/") or "NoFrameskip" in env):
+            from ray_tpu.rllib.connectors import atari_connectors
+
+            connectors = atari_connectors()
+    if connectors is not None:
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+
+        if not isinstance(connectors, ConnectorPipeline):
+            connectors = ConnectorPipeline(list(connectors))
+        out = ConnectorVectorEnv(out, connectors)
+    return out

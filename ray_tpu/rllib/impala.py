@@ -29,7 +29,7 @@ import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.learner import Learner, LearnerGroup
-from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+from ray_tpu.rllib.rl_module import build_module_from_env_spec
 from ray_tpu.rllib.rollout import WorkerSet
 
 logger = logging.getLogger(__name__)
@@ -117,6 +117,7 @@ class IMPALAConfig:
     learner_resources: Optional[Dict[str, float]] = None
     num_cpus_per_worker: float = 0.4
     rollout_platform: Optional[str] = "cpu"
+    connectors: Any = None  # observation connector pipeline
 
     def environment(self, env) -> "IMPALAConfig":
         self.env = env
@@ -158,7 +159,7 @@ class IMPALALearner(Learner):
         # behavior worker's stale value head in poisons the targets).
         obs_ext = jnp.concatenate([batch[sb.OBS], batch["last_obs"]], axis=0)
         flat = {
-            "obs": obs_ext.reshape((T + 1) * B, -1),
+            "obs": obs_ext.reshape(((T + 1) * B,) + obs_ext.shape[2:]),
             "actions": jnp.concatenate(
                 [batch[sb.ACTIONS],
                  jnp.zeros((1, B), batch[sb.ACTIONS].dtype)],
@@ -215,11 +216,10 @@ class IMPALA:
             n_envs=config.num_envs_per_worker, hidden=config.hidden,
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker,
-            jax_platform=config.rollout_platform)
-        spec = self.workers.env_spec()
-        module = DiscretePolicyModule(
-            SpecDict(spec["obs_dim"], spec["n_actions"]),
-            hidden=config.hidden)
+            jax_platform=config.rollout_platform,
+            connectors=config.connectors)
+        module = build_module_from_env_spec(self.workers.env_spec(),
+                                            hidden=config.hidden)
         self.learner_group = LearnerGroup(
             lambda: IMPALALearner(module, config, seed=config.seed),
             mode=config.learner_mode,
@@ -315,12 +315,12 @@ class IMPALA:
     def _to_time_major(self, frag: Dict[str, np.ndarray]
                        ) -> Dict[str, np.ndarray]:
         T, n = frag.pop("_shape")
-        obs_dim = frag[sb.OBS].shape[-1]
+        obs_shape = frag[sb.OBS].shape[1:]  # (obs_dim,) or image dims
         dones = frag[sb.DONES].reshape(T, n).astype(np.float32)
         truncs = frag[sb.TRUNCATEDS].reshape(T, n).astype(np.float32)
         return {
-            sb.OBS: frag[sb.OBS].reshape(T, n, obs_dim),
-            "last_obs": frag["_last_obs"].reshape(1, n, obs_dim),
+            sb.OBS: frag[sb.OBS].reshape((T, n) + obs_shape),
+            "last_obs": frag["_last_obs"].reshape((1, n) + obs_shape),
             sb.ACTIONS: frag[sb.ACTIONS].reshape(T, n),
             sb.REWARDS: frag[sb.REWARDS].reshape(T, n),
             sb.LOGP: frag[sb.LOGP].reshape(T, n),

@@ -18,7 +18,7 @@ import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.learner import Learner, LearnerGroup
-from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+from ray_tpu.rllib.rl_module import build_module_from_env_spec
 from ray_tpu.rllib.rollout import WorkerSet
 
 logger = logging.getLogger(__name__)
@@ -50,6 +50,9 @@ class PPOConfig:
     # Pin sampler processes to a jax platform ("cpu" keeps the chip free
     # for the learner); None inherits the ambient platform.
     rollout_platform: Optional[str] = "cpu"
+    # Observation connector pipeline (reference agent connectors); Atari
+    # ids get GrayscaleResize+FrameStack automatically via make_env.
+    connectors: Any = None
 
     # Fluent API parity with the reference's AlgorithmConfig builder.
     def environment(self, env) -> "PPOConfig":
@@ -125,11 +128,10 @@ class PPO:
             n_envs=config.num_envs_per_worker, hidden=config.hidden,
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker,
-            jax_platform=config.rollout_platform)
-        spec = self.workers.env_spec()
-        module = DiscretePolicyModule(
-            SpecDict(spec["obs_dim"], spec["n_actions"]),
-            hidden=config.hidden)
+            jax_platform=config.rollout_platform,
+            connectors=config.connectors)
+        module = build_module_from_env_spec(self.workers.env_spec(),
+                                            hidden=config.hidden)
         self.learner_group = LearnerGroup(
             lambda: PPOLearner(module, config, seed=config.seed),
             mode=config.learner_mode,

@@ -22,6 +22,12 @@ import numpy as np
 class SpecDict:
     obs_dim: int
     n_actions: int
+    # Image modules need the full shape ([H, W] or [H, W, C]); flat modules
+    # derive it from obs_dim.
+    obs_shape: Tuple[int, ...] = ()
+
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.obs_shape) if self.obs_shape else (self.obs_dim,)
 
 
 class _PolicyValueNet(nn.Module):
@@ -38,6 +44,44 @@ class _PolicyValueNet(nn.Module):
             x = nn.Dense(width, name=f"torso_{i}",
                          kernel_init=nn.initializers.orthogonal(np.sqrt(2)))(x)
             x = nn.tanh(x)
+        logits = nn.Dense(self.n_actions, name="pi",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        value = nn.Dense(1, name="vf",
+                         kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return logits, value[..., 0]
+
+
+class _ConvPolicyValueNet(nn.Module):
+    """Nature-CNN torso -> (logits, value) heads for image observations.
+
+    TPU-first: observations arrive uint8 (4x less sample-batch bandwidth
+    than float32) and are normalized to [0, 1] on-device; convolutions are
+    NHWC, the layout XLA tiles best on the MXU.
+    """
+
+    n_actions: int
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    dense: int = 512
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(jnp.float32)
+        if jnp.issubdtype(obs.dtype, jnp.integer):
+            x = x / 255.0  # uint8 pixels; float envs are already scaled
+        if x.ndim == 3:  # [B, H, W] -> single channel
+            x = x[..., None]
+        for i, (c, k, s) in enumerate(zip(self.channels, self.kernels,
+                                          self.strides)):
+            x = nn.Conv(c, (k, k), strides=(s, s), padding="VALID",
+                        name=f"conv_{i}",
+                        kernel_init=nn.initializers.orthogonal(np.sqrt(2)))(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.dense, name="torso",
+                     kernel_init=nn.initializers.orthogonal(np.sqrt(2)))(x)
+        x = nn.relu(x)
         logits = nn.Dense(self.n_actions, name="pi",
                           kernel_init=nn.initializers.orthogonal(0.01))(x)
         value = nn.Dense(1, name="vf",
@@ -113,3 +157,57 @@ class DiscretePolicyModule(RLModule):
 
     def __reduce__(self):
         return (DiscretePolicyModule, (self.spec, tuple(self.model.hidden)))
+
+
+class ConvPolicyModule(DiscretePolicyModule):
+    """CNN policy+value module for image observations (the Atari module —
+    reference Catalog's vision encoder path).
+
+    Architecture auto-sizes to the input: nature-DQN filters for >= 40 px
+    frames, a shallower stack for small synthetic envs.
+    """
+
+    def __init__(self, spec: SpecDict, dense: int = 512):
+        self.spec = spec
+        self.dense = dense
+        if len(spec.shape()) not in (2, 3):
+            raise ValueError(
+                f"ConvPolicyModule needs [H, W] or [H, W, C] observations, "
+                f"got shape {spec.shape()} — a color env plus FrameStack "
+                f"yields rank 4; add GrayscaleResize before the stack")
+        h = spec.shape()[0]
+        if h >= 40:
+            conv = dict(channels=(32, 64, 64), kernels=(8, 4, 3),
+                        strides=(4, 2, 1))
+        else:
+            conv = dict(channels=(16, 32), kernels=(4, 3), strides=(2, 1))
+        self.model = _ConvPolicyValueNet(n_actions=spec.n_actions,
+                                         dense=dense, **conv)
+        self._sample = jax.jit(self._sample_impl)
+        self._greedy = jax.jit(self._greedy_impl)
+
+    def init_params(self, rng) -> Any:
+        obs = jnp.zeros((1,) + self.spec.shape(), jnp.uint8)
+        return self.model.init(rng, obs)
+
+    def __reduce__(self):
+        return (ConvPolicyModule, (self.spec, self.dense))
+
+
+def build_module(spec: SpecDict, hidden: Sequence[int] = (64, 64)) -> RLModule:
+    """Default module for an env spec: CNN for image observations (rank >=
+    2), MLP otherwise (reference Catalog dispatch)."""
+    if len(spec.shape()) >= 2:
+        return ConvPolicyModule(spec)
+    return DiscretePolicyModule(spec, hidden=hidden)
+
+
+def build_module_from_env_spec(env_spec: Dict[str, Any],
+                               hidden: Sequence[int] = (64, 64)) -> RLModule:
+    """From a RolloutWorker.env_spec() dict — the single place algorithms
+    construct their learner module, so it can never drift from the module
+    the rollout workers build."""
+    return build_module(
+        SpecDict(env_spec["obs_dim"], env_spec["n_actions"],
+                 tuple(env_spec.get("obs_shape", ()))),
+        hidden=hidden)

@@ -17,7 +17,7 @@ import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.env import make_env
-from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+from ray_tpu.rllib.rl_module import build_module_from_env_spec
 
 logger = logging.getLogger(__name__)
 
@@ -28,7 +28,7 @@ class RolloutWorker:
 
     def __init__(self, env: Any, n_envs: int = 8, seed: int = 0,
                  hidden=(64, 64), module: Optional[Any] = None,
-                 jax_platform: Optional[str] = None):
+                 jax_platform: Optional[str] = None, connectors: Any = None):
         import os
 
         from ray_tpu._jax_env import apply_jax_platform_env
@@ -41,9 +41,10 @@ class RolloutWorker:
         apply_jax_platform_env()
         import jax
 
-        self.env = make_env(env, n_envs=n_envs, seed=seed)
-        self.module = module or DiscretePolicyModule(
-            SpecDict(self.env.obs_dim, self.env.n_actions), hidden=hidden)
+        self.env = make_env(env, n_envs=n_envs, seed=seed,
+                            connectors=connectors)
+        self.module = module or build_module_from_env_spec(
+            self.env_spec(), hidden=hidden)
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self._rng = jax.random.PRNGKey(seed + 1000)
         self._obs = self.env.reset()
@@ -56,16 +57,18 @@ class RolloutWorker:
     def set_weights(self, weights: Any):
         self.params = weights
 
-    def env_spec(self) -> Dict[str, int]:
+    def env_spec(self) -> Dict[str, Any]:
         return {"obs_dim": self.env.obs_dim, "n_actions": self.env.n_actions,
-                "n_envs": self.env.n_envs}
+                "n_envs": self.env.n_envs,
+                "obs_shape": tuple(self.env.obs_shape)}
 
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect `num_steps` env steps (x n_envs transitions), flattened."""
         import jax
 
         n = self.env.n_envs
-        obs_buf = np.empty((num_steps, n, self.env.obs_dim), dtype=np.float32)
+        obs_buf = np.empty((num_steps, n) + tuple(self.env.obs_shape),
+                           dtype=self.env.obs_dtype)
         act_buf = np.empty((num_steps, n), dtype=np.int64)
         rew_buf = np.empty((num_steps, n), dtype=np.float32)
         done_buf = np.empty((num_steps, n), dtype=bool)
@@ -122,7 +125,7 @@ class RolloutWorker:
             padded_k = 1
             while padded_k < k:
                 padded_k *= 2
-            padded = np.zeros((padded_k, all_fo.shape[-1]), np.float32)
+            padded = np.zeros((padded_k,) + all_fo.shape[1:], all_fo.dtype)
             padded[:k] = all_fo
             vals = np.asarray(self.module.forward_inference(
                 self.params, padded)["vf"])[:k]
@@ -132,10 +135,11 @@ class RolloutWorker:
                 pos += rows.size
 
         batch = {
-            sb.OBS: obs_buf.reshape(num_steps * n, -1),
+            sb.OBS: obs_buf.reshape(
+                (num_steps * n,) + tuple(self.env.obs_shape)),
             # Tail observation: lets an off-policy learner (IMPALA) compute
             # its own bootstrap V(x_{T}) with current params.
-            "_last_obs": np.asarray(obs, dtype=np.float32),
+            "_last_obs": np.asarray(obs, dtype=self.env.obs_dtype),
             sb.ACTIONS: act_buf.reshape(-1),
             sb.REWARDS: rew_buf.reshape(-1),
             sb.DONES: done_buf.reshape(-1),
@@ -167,17 +171,19 @@ class WorkerSet:
     def __init__(self, env: Any, num_workers: int = 2, n_envs: int = 8,
                  hidden=(64, 64), seed: int = 0,
                  num_cpus_per_worker: float = 0.5,
-                 jax_platform: Optional[str] = None):
+                 jax_platform: Optional[str] = None,
+                 connectors: Any = None):
         import ray_tpu
 
         self._ctor = dict(env=env, n_envs=n_envs, hidden=tuple(hidden),
                           jax_platform=jax_platform, seed=seed,
-                          num_cpus=num_cpus_per_worker)
+                          num_cpus=num_cpus_per_worker,
+                          connectors=connectors)
         actor_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
             actor_cls.options(num_cpus=num_cpus_per_worker).remote(
                 env, n_envs=n_envs, seed=seed + i, hidden=tuple(hidden),
-                jax_platform=jax_platform)
+                jax_platform=jax_platform, connectors=connectors)
             for i in range(num_workers)]
         self.num_workers = num_workers
 
@@ -195,7 +201,8 @@ class WorkerSet:
         self.workers[idx] = actor_cls.options(
             num_cpus=c["num_cpus"]).remote(
             c["env"], n_envs=c["n_envs"], seed=c["seed"] + idx,
-            hidden=c["hidden"], jax_platform=c["jax_platform"])
+            hidden=c["hidden"], jax_platform=c["jax_platform"],
+            connectors=c["connectors"])
         return self.workers[idx]
 
     def sync_weights(self, weights: Any):

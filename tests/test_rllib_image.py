@@ -1,0 +1,143 @@
+"""Image-observation path: connectors, CNN module, Atari-shaped training.
+
+Mirrors the reference's connector tests (`rllib/connectors/tests/`) and the
+Atari PPO tuned-example shape (`tuned_examples/ppo/atari-ppo.yaml`) on the
+synthetic Catch env (ale_py is not installed in CI).
+"""
+
+import numpy as np
+import pytest
+
+
+def test_grayscale_resize_area_and_nearest():
+    from ray_tpu.rllib.connectors import GrayscaleResize
+
+    # Integer-factor path: area mean.
+    obs = np.zeros((2, 8, 8, 3), np.uint8)
+    obs[:, :4] = 255
+    out = GrayscaleResize(4, 4)(obs)
+    assert out.shape == (2, 4, 4) and out.dtype == np.uint8
+    assert out[0, 0, 0] == 255 and out[0, 3, 0] == 0
+
+    # Non-integer path: nearest-index sampling (Atari 210x160 -> 84x84).
+    big = np.random.default_rng(0).integers(
+        0, 255, (1, 210, 160, 3), dtype=np.uint8)
+    out = GrayscaleResize(84, 84)(big)
+    assert out.shape == (1, 84, 84)
+
+
+def test_frame_stack_and_reset_rows():
+    from ray_tpu.rllib.connectors import FrameStack
+
+    fs = FrameStack(k=3)
+    f1 = np.full((2, 4, 4), 1, np.uint8)
+    f2 = np.full((2, 4, 4), 2, np.uint8)
+    f3 = np.full((2, 4, 4), 3, np.uint8)
+    assert (fs(f1)[0, 0, 0] == [1, 1, 1]).all()  # first frame repeated
+    assert (fs(f2)[0, 0, 0] == [1, 1, 2]).all()
+    # peek does not commit
+    peeked = fs.peek(f3)
+    assert (peeked[0, 0, 0] == [1, 2, 3]).all()
+    assert (fs._stack[0, 0, 0] == [1, 1, 2]).all()
+    # env 0 resets; env 1 continues
+    fs.reset_rows(np.array([0]), f3)
+    assert (fs._stack[0, 0, 0] == [3, 3, 3]).all()
+    assert (fs._stack[1, 0, 0] == [1, 1, 2]).all()
+
+
+def test_connector_env_stacks_and_resets():
+    from ray_tpu.rllib.connectors import ConnectorPipeline, FrameStack
+    from ray_tpu.rllib.env import CatchVectorEnv, ConnectorVectorEnv
+
+    env = ConnectorVectorEnv(CatchVectorEnv(n_envs=4, seed=0, size=9),
+                             ConnectorPipeline([FrameStack(4)]))
+    assert env.obs_shape == (9, 9, 4)
+    obs = env.reset()
+    assert obs.shape == (4, 9, 9, 4) and obs.dtype == np.uint8
+    # First obs: all stack slots identical.
+    assert (obs[..., 0] == obs[..., 3]).all()
+    steps = 0
+    saw_done = False
+    while steps < 30 and not saw_done:
+        obs, rew, dones, infos = env.step(np.ones(4, np.int64))
+        steps += 1
+        if dones.any():
+            saw_done = True
+            i = int(np.nonzero(dones)[0][0])
+            # final_obs carries pre-reset frames (continuing stack)...
+            assert "final_obs" in infos
+            # ...while the returned obs restarted its stack: all slots equal.
+            assert (obs[i, ..., 0] == obs[i, ..., 3]).all()
+    assert saw_done
+
+
+def test_conv_module_shapes():
+    import jax
+
+    from ray_tpu.rllib.rl_module import ConvPolicyModule, SpecDict
+
+    mod = ConvPolicyModule(SpecDict(0, 3, (21, 21, 4)))
+    params = mod.init_params(jax.random.PRNGKey(0))
+    obs = np.random.default_rng(0).integers(
+        0, 255, (5, 21, 21, 4), dtype=np.uint8)
+    out = mod.forward_exploration(params, obs, jax.random.PRNGKey(1))
+    assert out["actions"].shape == (5,) and out["vf"].shape == (5,)
+    train = mod.forward_train(params, {"obs": obs,
+                                       "actions": np.asarray(out["actions"])})
+    assert train["logits"].shape == (5, 3)
+
+
+def test_image_rollout_worker_batch_layout():
+    from ray_tpu.rllib.connectors import ConnectorPipeline, FrameStack
+    from ray_tpu.rllib.rollout import RolloutWorker
+
+    w = RolloutWorker("Catch-v0", n_envs=4, seed=0,
+                      connectors=ConnectorPipeline([FrameStack(2)]))
+    batch = w.sample(12)
+    T, n = batch["_shape"]
+    assert (T, n) == (12, 4)
+    assert batch["obs"].shape == (48, 21, 21, 2)
+    assert batch["obs"].dtype == np.uint8
+    assert batch["_last_obs"].shape == (4, 21, 21, 2)
+
+
+def test_ppo_atari_shaped_end_to_end(ray_start_shared):
+    """The Atari-PPO path (CNN module + frame stacking + actor workers)
+    executes end-to-end and improves on Catch."""
+    from ray_tpu.rllib import PPO, PPOConfig
+    from ray_tpu.rllib.connectors import ConnectorPipeline, FrameStack
+
+    from ray_tpu.rllib.env import CatchVectorEnv
+
+    algo = PPO(PPOConfig(
+        # Shaped small Catch: the unshaped terminal-only reward needs far
+        # more samples than CI affords; the path under test (CNN + frame
+        # stack + uint8 batches through actor workers) is identical.
+        env=lambda n_envs, seed: CatchVectorEnv(n_envs, seed, size=9,
+                                                shaped=True),
+        connectors=ConnectorPipeline([FrameStack(2)]),
+        num_rollout_workers=2,
+        num_envs_per_worker=8,
+        rollout_fragment_length=40,
+        num_sgd_iter=4,
+        sgd_minibatch_size=256,
+        lr=1e-3,
+        entropy_coeff=0.01,
+        seed=0,
+    ))
+    try:
+        first, best = None, -2.0
+        for _ in range(25):
+            m = algo.train()
+            r = m.get("episode_reward_mean")
+            if r is not None:
+                if first is None:
+                    first = r
+                best = max(best, r)
+            if first is not None and best > first + 0.3:
+                break
+        assert first is not None
+        assert best > first + 0.3, \
+            f"no improvement: first={first}, best={best}"
+    finally:
+        algo.stop()
