@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -74,6 +75,10 @@ def init(
                 return _context_info()
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
+        if address is None:
+            # Submitted-job entrypoints (and any child process of a cluster)
+            # inherit the cluster address from the environment.
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         GLOBAL_CONFIG.initialize(_system_config)
         from ray_tpu.core.node import Node
 
@@ -131,6 +136,8 @@ def _context_info() -> Dict[str, Any]:
         "node_id": _global_runtime.node_id.hex() if _global_runtime.node_id else None,
         "job_id": _global_runtime.job_id.hex(),
         "session_dir": getattr(_global_node, "session_dir", None),
+        "dashboard_url": getattr(
+            getattr(_global_node, "dashboard", None), "url", None),
     }
 
 

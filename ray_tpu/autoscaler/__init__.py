@@ -1,0 +1,19 @@
+"""Autoscaler: reconcile cluster size with resource demand.
+
+Equivalent of the reference's StandardAutoscaler
+(`autoscaler/_private/autoscaler.py:172`) + ResourceDemandScheduler: a
+control loop reads the aggregated demand signal from the GCS (queued task
+shapes + explicit request_resources bundles), bin-packs it against current
+capacity, and asks a NodeProvider to launch or terminate worker nodes.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    LocalNodeProvider,
+    NodeProvider,
+    StandardAutoscaler,
+    request_resources,
+)
+
+__all__ = ["AutoscalerConfig", "NodeProvider", "LocalNodeProvider",
+           "StandardAutoscaler", "request_resources"]

@@ -67,6 +67,8 @@ _flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creat
 _flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")
 _flag("object_transfer_chunk_bytes", int, 16 * 1024 * 1024, "Node-to-node object transfer chunk size")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
+_flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
+_flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")
 
 # --- TPU / JAX specifics ----------------------------------------------------
 _flag("tpu_chips_per_host", int, 4, "Default chips per TPU host when not detected")

@@ -17,6 +17,7 @@ Runs as a thread inside the head process (default) or standalone via
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import defaultdict, deque
@@ -112,6 +113,12 @@ class GcsServer:
         self.objects: Dict[ObjectID, Dict[str, Any]] = {}
         # Task events ring buffer for the state API / timeline
         self.task_events: deque = deque(maxlen=GLOBAL_CONFIG.task_events_max_buffer)
+        # Metric snapshots per reporting process (TTL-expired)
+        self.metrics: Dict[str, Dict[str, Any]] = {}
+        # Per-node queued-but-unsatisfiable resource shapes (autoscaler feed)
+        self.node_demand: Dict[NodeID, List[Dict[str, float]]] = {}
+        # Explicit autoscaler.request_resources() bundles
+        self.resource_requests: List[Dict[str, float]] = []
 
         # Raylet clients for GCS-initiated RPCs (actor creation, 2PC, deletes)
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
@@ -150,6 +157,11 @@ class GcsServer:
 
     def stop(self):
         self._stopped.set()
+        if getattr(self, "_job_manager", None) is not None:
+            try:
+                self._job_manager.shutdown()
+            except Exception:
+                pass
         if self._storage_path:
             try:
                 self._persist_tables()
@@ -253,6 +265,7 @@ class GcsServer:
             info.last_heartbeat = time.time()
             info.resources_available = data["resources_available"]
             info.resources_total = data.get("resources_total", info.resources_total)
+            self.node_demand[node_id] = data.get("pending_demand", [])
         if data.get("broadcast", True):
             self._broadcast_resource_view()
         return {"registered": True}
@@ -303,6 +316,7 @@ class GcsServer:
             if info is None or info.state == "DEAD":
                 return
             info.state = "DEAD"
+            self.node_demand.pop(node_id, None)
             client = self._raylet_clients.pop(node_id, None)
         if client:
             client.close()
@@ -869,6 +883,83 @@ class GcsServer:
 
     # --------------------------------------------------------- task events
 
+    # ------------------------------------------------------ job submission
+
+    @property
+    def job_manager(self):
+        """Lazy JobManager (spawns driver subprocesses for submitted jobs,
+        reference job_manager.py:507)."""
+        with self._lock:
+            if getattr(self, "_job_manager", None) is None:
+                import tempfile
+
+                from ray_tpu.job_submission.manager import JobManager
+
+                self._job_manager = JobManager(
+                    self.address,
+                    log_dir=os.path.join(tempfile.gettempdir(),
+                                         "ray_tpu_jobs"))
+            return self._job_manager
+
+    def handle_submit_job(self, conn: Connection, data: Dict[str, Any]):
+        try:
+            sid = self.job_manager.submit(
+                data["entrypoint"], submission_id=data.get("submission_id"),
+                runtime_env=data.get("runtime_env"),
+                metadata=data.get("metadata"))
+            return {"submission_id": sid}
+        except ValueError as e:
+            return {"error": str(e)}
+
+    def handle_job_info(self, conn: Connection, data: Dict[str, Any]):
+        details = self.job_manager.details(data["submission_id"])
+        if details is None:
+            return {"found": False}
+        return {"found": True, "details": details}
+
+    def handle_job_logs(self, conn: Connection, data: Dict[str, Any]):
+        logs = self.job_manager.logs(data["submission_id"])
+        if logs is None:
+            return {"found": False}
+        return {"found": True, "logs": logs}
+
+    def handle_stop_job(self, conn: Connection, data: Dict[str, Any]):
+        return {"stopped": self.job_manager.stop(data["submission_id"])}
+
+    def handle_delete_job(self, conn: Connection, data: Dict[str, Any]):
+        return {"deleted": self.job_manager.delete(data["submission_id"])}
+
+    def handle_list_jobs(self, conn: Connection, data=None):
+        return self.job_manager.list()
+
+    # ------------------------------------------------------- metrics export
+
+    _METRICS_TTL_S = 30.0
+
+    def handle_metrics_report(self, conn: Connection, data: Dict[str, Any]):
+        """A process pushed its metric registry snapshot (reference
+        metrics_agent.py:375 harvest path)."""
+        with self._lock:
+            self.metrics[data["reporter"]] = {
+                "metrics": data["metrics"], "ts": data.get("ts", time.time())}
+        return {}
+
+    def _live_metrics(self) -> Dict[str, List]:
+        cutoff = time.time() - self._METRICS_TTL_S
+        with self._lock:
+            stale = [r for r, e in self.metrics.items() if e["ts"] < cutoff]
+            for r in stale:
+                del self.metrics[r]
+            return {r: e["metrics"] for r, e in self.metrics.items()}
+
+    def handle_metrics_snapshot(self, conn: Connection, data=None):
+        return self._live_metrics()
+
+    def handle_metrics_prometheus(self, conn: Connection, data=None):
+        from ray_tpu.util.metrics import render_prometheus
+
+        return {"text": render_prometheus(self._live_metrics())}
+
     def handle_add_task_events(self, conn: Connection, data: Dict[str, Any]):
         with self._lock:
             self.task_events.extend(data["events"])
@@ -881,6 +972,25 @@ class GcsServer:
         return {"events": events}
 
     # --------------------------------------------------------------- misc
+
+    def handle_resource_demand(self, conn: Connection, data=None):
+        """Aggregated scale-up signal for the autoscaler: queued shapes from
+        every live node plus explicit request_resources bundles."""
+        with self._lock:
+            shapes: List[Dict[str, float]] = []
+            for node_id, demand in self.node_demand.items():
+                info = self.nodes.get(node_id)
+                if info is not None and info.state == "ALIVE":
+                    shapes.extend(demand)
+            return {"demand": shapes,
+                    "requests": list(self.resource_requests)}
+
+    def handle_request_resources(self, conn: Connection, data: Dict[str, Any]):
+        """reference `autoscaler.sdk.request_resources`: pin a floor of
+        cluster capacity independent of current queue state."""
+        with self._lock:
+            self.resource_requests = list(data.get("bundles") or [])
+        return {}
 
     def handle_cluster_resources(self, conn: Connection, data=None):
         totals: Dict[str, float] = defaultdict(float)

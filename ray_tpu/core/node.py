@@ -55,10 +55,25 @@ class Node:
         self.head = head
         self.session_dir = session_dir or default_session_dir()
         self.gcs: Optional[GcsServer] = None
+        self.dashboard = None
         if head:
             self.gcs = GcsServer()
             self.gcs.start()
             self.gcs_address = self.gcs.address
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            if GLOBAL_CONFIG.include_dashboard:
+                try:
+                    from ray_tpu.dashboard import DashboardServer
+
+                    self.dashboard = DashboardServer(
+                        self.gcs_address,
+                        port=GLOBAL_CONFIG.dashboard_port).start()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "dashboard failed to start", exc_info=True)
         else:
             assert gcs_address, "non-head node requires gcs_address"
             self.gcs_address = gcs_address
@@ -94,5 +109,10 @@ class Node:
 
     def shutdown(self):
         self.raylet.stop()
+        if self.dashboard is not None:
+            try:
+                self.dashboard.stop()
+            except Exception:
+                pass
         if self.gcs is not None:
             self.gcs.stop()

@@ -398,6 +398,19 @@ class Raylet:
         client.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
         client.call("subscribe", {"channel": "OBJECT", "key": b"*"})
 
+    def _pending_demand(self, cap: int = 64) -> List[Dict[str, float]]:
+        """Resource shapes of queued tasks that can't run right now — the
+        autoscaler's scale-up signal (reference ResourceDemandScheduler
+        input)."""
+        with self._lock:
+            shapes = []
+            for qt in self._queue:
+                if len(shapes) >= cap:
+                    break
+                if not qt.deps_remaining and qt.spec.resources:
+                    shapes.append(dict(qt.spec.resources))
+            return shapes
+
     def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
         while not self._stopped.wait(period):
@@ -406,7 +419,8 @@ class Raylet:
                 resp = self.gcs.call(
                     "heartbeat",
                     {"node_id": self.node_id, "resources_available": avail,
-                     "resources_total": total},
+                     "resources_total": total,
+                     "pending_demand": self._pending_demand()},
                     timeout=5,
                 )
                 if not resp.get("registered"):
@@ -435,6 +449,10 @@ class Raylet:
         channel = data["channel"]
         if channel == "RESOURCES":
             self._cluster_view = data["message"]
+            # New capacity may have appeared (autoscaler launch): queued
+            # tasks this node can never run get handed back to their
+            # submitters for re-routing (reference task spilling).
+            self._respill_infeasible()
         elif channel == "OBJECT":
             oid = ObjectID(data["key"])
             with self._lock:
@@ -519,6 +537,33 @@ class Raylet:
         for dep in list(qt.deps_remaining):
             self._start_pull(dep)
         self._dispatch_event.set()
+
+    def _respill_infeasible(self):
+        """Queued tasks whose resources exceed this node's totals can only
+        run elsewhere; once the cluster view shows a node that fits, return
+        them to their submitter for re-routing (it re-runs the normal
+        submit path, which spills to the capable node)."""
+        with self._lock:
+            candidates = []
+            for qt in list(self._queue):
+                if qt.deps_remaining or \
+                        self.resources.feasible(qt.spec.resources):
+                    continue
+                target = self._choose_node(qt.spec)
+                if target is not None and target != self.node_id.hex():
+                    candidates.append(qt)
+            for qt in candidates:
+                self._queue.remove(qt)
+                self._task_submitters.pop(qt.spec.task_id.binary(), None)
+        for qt in candidates:
+            if qt.submitter is not None and qt.submitter.alive:
+                try:
+                    qt.submitter.push("task_respill", {"spec": qt.spec})
+                    continue
+                except Exception:  # noqa: BLE001
+                    pass
+            logger.warning("dropping respilled task %s (submitter gone)",
+                           qt.spec.name)
 
     def _dep_available(self, oid: ObjectID) -> bool:
         if self.store.contains(oid):

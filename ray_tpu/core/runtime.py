@@ -127,6 +127,22 @@ class CoreRuntime:
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
+        # Metrics flush: user Counters/Gauges/Histograms in this process
+        # surface at the GCS (rendered by /metrics on the dashboard).
+        from ray_tpu.util.metrics import MetricsPusher
+
+        self._metrics_pusher = MetricsPusher(
+            self.gcs, reporter_id=("driver-" if is_driver else "worker-")
+            + self.worker_id.hex()[:12])
+        self._metrics_pusher.start()
+        # Drivers receive worker stdout/stderr over the LOG channel
+        # (reference log_to_driver).
+        if is_driver and GLOBAL_CONFIG.log_to_driver:
+            try:
+                self.gcs.call("subscribe", {"channel": "LOG", "key": b"*"},
+                              timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
 
     # ----------------------------------------------------------- push events
 
@@ -174,6 +190,12 @@ class CoreRuntime:
                         pass
             rec.event.set()
             self._completion_event.set()
+        elif method == "task_respill":
+            # A raylet returned a queued task it can never run (the cluster
+            # grew): resubmit through the normal routing path.
+            spec = data["spec"]
+            threading.Thread(target=self._resubmit_respilled, args=(spec,),
+                             daemon=True).start()
         elif method in ("object_ready", "object_unavailable"):
             entry = self._object_events.get(data["object_id"].binary())
             if entry is not None:
@@ -194,6 +216,12 @@ class CoreRuntime:
                 client.call("reattach_job", {"job_id": self.job_id}, timeout=5)
             except Exception:  # noqa: BLE001 — older GCS or racing restart
                 pass
+        if self.is_driver and GLOBAL_CONFIG.log_to_driver:
+            try:
+                client.call("subscribe", {"channel": "LOG", "key": b"*"},
+                            timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
         with self._lock:
             actor_keys = [k for k in self._actor_clients] + \
                 [k for k in self._actor_states]
@@ -202,6 +230,15 @@ class CoreRuntime:
 
     def _on_gcs_push(self, method: str, data: Any):
         if method != "pubsub":
+            return
+        if data["channel"] == "LOG":
+            from ray_tpu.core.log_streaming import print_log_batch
+
+            msg = data["message"]
+            # Only this driver's job (untagged output — actor background
+            # threads between tasks — still prints).
+            if msg.get("job") in (None, self.job_id.hex()):
+                print_log_batch(msg)
             return
         if data["channel"] == "ACTOR":
             actor_key = data["key"]
@@ -334,6 +371,18 @@ class CoreRuntime:
                     on_close=lambda: self._on_remote_raylet_lost(address))
                 self._raylet_clients[address] = client
             return client
+
+    def _resubmit_respilled(self, spec: TaskSpec):
+        if self._closed:
+            return
+        rec = self._tasks.get(spec.task_id.binary())
+        if rec is None or rec.event.is_set():
+            return  # already resolved elsewhere
+        try:
+            self._submit_spec(spec)
+        except Exception as e:  # noqa: BLE001
+            self._fail_task_record(rec, spec, serialization.serialize_exception(
+                RaySystemError(f"respill resubmit failed: {e}")))
 
     def _on_remote_raylet_lost(self, address: str):
         """A remote raylet holding our submitted tasks died: fail over every
@@ -848,6 +897,10 @@ class CoreRuntime:
 
     def shutdown(self):
         self._flush_free_buffer()
+        try:
+            self._metrics_pusher.stop()
+        except Exception:  # noqa: BLE001
+            pass
         self._closed = True
         for c in self._actor_clients.values():
             c.client.close()

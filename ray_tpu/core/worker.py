@@ -273,6 +273,16 @@ def main():
                 "%(levelname)s %(name)s: %(message)s"),
     )
     runtime = WorkerRuntime()
+    if GLOBAL_CONFIG.log_to_driver:
+        from ray_tpu.core.log_streaming import LogStreamer
+
+        def _current_job():
+            spec = runtime.executing_task or runtime.actor_spec
+            return spec.job_id.hex() if spec is not None else None
+
+        streamer = LogStreamer(runtime.gcs, runtime.worker_id.hex(),
+                               os.getpid(), job_provider=_current_job)
+        streamer.install()
 
     def _term(signum, frame):
         os._exit(0)

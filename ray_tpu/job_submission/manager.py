@@ -1,0 +1,157 @@
+"""Server half of job submission: spawn, monitor, and log entrypoints.
+
+Reference: `dashboard/modules/job/job_manager.py:507` (the reference runs
+drivers via a JobSupervisor actor; here the GCS process supervises the
+subprocess directly — one fewer moving part, same state machine).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.job_submission import JobStatus
+
+
+class JobManager:
+    def __init__(self, gcs_address: str, log_dir: str):
+        self._gcs_address = gcs_address
+        self._log_dir = log_dir
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+
+    # ---------------------------------------------------------------- API
+
+    def submit(self, entrypoint: str, submission_id: Optional[str] = None,
+               runtime_env: Optional[Dict[str, Any]] = None,
+               metadata: Optional[Dict[str, str]] = None) -> str:
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            if sid in self._jobs:
+                raise ValueError(f"submission_id {sid!r} already exists")
+            self._jobs[sid] = {
+                "entrypoint": entrypoint, "status": JobStatus.PENDING,
+                "message": "", "start_time": None, "end_time": None,
+                "metadata": metadata or {}, "proc": None,
+                "log_path": os.path.join(self._log_dir, f"job-{sid}.log")}
+        threading.Thread(target=self._run, args=(sid, runtime_env),
+                         name=f"job-{sid[:12]}", daemon=True).start()
+        return sid
+
+    def _run(self, sid: str, runtime_env: Optional[Dict[str, Any]]):
+        job = self._jobs[sid]
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = self._gcs_address
+        env["RAY_TPU_SUBMISSION_ID"] = sid
+        # The entrypoint must import the SAME framework this cluster runs
+        # (which may not be pip-installed, and /tmp/ray_tpu session dirs
+        # can shadow the package as a namespace package).
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = pkg_root + (os.pathsep + pp if pp else "")
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[k] = str(v)
+        cwd = (runtime_env or {}).get("working_dir") or None
+        os.makedirs(self._log_dir, exist_ok=True)
+        try:
+            with open(job["log_path"], "wb") as logf:
+                with self._lock:
+                    if job["status"] == JobStatus.STOPPED:
+                        # stop_job() won the race before the spawn: honor it.
+                        job["end_time"] = time.time()
+                        return
+                    proc = subprocess.Popen(
+                        job["entrypoint"], shell=True, stdout=logf,
+                        stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                        start_new_session=True)
+                    job["proc"] = proc
+                    job["status"] = JobStatus.RUNNING
+                    job["start_time"] = time.time()
+                rc = proc.wait()
+            with self._lock:
+                job["end_time"] = time.time()
+                if job["status"] == JobStatus.STOPPED:
+                    pass  # stop_job already labeled it
+                elif rc == 0:
+                    job["status"] = JobStatus.SUCCEEDED
+                else:
+                    job["status"] = JobStatus.FAILED
+                    job["message"] = f"entrypoint exited with code {rc}"
+        except Exception as e:  # noqa: BLE001 — spawn failure
+            with self._lock:
+                job["status"] = JobStatus.FAILED
+                job["message"] = f"failed to start: {e}"
+                job["end_time"] = time.time()
+
+    def details(self, sid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            job = self._jobs.get(sid)
+            if job is None:
+                return None
+            return {"submission_id": sid, "entrypoint": job["entrypoint"],
+                    "status": job["status"], "message": job["message"],
+                    "start_time": job["start_time"],
+                    "end_time": job["end_time"],
+                    "metadata": dict(job["metadata"])}
+
+    def logs(self, sid: str) -> Optional[str]:
+        with self._lock:
+            job = self._jobs.get(sid)
+        if job is None:
+            return None
+        try:
+            with open(job["log_path"], "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop(self, sid: str) -> bool:
+        with self._lock:
+            job = self._jobs.get(sid)
+            if job is None or job["status"] in JobStatus.TERMINAL:
+                return False
+            job["status"] = JobStatus.STOPPED
+            proc = job["proc"]
+        if proc is not None and proc.poll() is None:
+            try:
+                # The entrypoint may have children (driver spawns workers
+                # elsewhere, but shell pipelines are local): kill the group.
+                os.killpg(os.getpgid(proc.pid), 15)
+            except Exception:  # noqa: BLE001
+                try:
+                    proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
+
+    def delete(self, sid: str) -> bool:
+        with self._lock:
+            job = self._jobs.get(sid)
+            if job is None or job["status"] not in JobStatus.TERMINAL:
+                return False
+            del self._jobs[sid]
+        try:
+            os.unlink(job["log_path"])
+        except OSError:
+            pass
+        return True
+
+    def list(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            sids = list(self._jobs)
+        return [d for sid in sids if (d := self.details(sid)) is not None]
+
+    def shutdown(self):
+        with self._lock:
+            sids = [s for s, j in self._jobs.items()
+                    if j["status"] == JobStatus.RUNNING]
+        for sid in sids:
+            self.stop(sid)
