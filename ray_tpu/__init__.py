@@ -239,9 +239,24 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    # Round-1 semantics: best-effort, queued tasks only (see raylet TODO).
-    logger.warning("cancel() is currently best-effort; running tasks are not "
-                   "interrupted")
+    """Cancel the task that produces `ref` (reference `ray.cancel`):
+    queued tasks are dropped; running tasks are interrupted (force=True
+    kills the worker process). get() on the ref raises
+    TaskCancelledError. Actor tasks cannot be cancelled."""
+    runtime = _require_runtime()
+    rec = runtime._tasks.get(
+        runtime._object_to_task.get(ref.object_id.binary(), b""))
+    if rec is None or rec.spec is None:
+        return  # unknown or already pruned: nothing to do
+    if rec.spec.actor_id is not None:
+        raise TypeError("ray_tpu.cancel() cannot cancel actor tasks")
+    if rec.event.is_set():
+        return  # already finished
+    addr = rec.submitted_addr
+    client = runtime.raylet if addr in (None, runtime.raylet.address) \
+        else runtime._raylet_for(addr)
+    client.call("cancel_task", {"task_id": rec.spec.task_id, "force": force},
+                timeout=30)
 
 
 # ----------------------------------------------------------------- cluster

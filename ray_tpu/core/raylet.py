@@ -654,6 +654,12 @@ class Raylet:
         tpus = spec.resources.get(TPU, 0)
         if tpus:
             env["RAY_TPU_GRANTED_TPU"] = str(tpus)
+        # runtime_env env_vars (reference runtime_env system, minimal
+        # slice): workers are leased by matching granted env, so tasks
+        # with different env_vars get different worker processes.
+        renv = spec.runtime_env or {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            env[str(k)] = str(v)
         return env
 
     def _dispatch_to(self, worker: WorkerHandle, qt: QueuedTask):
@@ -1144,6 +1150,75 @@ class Raylet:
         if waiters:
             self._dispatch_event.set()
         self._notify_object_waiters(oid, "object_ready")
+
+    def handle_cancel_task(self, conn: Connection, data: Dict[str, Any]):
+        """Cancel a queued or running normal task (reference
+        `ray.cancel`): queued tasks are dropped; running tasks get an
+        interrupt signal (or, with force, their worker is killed). The
+        submitter receives TaskCancelledError either way, and cancelled
+        tasks are never retried."""
+        import signal as _signal
+
+        from ray_tpu.exceptions import TaskCancelledError
+
+        task_id = data["task_id"]
+        force = bool(data.get("force"))
+        tkey = task_id.binary()
+        err = serialization.serialize_exception(
+            TaskCancelledError(task_id), "cancelled")
+        with self._lock:
+            queued = next((qt for qt in self._queue
+                           if qt.spec.task_id.binary() == tkey), None)
+            if queued is not None:
+                self._queue.remove(queued)
+                for dep in queued.deps_remaining:
+                    waiters = self._waiting_deps.get(dep)
+                    if waiters and queued in waiters:
+                        waiters.remove(queued)
+                submitter = self._task_submitters.pop(tkey, None)
+        if queued is not None:
+            if submitter is not None and submitter.alive:
+                try:
+                    submitter.push("task_result",
+                                   {"task_id": task_id, "results": [],
+                                    "error": err})
+                except Exception:  # noqa: BLE001
+                    pass
+            return {"cancelled": "queued"}
+        with self._lock:
+            entry = self._running.get(tkey)
+        if entry is None:
+            return {"cancelled": None}  # already finished (or elsewhere)
+        spec, worker = entry
+        if not force:
+            # Cooperative interrupt: tell the worker WHICH task to cancel —
+            # it signals itself after recording the id, and its handler
+            # verifies the id before raising, so a cancel can never hit a
+            # different task the worker has since started. Normal
+            # task_done reports the error (crashed=False -> no retry).
+            try:
+                worker.conn.push("cancel_exec", {"task_id": task_id})
+                return {"cancelled": "interrupted"}
+            except Exception:  # noqa: BLE001 — worker gone
+                return {"cancelled": None}
+        # Force: pre-empt the result so the submitter sees cancellation
+        # (not WorkerCrashedError), then kill the worker process.
+        with self._lock:
+            self._running.pop(tkey, None)
+            submitter = self._task_submitters.pop(tkey, None)
+        if submitter is not None and submitter.alive:
+            try:
+                submitter.push("task_result",
+                               {"task_id": task_id, "results": [],
+                                "error": err})
+            except Exception:  # noqa: BLE001
+                pass
+        if worker.proc is not None and worker.proc.poll() is None:
+            try:
+                worker.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        return {"cancelled": "killed"}
 
     def handle_cancel_object_wait(self, conn: Connection, data: Dict[str, Any]):
         """Client gave up on a get (timeout): drop its waiter entry so the

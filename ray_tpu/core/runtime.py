@@ -110,6 +110,12 @@ class CoreRuntime:
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
         self._free_buffer: List[ObjectID] = []
         self._free_timer: Optional[threading.Timer] = None
+        # Actor-call inline results ride the direct push channel and are
+        # NOT in the cluster object directory; when such a ref is passed as
+        # a task argument it must be published first (lazily — most actor
+        # results never leave the caller). Keys are published-or-pending.
+        self._published_deps: set = set()
+        self._publish_when_done: set = set()
         # Owner-side reference counting (reference `reference_count.h`):
         # local ObjectRef count per object + pins while submitted tasks
         # depend on the object; frees are deferred until both drop to zero.
@@ -189,6 +195,21 @@ class CoreRuntime:
                     except Exception:
                         pass
             rec.event.set()
+            # Deferred publication: a ref of this (actor) task was passed
+            # as a task dependency before the result arrived. Runs after
+            # event.set() so _ensure_dep_visible's is_set() check plus the
+            # locked set-pop below give exactly-once publication.
+            if rec.spec is not None and rec.spec.actor_id is not None and \
+                    rec.results:
+                with self._lock:
+                    pending = [r for r in rec.results
+                               if r["object_id"].binary()
+                               in self._publish_when_done]
+                    for r in pending:
+                        self._publish_when_done.discard(
+                            r["object_id"].binary())
+                if pending:
+                    self._publish_inline_results(pending)
             self._completion_event.set()
         elif method == "task_respill":
             # A raylet returned a queued task it can never run (the cluster
@@ -201,12 +222,17 @@ class CoreRuntime:
             if entry is not None:
                 entry[0].set()
             self._completion_event.set()
+        elif method == "cancel_exec":
+            self.on_cancel_exec(data["task_id"])
         elif method == "execute_task":
             # Only workers receive this; WorkerLoop overrides via subclassing hook.
             self.on_execute_task(data["spec"])
 
     def on_execute_task(self, spec: TaskSpec):  # overridden in worker.py
         raise RaySystemError("driver runtime received execute_task")
+
+    def on_cancel_exec(self, task_id):  # overridden in worker.py
+        pass
 
     def _resubscribe_gcs(self, client: RpcClient):
         # Re-bind this driver's job to the fresh connection so driver-exit
@@ -309,6 +335,7 @@ class CoreRuntime:
         flat = list(args) + list(kwargs.values())
         for a in flat:
             if isinstance(a, ObjectRef):
+                self._ensure_dep_visible(a.object_id)
                 out.append(("r", a.object_id))
             else:
                 blob = serialization.serialize_to_bytes(a)
@@ -317,6 +344,48 @@ class CoreRuntime:
                 else:
                     out.append(("v", blob))
         return out, list(kwargs.keys())
+
+    def _ensure_dep_visible(self, oid: ObjectID):
+        """Make an actor-call result usable as a task dependency: publish
+        its inline payload to the object directory (once). Normal task
+        results are registered by the executing raylet; actor store
+        results by the actor's raylet — only actor INLINE results are
+        invisible cluster-wide."""
+        key = oid.binary()
+        with self._lock:
+            if key in self._published_deps:
+                return
+            self._published_deps.add(key)
+            task_key = self._object_to_task.get(key)
+            rec = self._tasks.get(task_key) if task_key is not None else None
+            if rec is None or rec.spec is None or rec.spec.actor_id is None:
+                return  # puts/task returns: already directory-visible
+            self._publish_when_done.add(key)
+        # Race arbitration with the result handler (which publishes pending
+        # keys AFTER rec.event.set()): if the event is set here, the
+        # handler's scan may have run before our add — whoever pops the key
+        # from the set (under the lock) publishes; the other side skips.
+        if rec.event.is_set():
+            with self._lock:
+                claimed = key in self._publish_when_done
+                self._publish_when_done.discard(key)
+                results = [r for r in (rec.results or [])
+                           if r["object_id"].binary() == key]
+            if claimed:
+                self._publish_inline_results(results)
+
+    def _publish_inline_results(self, results: List[Dict[str, Any]]):
+        for r in results:
+            if r.get("kind") != "inline":
+                continue
+            try:
+                self.gcs.call("object_location_add",
+                              {"object_id": r["object_id"],
+                               "inline": r["data"], "size": len(r["data"]),
+                               "owner": self.worker_id.hex()}, timeout=10)
+            except Exception:  # noqa: BLE001
+                logger.warning("failed to publish actor result %s",
+                               r["object_id"])
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         rec = _TaskRecord(spec=spec)

@@ -69,6 +69,7 @@ class WorkerRuntime(CoreRuntime):
         self._actor_executor: Optional[Any] = None
         self._async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._stopping = threading.Event()
+        self._cancel_task_id = None  # ray.cancel target (see on_cancel_exec)
 
     # ------------------------------------------------------------ plumbing
 
@@ -83,6 +84,14 @@ class WorkerRuntime(CoreRuntime):
     def on_execute_task(self, spec: TaskSpec):
         # Called on the RpcClient reader thread: enqueue only.
         self._task_queue.put(spec)
+
+    def on_cancel_exec(self, task_id):
+        """ray.cancel: record the target and poke the main thread; the
+        SIGUSR1 handler raises only if the target is still executing."""
+        self._cancel_task_id = task_id
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGUSR1)
 
     def main_loop(self):
         while not self._stopping.is_set():
@@ -287,7 +296,21 @@ def main():
     def _term(signum, frame):
         os._exit(0)
 
+    def _cancel(signum, frame):
+        # ray.cancel: raise in the main thread (where normal tasks run),
+        # but only if the requested task is STILL the one executing — the
+        # worker may have finished it and started another.
+        spec = runtime.executing_task
+        target = runtime._cancel_task_id
+        if spec is not None and target is not None and \
+                spec.task_id == target:
+            runtime._cancel_task_id = None
+            from ray_tpu.exceptions import TaskCancelledError
+
+            raise TaskCancelledError(spec.task_id)
+
     signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGUSR1, _cancel)
     # Bind the process-global runtime so user code calling ray_tpu.get/put/
     # remote inside tasks routes through this worker's CoreRuntime.
     import ray_tpu

@@ -14,7 +14,7 @@ import numpy as np
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
 from ray_tpu.data.iterator import DataIterator, StreamSplitDataIterator
 from ray_tpu.data import datasource as _ds
 
@@ -127,7 +127,8 @@ def read_binary_files(paths, *, include_paths: bool = False,
 
 
 __all__ = [
-    "Dataset", "DataIterator", "StreamSplitDataIterator", "DataContext",
+    "ActorPoolStrategy", "Dataset", "DataIterator",
+    "StreamSplitDataIterator", "DataContext",
     "Block", "BlockAccessor", "BlockMetadata",
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",

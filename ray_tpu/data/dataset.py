@@ -77,6 +77,29 @@ def _map_batches_transform(fn, batch_size: Optional[int], fn_kwargs):
     return transform
 
 
+class ActorPoolStrategy:
+    """Compute strategy for stateful map_batches UDFs (reference
+    `ActorPoolStrategy` / `actor_pool_map_operator.py`): blocks flow
+    through a pool of long-lived actors, each holding one instance of the
+    UDF class — expensive setup (model load, jit compile) happens once per
+    actor instead of once per block."""
+
+    def __init__(self, size: Optional[int] = None, *, min_size: int = 1,
+                 max_size: Optional[int] = None):
+        self.size = size or max_size or max(min_size, 2)
+
+
+class _MapWorker:
+    """Actor body for ActorPoolStrategy stages."""
+
+    def __init__(self, fn_cls, ctor_args, ctor_kwargs, batch_size, fn_kwargs):
+        self._transform = _map_batches_transform(
+            fn_cls(*ctor_args, **ctor_kwargs), batch_size, fn_kwargs)
+
+    def apply(self, block):
+        return self._transform(block)
+
+
 class Dataset:
     """Lazy pipeline: `_work` produces input blocks, `_transforms` fuse."""
 
@@ -104,13 +127,79 @@ class Dataset:
         return self._derive(_filter_transform(fn))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    fn_kwargs: Optional[Dict] = None, **_compat) -> "Dataset":
+                    fn_kwargs: Optional[Dict] = None,
+                    compute: Optional["ActorPoolStrategy"] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[Dict] = None,
+                    **_compat) -> "Dataset":
+        if compute is not None or isinstance(fn, type):
+            if not isinstance(fn, type):
+                raise ValueError(
+                    "compute=ActorPoolStrategy requires a callable CLASS "
+                    "(stateful UDF), got a function")
+            return _ActorStageDataset(
+                self, fn, batch_size, fn_kwargs or {},
+                tuple(fn_constructor_args), fn_constructor_kwargs or {},
+                compute or ActorPoolStrategy())
         return self._derive(_map_batches_transform(fn, batch_size,
                                                    fn_kwargs or {}))
 
+    def limit(self, n: int) -> "Dataset":
+        """First `n` rows. Executes streaming with early stop (the
+        reference's limit pushdown: upstream tasks past the cutoff are
+        never launched because the pull stops)."""
+        parent = self
+
+        def work() -> List[WorkItem]:
+            out: List[WorkItem] = []
+            remaining = n
+            for block in parent._iter_block_values():
+                acc = BlockAccessor(block)
+                take = min(acc.num_rows(), remaining)
+                if take > 0:
+                    out.append((None, (acc.slice(0, take),)))
+                    remaining -= take
+                if remaining <= 0:
+                    break
+            return out
+
+        return _DeferredDataset(work)
+
+    def sort(self, key: Optional[Any] = None, descending: bool = False
+             ) -> "Dataset":
+        """Global sort (all-to-all barrier, like repartition)."""
+        parent = self
+
+        def work() -> List[WorkItem]:
+            rows = [r for r in parent.iter_rows()]
+            if key is None:
+                if rows and isinstance(rows[0], dict):
+                    raise ValueError(
+                        "sort() on record rows needs a key: pass a column "
+                        "name (sort(key='col')) or a callable")
+                rows.sort(reverse=descending)
+            elif callable(key):
+                rows.sort(key=key, reverse=descending)
+            else:
+                rows.sort(key=lambda r: r[key], reverse=descending)
+            if not rows:
+                return []
+            nb = max(1, parent.num_blocks())
+            per = max(1, -(-len(rows) // nb))
+            return [(None, (rows[i: i + per],))
+                    for i in range(0, len(rows), per)]
+
+        return _DeferredDataset(work)
+
     def with_resources(self, **resources) -> "Dataset":
-        """Run this dataset's tasks with resource options (e.g. num_cpus)."""
-        return Dataset(self._work, self._transforms, resources)
+        """Run this dataset's tasks with resource options (e.g. num_cpus).
+        Type-preserving: subclasses carry their plan state along."""
+        out = self._copy()
+        out._resources = resources
+        return out
+
+    def _copy(self) -> "Dataset":
+        return Dataset(self._work, self._transforms, self._resources)
 
     # ----------------------------------------------------------- all-to-all
 
@@ -334,6 +423,91 @@ class Dataset:
                 f"num_transforms={len(self._transforms)})")
 
 
+class _ActorStageDataset(Dataset):
+    """map_batches over an actor pool: the parent's output refs stream
+    through `size` long-lived _MapWorker actors with bounded in-flight
+    (reference `actor_pool_map_operator.py`); downstream 1:1 transforms
+    fuse into tasks over the stage's outputs as usual."""
+
+    def __init__(self, parent: Dataset, fn_cls, batch_size, fn_kwargs,
+                 ctor_args, ctor_kwargs, strategy: ActorPoolStrategy,
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        super().__init__([], transforms, resources or parent._resources)
+        self._parent = parent
+        self._stage = (fn_cls, batch_size, fn_kwargs, ctor_args, ctor_kwargs,
+                       strategy)
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return _ActorStageDataset(self._parent, *self._stage[:5],
+                                  self._stage[5],
+                                  self._transforms + [transform],
+                                  self._resources)
+
+    def _copy(self) -> "Dataset":
+        return _ActorStageDataset(self._parent, *self._stage[:5],
+                                  self._stage[5], list(self._transforms),
+                                  self._resources)
+
+    def _actor_output_refs(self) -> Iterator[Any]:
+        import ray_tpu
+
+        fn_cls, batch_size, fn_kwargs, ctor_args, ctor_kwargs, strat = \
+            self._stage
+        actor_cls = ray_tpu.remote(_MapWorker)
+        if self._resources:
+            actor_cls = actor_cls.options(**self._resources)
+        actors = [actor_cls.remote(fn_cls, ctor_args, ctor_kwargs,
+                                   batch_size, fn_kwargs)
+                  for _ in range(strat.size)]
+        try:
+            upstream = self._parent._iter_block_refs()
+            in_flight: Dict[Any, Any] = {}  # result ref -> actor
+            free = list(actors)
+            exhausted = False
+            while True:
+                while free and not exhausted:
+                    try:
+                        block_ref = next(upstream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    actor = free.pop()
+                    in_flight[actor.apply.remote(block_ref)] = actor
+                if not in_flight:
+                    if exhausted:
+                        return
+                    continue
+                ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                        timeout=30.0)
+                for ref in ready:
+                    free.append(in_flight.pop(ref))
+                    yield ref
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        if self._materialized_refs is not None:
+            yield from self._materialized_refs
+            return
+        refs = self._actor_output_refs()
+        if not self._transforms:
+            yield from refs
+            return
+        from ray_tpu.data.executor import StreamingExecutor
+
+        executor = StreamingExecutor(self._transforms,
+                                     resources=self._resources)
+        yield from executor.execute((None, (ref,)) for ref in refs)
+
+    def num_blocks(self) -> int:
+        return self._parent.num_blocks()
+
+
 class _DeferredDataset(Dataset):
     """Dataset whose inputs come from a barrier (all-to-all) computation;
     the work list is computed on first execution and cached."""
@@ -348,6 +522,10 @@ class _DeferredDataset(Dataset):
     def _derive(self, transform: Callable) -> "Dataset":
         return _DeferredDataset(self._work_fn,
                                 self._transforms + [transform],
+                                self._resources)
+
+    def _copy(self) -> "Dataset":
+        return _DeferredDataset(self._work_fn, list(self._transforms),
                                 self._resources)
 
     def _resolve(self):

@@ -36,6 +36,8 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -60,6 +62,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0
     scheduler: Optional[TrialScheduler] = None
+    # Adaptive search algorithm (e.g. TPESearcher). When set, trials are
+    # suggested sequentially as results arrive instead of expanded upfront.
+    search_alg: Optional[Searcher] = None
     seed: Optional[int] = None
 
 
@@ -154,6 +159,8 @@ class Tuner:
         tc = self._tune_config
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif tc.search_alg is not None:
+            trials = []  # the searcher proposes them as capacity frees
         else:
             configs = BasicVariantGenerator(
                 self._param_space, tc.num_samples, tc.seed).generate()
@@ -165,6 +172,8 @@ class Tuner:
             experiment_dir=self._experiment_dir(),
             stop=self._run_config.stop,
             metric=tc.metric, mode=tc.mode,
+            searcher=tc.search_alg,
+            num_samples=tc.num_samples if tc.search_alg is not None else None,
         )
         controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
@@ -188,5 +197,5 @@ __all__ = [
     "Trial", "TrialStatus", "TrialScheduler", "FIFOScheduler",
     "ASHAScheduler", "PopulationBasedTraining",
     "grid_search", "choice", "uniform", "loguniform", "randint", "quniform",
-    "sample_from", "get_checkpoint",
+    "sample_from", "get_checkpoint", "Searcher", "TPESearcher",
 ]

@@ -109,6 +109,128 @@ def sample_from(fn: Callable[[], Any]) -> FunctionDomain:
     return FunctionDomain(fn)
 
 
+class Searcher:
+    """Adaptive search algorithm: suggests configs one at a time as trial
+    results arrive (reference `tune/search/searcher.py` Searcher)."""
+
+    def suggest(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, config: Dict[str, Any],
+                          score: Optional[float]) -> None:
+        pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator over independent dimensions
+    (reference's HyperOpt/Optuna integration niche, self-contained: the
+    external libraries aren't available here).
+
+    After `n_initial` random trials, observations split into good (top
+    `gamma` fraction) and bad; numeric dimensions sample candidates from a
+    kernel density over the good values and keep the candidate maximizing
+    the good/bad density ratio; categorical dimensions sample
+    proportionally to smoothed good-counts.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "min", n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    f"TPESearcher does not accept grid_search ({k!r}); "
+                    "use BasicVariantGenerator for grids")
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._history: List[Any] = []  # (config, score) with score not None
+
+    # ------------------------------------------------------------ feedback
+
+    def on_trial_complete(self, config, score):
+        if score is None:
+            return
+        self._history.append((config, float(score)))
+
+    # ----------------------------------------------------------- suggestion
+
+    def suggest(self) -> Dict[str, Any]:
+        if len(self._history) < self.n_initial:
+            return {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                    for k, v in self.param_space.items()}
+        ordered = sorted(self._history, key=lambda cs: cs[1],
+                         reverse=(self.mode == "max"))
+        n_good = max(1, int(len(ordered) * self.gamma))
+        good = [c for c, _ in ordered[:n_good]]
+        bad = [c for c, _ in ordered[n_good:]] or good
+        out: Dict[str, Any] = {}
+        for k, dom in self.param_space.items():
+            if not isinstance(dom, Domain):
+                out[k] = dom
+            elif isinstance(dom, Categorical):
+                out[k] = self._suggest_categorical(k, dom, good)
+            elif isinstance(dom, FunctionDomain):
+                out[k] = dom.sample(self._rng)  # opaque: no model possible
+            else:
+                out[k] = self._suggest_numeric(k, dom, good, bad)
+        return out
+
+    def _suggest_categorical(self, key, dom: Categorical, good):
+        counts = {c: 1.0 for c in dom.categories}  # +1 smoothing prior
+        for cfg in good:
+            if cfg.get(key) in counts:
+                counts[cfg[key]] += 1.0
+        total = sum(counts.values())
+        r = self._rng.uniform(0, total)
+        acc = 0.0
+        for c, w in counts.items():
+            acc += w
+            if r <= acc:
+                return c
+        return dom.categories[-1]
+
+    def _suggest_numeric(self, key, dom: Domain, good, bad):
+        import math
+
+        log_scale = isinstance(dom, LogUniform)
+
+        def to_x(v):
+            return math.log(v) if log_scale else float(v)
+
+        lo, hi = to_x(dom.lower), to_x(dom.upper)
+        span = hi - lo
+        gx = [to_x(c[key]) for c in good if key in c]
+        bx = [to_x(c[key]) for c in bad if key in c]
+        if not gx:
+            return dom.sample(self._rng)
+        bw = max(span / max(len(gx), 1) ** 0.5, 1e-12)
+
+        def density(x, pts):
+            if not pts:
+                return 1.0 / span
+            return sum(math.exp(-0.5 * ((x - p) / bw) ** 2)
+                       for p in pts) / (len(pts) * bw)
+
+        best_x, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            center = self._rng.choice(gx)
+            x = min(max(self._rng.gauss(center, bw), lo), hi)
+            ratio = density(x, gx) / (density(x, bx) + 1e-12)
+            if ratio > best_ratio:
+                best_x, best_ratio = x, ratio
+        v = math.exp(best_x) if log_scale else best_x
+        if isinstance(dom, Randint):
+            return min(max(int(round(v)), dom.lower), dom.upper - 1)
+        if isinstance(dom, QUniform):
+            return round(v / dom.q) * dom.q
+        return v
+
+
 class BasicVariantGenerator:
     """Expands grid_search cross products x num_samples; samples domains."""
 

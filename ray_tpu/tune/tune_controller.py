@@ -98,13 +98,20 @@ class TuneController:
                  stop: Optional[Dict[str, Any]] = None,
                  metric: Optional[str] = None,
                  mode: str = "min",
-                 resources_per_trial: Optional[Dict[str, float]] = None):
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 searcher: Optional[Any] = None,
+                 num_samples: Optional[int] = None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
         self.stop = stop or {}
         self.metric = metric
         self.mode = mode
+        # Adaptive search: the searcher proposes new trials as capacity
+        # frees, informed by completed results, up to num_samples total.
+        self.searcher = searcher
+        self.num_samples = num_samples or len(trials)
+        self._created = len(trials)
         self.experiment_dir = experiment_dir
         self.resources_per_trial = resources_per_trial or {}
         if max_concurrent <= 0:
@@ -120,8 +127,12 @@ class TuneController:
 
     # ------------------------------------------------------------- main loop
 
+    def _more_to_create(self) -> bool:
+        return self.searcher is not None and self._created < self.num_samples
+
     def run(self) -> List[Trial]:
-        while not all(t.is_finished for t in self.trials):
+        while not all(t.is_finished for t in self.trials) \
+                or self._more_to_create():
             self._start_pending()
             if not self._inflight:
                 if any(t.status == TrialStatus.RUNNING for t in self.trials):
@@ -145,7 +156,17 @@ class TuneController:
     def _start_pending(self):
         running = sum(1 for t in self.trials if t.status == TrialStatus.RUNNING)
         pending = [t for t in self.trials if t.status == TrialStatus.PENDING]
-        while running < self.max_concurrent and pending:
+        while running < self.max_concurrent:
+            if not pending and self._more_to_create():
+                config = self.searcher.suggest()
+                if config is None:
+                    break
+                trial = Trial(config=config)
+                self.trials.append(trial)
+                pending.append(trial)
+                self._created += 1
+            if not pending:
+                break
             trial = self.scheduler.choose_trial_to_run(pending)
             if trial is None:
                 break
@@ -189,6 +210,7 @@ class TuneController:
                 trial.status = TrialStatus.TERMINATED
                 trial.runtime_s = time.time() - trial.start_time
                 self.scheduler.on_trial_complete(trial)
+                self._notify_searcher(trial)
             self._cleanup_actor(trial)
             return
         if res.get("timeout"):
@@ -213,6 +235,7 @@ class TuneController:
             trial.status = TrialStatus.TERMINATED
             trial.runtime_s = time.time() - trial.start_time
             self.scheduler.on_trial_complete(trial)
+            self._notify_searcher(trial)
             self._cleanup_actor(trial, kill=True)
         elif decision == PopulationBasedTraining.EXPLOIT and \
                 isinstance(self.scheduler, PopulationBasedTraining):
@@ -246,6 +269,15 @@ class TuneController:
             elif v <= bound:
                 return True
         return False
+
+    def _notify_searcher(self, trial: Trial):
+        if self.searcher is None:
+            return
+        score = trial.last_result.get(self.metric) if self.metric else None
+        try:
+            self.searcher.on_trial_complete(trial.config, score)
+        except Exception:
+            logger.exception("searcher on_trial_complete failed")
 
     def _fail_trial(self, trial: Trial, msg: str):
         trial.status = TrialStatus.ERROR

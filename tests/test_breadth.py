@@ -1,0 +1,193 @@
+"""Data/Tune breadth + task cancellation + runtime_env env_vars.
+
+Mirrors reference coverage for actor-pool map_batches
+(`test_actor_pool_map_operator.py`), limit/sort, adaptive search, and
+`ray.cancel` (`test_cancel.py`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# --------------------------------------------------------------------------- #
+# Data: actor-pool map_batches, limit, sort
+# --------------------------------------------------------------------------- #
+
+
+class AddState:
+    """Stateful UDF: expensive setup once per actor, not per block."""
+
+    def __init__(self, offset):
+        import os
+
+        self.offset = offset
+        self.pid = os.getpid()
+
+    def __call__(self, batch):
+        batch["id"] = batch["id"] + self.offset
+        batch["pid"] = np.full(len(batch["id"]), self.pid)
+        return batch
+
+
+def test_map_batches_actor_pool(ray_start_shared):
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    ds = data.range(200, parallelism=8).map_batches(
+        AddState, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,))
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(1000, 1200))
+    # Exactly pool-size distinct actor processes did the work.
+    assert len({r["pid"] for r in rows}) <= 2
+
+
+def test_map_batches_actor_pool_chains_with_tasks(ray_start_shared):
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    ds = (data.range(100, parallelism=4)
+          .map_batches(AddState, compute=ActorPoolStrategy(size=1),
+                       fn_constructor_args=(0,))
+          .filter(lambda r: r["id"] % 2 == 0))
+    assert ds.count() == 50
+
+
+def test_map_batches_class_requires_actor_strategy_fn_check(ray_start_shared):
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    with pytest.raises(ValueError):
+        data.range(10).map_batches(lambda b: b,
+                                   compute=ActorPoolStrategy(size=1))
+
+
+def test_limit_and_sort(ray_start_shared):
+    from ray_tpu import data
+
+    assert data.range(1000, parallelism=10).limit(7).take_all() == [
+        {"id": i} for i in range(7)]
+    ds = data.from_items([{"v": x} for x in [5, 1, 4, 2, 3]])
+    assert [r["v"] for r in ds.sort(key="v").take_all()] == [1, 2, 3, 4, 5]
+    assert [r["v"] for r in ds.sort(key="v", descending=True).take_all()] == \
+        [5, 4, 3, 2, 1]
+
+
+# --------------------------------------------------------------------------- #
+# Tune: TPE searcher
+# --------------------------------------------------------------------------- #
+
+
+def test_tpe_searcher_suggests_and_improves():
+    from ray_tpu.tune.search import TPESearcher, loguniform, uniform
+
+    space = {"x": uniform(-5, 5), "lr": loguniform(1e-4, 1e-1), "fixed": 7}
+    s = TPESearcher(space, metric="loss", mode="min", n_initial=6, seed=0)
+    # Quadratic bowl at x=2: feed results, expect later suggestions near 2.
+    for _ in range(30):
+        cfg = s.suggest()
+        assert -5 <= cfg["x"] <= 5 and cfg["fixed"] == 7
+        s.on_trial_complete(cfg, (cfg["x"] - 2.0) ** 2)
+    late = [s.suggest()["x"] for _ in range(10)]
+    assert abs(np.median(late) - 2.0) < 1.5, late
+
+
+def test_tpe_searcher_rejects_grid():
+    from ray_tpu.tune.search import TPESearcher, grid_search
+
+    with pytest.raises(ValueError):
+        TPESearcher({"a": grid_search([1, 2])}, metric="m")
+
+
+def test_tuner_with_tpe_search(ray_start_shared, tmp_path):
+    from ray_tpu import tune
+
+    def trainable(config):
+        tune.report({"score": (config["x"] - 3.0) ** 2})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 10)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="min", num_samples=12,
+            max_concurrent_trials=3,
+            search_alg=tune.TPESearcher({"x": tune.uniform(0, 10)},
+                                        metric="score", mode="min",
+                                        n_initial=5, seed=0)),
+        run_config=tune.RunConfig(name="tpe_test", storage_path=str(tmp_path))
+        if hasattr(tune, "RunConfig") else None,
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    best = results.get_best_result()
+    assert best.metrics["score"] < 4.0  # better than random-ish
+
+
+# --------------------------------------------------------------------------- #
+# cancel + runtime_env
+# --------------------------------------------------------------------------- #
+
+
+def test_cancel_queued_task(ray_start_regular):
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote
+    def blocked():
+        return 1
+
+    ref = blocked.options(num_cpus=99).remote()  # never schedulable
+    time.sleep(0.3)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_running_task(ray_start_regular):
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(60)
+        return "finished"
+
+    ref = sleeper.remote()
+    time.sleep(3.0)  # let it start executing
+    ray_tpu.cancel(ref)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 25, "cancel did not interrupt the sleep"
+
+
+def test_cancel_running_task_force(ray_start_regular):
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote
+    def stubborn():
+        while True:
+            time.sleep(1)
+
+    ref = stubborn.remote()
+    time.sleep(3.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_runtime_env_env_vars(ray_start_regular):
+    @ray_tpu.remote
+    def read_env():
+        import os
+
+        return os.environ.get("MY_RUNTIME_FLAG")
+
+    val = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"MY_RUNTIME_FLAG": "on"}}).remote(),
+        timeout=60)
+    assert val == "on"
+    # A task without the env gets a worker without it.
+    assert ray_tpu.get(read_env.remote(), timeout=60) is None
