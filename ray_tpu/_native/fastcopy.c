@@ -1,0 +1,34 @@
+/* Native data-plane helper for the shared-memory object store.
+ *
+ * The reference implements its object store data plane in C++ (plasma,
+ * `src/ray/object_manager/plasma/`); this is the equivalent hot path for
+ * this framework: gather-copy of serialized buffer parts into an shm
+ * segment. Called through ctypes, so the GIL is released for the
+ * duration — concurrent puts from different Python threads copy in
+ * parallel, and a single large copy runs at memcpy speed instead of
+ * Python's byte-wise memoryview assignment.
+ *
+ * Build: cc -O3 -shared -fPIC fastcopy.c -o fastcopy.so (done lazily by
+ * ray_tpu/_native/__init__.py; pure C99, no Python headers).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+/* Copy n parts (srcs[i], lens[i]) into dst back to back. Returns total
+ * bytes copied. */
+size_t rtpu_gather_copy(char *dst, const char **srcs, const size_t *lens,
+                        int n) {
+    size_t pos = 0;
+    for (int i = 0; i < n; i++) {
+        memcpy(dst + pos, srcs[i], lens[i]);
+        pos += lens[i];
+    }
+    return pos;
+}
+
+/* Single copy with an explicit destination offset (chunked transfers). */
+void rtpu_copy_at(char *dst, size_t offset, const char *src, size_t len) {
+    memcpy(dst + offset, src, len);
+}

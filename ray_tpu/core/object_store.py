@@ -162,13 +162,12 @@ class SharedMemoryStore:
             entry.sealed = True
 
     def put_serialized(self, object_id: ObjectID, parts: List[memoryview | bytes]) -> int:
+        from ray_tpu._native import gather_copy
+
         total = serialization.serialized_size(parts)
         buf = self.create(object_id, total)
-        pos = 0
-        for p in parts:
-            n = p.nbytes if isinstance(p, memoryview) else len(p)
-            buf[pos : pos + n] = p
-            pos += n
+        # Native memcpy gather (GIL released); numpy-view fallback.
+        gather_copy(buf, parts)
         self.seal(object_id)
         return total
 

@@ -1046,8 +1046,10 @@ class Raylet:
             raise
         ok = False
         try:
+            from ray_tpu._native import copy_at
+
             data = first["data"]
-            buf[: min(len(data), size)] = data[:size]
+            copy_at(buf, 0, data[:size] if len(data) > size else data)
             pos = min(len(data), size)
             while pos < size:
                 resp = peer.call(
@@ -1057,7 +1059,7 @@ class Raylet:
                 data = resp.get("data")
                 if not data:
                     return False
-                buf[pos: pos + len(data)] = data
+                copy_at(buf, pos, data)
                 pos += len(data)
             self.store.seal(oid)
             ok = True

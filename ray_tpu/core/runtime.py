@@ -303,14 +303,12 @@ class CoreRuntime:
     def _write_segment(self, oid: ObjectID, parts, size: int):
         from multiprocessing import shared_memory
 
+        from ray_tpu._native import gather_copy
+
         shm = shared_memory.SharedMemory(
             name=_segment_name(self.session_suffix, oid), create=True, size=max(size, 1))
         try:
-            pos = 0
-            for p in parts:
-                n = p.nbytes if isinstance(p, memoryview) else len(p)
-                shm.buf[pos:pos + n] = p
-                pos += n
+            gather_copy(shm.buf[:size], parts)
         finally:
             shm.close()
             from ray_tpu.core.object_store import _untrack

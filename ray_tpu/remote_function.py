@@ -77,6 +77,13 @@ class RemoteFunction:
     def func(self):
         return self._function
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (reference
+        `ray.dag`): compose with other bound nodes, run via execute()."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         import ray_tpu
 
