@@ -274,8 +274,38 @@ def available_resources() -> Dict[str, float]:
     return _require_runtime().gcs.call("cluster_resources")["available"]
 
 
-def timeline() -> List[Dict[str, Any]]:
-    return _require_runtime().gcs.call("get_task_events", {})["events"]
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task lifecycle events; with `filename`, write chrome://tracing JSON
+    (reference `ray.timeline`) — load it in chrome://tracing or Perfetto."""
+    # limit=0 -> the GCS's full retained ring, not the 10k default slice.
+    events = _require_runtime().gcs.call(
+        "get_task_events", {"limit": 0})["events"]
+    if filename is not None:
+        import json as _json
+
+        starts: Dict[str, Dict[str, Any]] = {}
+        trace: List[Dict[str, Any]] = []
+        for ev in events:
+            if ev.get("state") == "RUNNING":
+                starts[ev["task_id"]] = ev
+            elif ev.get("state") in ("FINISHED", "FAILED"):
+                st = starts.pop(ev["task_id"], None)
+                if st is None:
+                    continue
+                trace.append({
+                    "name": st.get("name", "task"),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": st["ts"] * 1e6,
+                    "dur": max(0.0, (ev["ts"] - st["ts"]) * 1e6),
+                    "pid": st.get("node_id", "node"),
+                    "tid": f"worker:{st.get('worker_id')}",
+                    "args": {"state": ev["state"],
+                             "task_id": ev["task_id"]},
+                })
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+    return events
 
 
 __all__ = [

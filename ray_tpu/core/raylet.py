@@ -342,6 +342,11 @@ class Raylet:
         # Non-retryable local pull failures (e.g. object exceeds store
         # capacity): surfaced through get_or_pull instead of endless retry.
         self._pull_errors: Dict[ObjectID, str] = {}
+        # Task lifecycle events, flushed to the GCS with the heartbeat.
+        # Bounded so a long GCS outage can't grow it without limit (oldest
+        # events are the right ones to shed — the GCS ring does the same).
+        self._task_event_buffer: deque = deque(
+            maxlen=GLOBAL_CONFIG.task_events_max_buffer // 10)
         self._stopped = threading.Event()
         self._dispatch_event = threading.Event()
         # GCS client with pubsub push handling; reconnects (and re-registers
@@ -427,6 +432,21 @@ class Raylet:
                     # A GCS that restarted without persisted node state (or
                     # that marked us dead during the outage): re-announce.
                     self._register_with_gcs(self.gcs)
+                with self._lock:
+                    events = list(self._task_event_buffer)
+                    self._task_event_buffer.clear()
+                if events:
+                    try:
+                        self.gcs.call("add_task_events", {"events": events},
+                                      timeout=5)
+                    except Exception:
+                        # Flush failed (e.g. GCS mid-restart): keep the
+                        # events for the next attempt instead of losing
+                        # this window's spans.
+                        with self._lock:
+                            self._task_event_buffer.extendleft(
+                                reversed(events))
+                        raise
             except Exception:
                 if self._stopped.is_set():
                     return
@@ -667,10 +687,28 @@ class Raylet:
         worker.current_task = spec
         with self._lock:
             self._running[spec.task_id.binary()] = (spec, worker)
+        self._record_task_event(spec, "RUNNING", worker)
         try:
             worker.conn.push("execute_task", {"spec": spec})
         except Exception:
             self._on_worker_dead(worker, "push failed")
+
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           worker: Optional[WorkerHandle] = None):
+        """Task lifecycle event for the state API / chrome timeline
+        (reference gcs_task_manager events); buffered, flushed with the
+        heartbeat so the hot path never waits on the GCS."""
+        with self._lock:
+            self._task_event_buffer.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "ts": time.time(),
+                "node_id": self.node_id.hex()[:12],
+                "worker_id": worker.worker_id.hex()[:12] if worker else None,
+                "pid": worker.pid if worker else None,
+                "queued_at": spec.submitted_at,
+            })
 
     # --------------------------------------------- worker-facing handlers
 
@@ -696,6 +734,8 @@ class Raylet:
         if entry is None:
             return {}
         spec, worker = entry
+        self._record_task_event(
+            spec, "FAILED" if error_blob is not None else "FINISHED", worker)
         # Resource release (handle partial release from blocked state).
         acquired = self._acquired_resources(spec)
         if released:

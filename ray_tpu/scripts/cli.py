@@ -1,0 +1,75 @@
+"""Command-line state inspection: `python -m ray_tpu <command>`.
+
+Equivalent of the reference CLI surface (`ray status`, `ray list ...`,
+`ray summary tasks`, `ray timeline`, `python/ray/scripts/scripts.py`)
+against a running cluster, addressed by --address (or RAY_TPU_ADDRESS).
+
+Commands:
+    status                         cluster resources + node/actor summary
+    list nodes|actors|jobs|tasks   entity tables
+    summary tasks|actors           aggregated counts
+    timeline --output FILE         chrome://tracing JSON
+    metrics                        Prometheus text from the GCS
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _connect(address: str | None):
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        return ray_tpu, False  # piggyback on the caller's runtime
+    addr = address or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        print("error: --address (or RAY_TPU_ADDRESS) required", file=sys.stderr)
+        raise SystemExit(2)
+    ray_tpu.init(address=addr)
+    return ray_tpu, True
+
+
+def _dump(obj):
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    ap.add_argument("--address", help="GCS address host:port")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    p_list = sub.add_parser("list")
+    p_list.add_argument("what", choices=["nodes", "actors", "jobs", "tasks",
+                                         "objects"])
+    p_sum = sub.add_parser("summary")
+    p_sum.add_argument("what", choices=["tasks", "actors"])
+    p_tl = sub.add_parser("timeline")
+    p_tl.add_argument("--output", default="timeline.json")
+    sub.add_parser("metrics")
+    args = ap.parse_args(argv)
+
+    ray_tpu, owns_runtime = _connect(args.address)
+    from ray_tpu import state
+
+    if args.cmd == "status":
+        _dump(state.cluster_summary())
+    elif args.cmd == "list":
+        _dump(getattr(state, f"list_{args.what}")())
+    elif args.cmd == "summary":
+        _dump(getattr(state, f"summarize_{args.what}")())
+    elif args.cmd == "timeline":
+        events = ray_tpu.timeline(filename=args.output)
+        print(f"wrote {args.output} ({len(events)} events)")
+    elif args.cmd == "metrics":
+        print(ray_tpu._require_runtime().gcs.call(
+            "metrics_prometheus")["text"])
+    if owns_runtime:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,59 @@ def test_job_stop_and_failure_status(ray_start_regular):
 
 
 # --------------------------------------------------------------------------- #
+# Task events / timeline / CLI
+# --------------------------------------------------------------------------- #
+
+
+def test_timeline_records_and_exports_chrome_trace(ray_start_regular,
+                                                   tmp_path):
+    import json
+
+    @ray_tpu.remote
+    def traced(i):
+        time.sleep(0.05)
+        return i
+
+    ray_tpu.get([traced.remote(i) for i in range(4)])
+    # Events flush with the raylet heartbeat (1s period).
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = ray_tpu.timeline()
+        if sum(1 for e in events if e.get("state") == "FINISHED") >= 4:
+            break
+        time.sleep(0.3)
+    names = {e["name"] for e in events}
+    assert any("traced" in n for n in names), names
+
+    out = str(tmp_path / "trace.json")
+    ray_tpu.timeline(filename=out)
+    trace = json.loads(open(out).read())
+    spans = [t for t in trace if "traced" in t["name"]]
+    assert len(spans) >= 4
+    assert all(t["ph"] == "X" and t["dur"] >= 0 for t in spans)
+
+
+def test_state_cli(ray_start_regular, capsys):
+    import json
+
+    from ray_tpu.scripts.cli import main as cli_main
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    address = ray_tpu._global_runtime.gcs.address
+    cli_main(["--address", address, "status"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["nodes"] >= 1 and "resources_total" in out
+    cli_main(["--address", address, "list", "nodes"])
+    nodes = json.loads(capsys.readouterr().out)
+    assert any(n["Alive"] for n in nodes)
+
+
+# --------------------------------------------------------------------------- #
 # Dashboard
 # --------------------------------------------------------------------------- #
 
