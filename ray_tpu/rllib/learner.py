@@ -37,6 +37,7 @@ class Learner:
             optax.clip_by_global_norm(clip), optax.adam(lr))
         self.opt_state = self.optimizer.init(self.params)
         self._update = jax.jit(self._update_impl)
+        self._update_many = jax.jit(self._update_many_impl)
 
     # -- override point -------------------------------------------------------
 
@@ -58,9 +59,37 @@ class Learner:
         metrics["grad_norm"] = optax.global_norm(grads)
         return params, opt_state, metrics
 
+    def _update_many_impl(self, params, opt_state, stacked):
+        """One SGD epoch as a single XLA program: lax.scan over the
+        leading minibatch axis. TPU-first — a per-minibatch Python loop
+        pays one host->device dispatch per step (hundreds of ms through a
+        remote-chip tunnel); the scan pays one for the whole epoch."""
+        import jax
+
+        def step(carry, mb):
+            p, o = carry
+            p, o, metrics = self._update_impl(p, o, mb)
+            return (p, o), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            step, (params, opt_state), stacked)
+        # Epoch means for reporting — except KL, where the guard needs the
+        # END-of-epoch divergence (the mean is diluted by the first
+        # minibatch's near-zero KL and would fire the early stop too late).
+        out = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        if "kl" in metrics:
+            out["kl"] = metrics["kl"][-1]
+        return params, opt_state, out
+
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def update_many(self, stacked: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Run one update per row of the leading minibatch axis."""
+        self.params, self.opt_state, metrics = self._update_many(
+            self.params, self.opt_state, stacked)
         return {k: float(v) for k, v in metrics.items()}
 
     def get_weights(self) -> Any:
@@ -123,6 +152,13 @@ class LearnerGroup:
 
         return ray_tpu.get(self._actor.update.remote(batch))
 
+    def update_many(self, stacked) -> Dict[str, float]:
+        if self._learner is not None:
+            return self._learner.update_many(stacked)
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.update_many.remote(stacked))
+
     def get_weights(self):
         if self._learner is not None:
             return self._learner.get_weights()
@@ -164,6 +200,9 @@ class _LearnerActor:
 
     def update(self, batch):
         return self._learner.update(batch)
+
+    def update_many(self, stacked):
+        return self._learner.update_many(stacked)
 
     def get_weights(self):
         return self._learner.get_weights()

@@ -159,11 +159,20 @@ class PPO:
         sgd_steps = 0
         for _ in range(cfg.num_sgd_iter):
             shuffled = sb.shuffle_batch(batch, self._rng)
-            for mb in sb.minibatches(shuffled, cfg.sgd_minibatch_size):
-                if sb.batch_size(mb) < 2:
-                    continue
-                metrics = self.learner_group.update(self._learner_view(mb))
+            stacked, remainder = sb.stack_minibatches(
+                self._learner_view(shuffled), cfg.sgd_minibatch_size)
+            if stacked:
+                # Whole epoch in one device dispatch (scan over
+                # minibatches) — the per-minibatch Python loop costs one
+                # host->chip round trip per step.
+                metrics = self.learner_group.update_many(stacked)
+                sgd_steps += len(next(iter(stacked.values())))
+            if remainder and sb.batch_size(remainder) >= 2:
+                # The ragged tail trains too (one ordinary update).
+                metrics = self.learner_group.update(remainder)
                 sgd_steps += 1
+            if not sgd_steps:
+                break
             if metrics.get("kl", 0.0) > cfg.kl_target:
                 break  # early stop like the reference's KL guard
         learn_s = time.perf_counter() - t1

@@ -45,6 +45,22 @@ def minibatches(batch: Dict[str, np.ndarray], minibatch_size: int):
         yield {k: v[start:start + minibatch_size] for k, v in batch.items()}
 
 
+def stack_minibatches(batch: Dict[str, np.ndarray], minibatch_size: int
+                      ) -> tuple:
+    """[N, ...] -> ([n_mb, minibatch_size, ...], remainder) for lax.scan
+    epochs. The ragged tail (N mod minibatch_size rows) can't join the
+    scan (unequal shape) — it's returned separately so the caller can run
+    it as one ordinary update. Stacked dict is {} if N < one batch."""
+    n = batch_size(batch)
+    n_mb = n // minibatch_size
+    keep = n_mb * minibatch_size
+    stacked = {} if n_mb == 0 else {
+        k: v[:keep].reshape((n_mb, minibatch_size) + v.shape[1:])
+        for k, v in batch.items()}
+    remainder = {} if keep >= n else {k: v[keep:] for k, v in batch.items()}
+    return stacked, remainder
+
+
 def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
                 truncateds: np.ndarray, bootstrap_values: np.ndarray,
                 gamma: float = 0.99, lam: float = 0.95):
