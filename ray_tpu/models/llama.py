@@ -1,0 +1,235 @@
+"""Llama-family decoder in flax, sharding-annotated, with KV-cache decode.
+
+Second model family (BASELINE.json names a Llama Serve deployment next to
+the GPT-2 trainer): RMSNorm, rotary position embeddings, SwiGLU MLP,
+grouped-query attention, untied LM head — the same logical-axis annotations
+as `gpt2.py` (tp shards heads/mlp, dp/fsdp shard batch, sp shards seq), so
+`make_train_step`/`mesh_shardings_for` work unchanged.
+
+Two forward paths share parameters:
+- `__call__(input_ids)` — full-sequence training forward (flash attention).
+- `decode(input_ids, cache, pos)` — incremental inference against a
+  preallocated KV cache: prefill writes the prompt's K/V once, each decode
+  step attends a 1-token query over the cache (O(context) memory reads
+  instead of an O(context^2) recompute per token).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000          # 250 * 128: already MXU-aligned
+    n_positions: int = 4096
+    n_embd: int = 4096
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 8               # grouped-query attention
+    intermediate: int = 11008        # SwiGLU hidden width
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_flash: bool = True
+    remat: bool = False
+
+    @staticmethod
+    def llama7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def small() -> "LlamaConfig":
+        """~110M-param config for single-chip experiments."""
+        return LlamaConfig(n_embd=768, n_layer=12, n_head=12, n_kv_head=4,
+                           intermediate=2048, n_positions=2048)
+
+    @staticmethod
+    def tiny(seq: int = 128) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, n_positions=seq, n_embd=128,
+                           n_layer=2, n_head=4, n_kv_head=2,
+                           intermediate=352, use_flash=False)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+def _dense(features: int, axes: Tuple[str, ...], cfg: LlamaConfig, name: str):
+    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), axes),
+                    name=name)
+
+
+class RMSNorm(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale",
+                           nn.with_logical_partitioning(
+                               nn.initializers.ones, ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.cfg.rms_eps)
+        return (out * scale).astype(self.cfg.dtype)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float) -> jnp.ndarray:
+    """Rotary embedding on [b, heads, s, d] with per-token positions [b, s]
+    (or [s]); rotates feature pairs (even, odd) halves-style."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    ang = positions[:, None, :, None].astype(jnp.float32) * freqs  # [b,1,s,h]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[Tuple] = None):
+        """cache=None: full causal forward. cache=(k, v) with layout
+        [b, max_len, kv_heads, head_dim]: write this call's K/V at each
+        row's `positions` and attend over the cache; returns (x, cache')."""
+        cfg = self.cfg
+        hd = cfg.head_dim
+        b, s, _ = x.shape
+        h = RMSNorm(cfg, name="attn_norm")(x)
+        q = _dense(cfg.n_head * hd, ("embed", "heads"), cfg, "wq")(h)
+        k = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wk")(h)
+        v = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wv")(h)
+        q = q.reshape(b, s, cfg.n_head, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+        groups = cfg.n_head // cfg.n_kv_head
+        if cache is None:
+            kf = jnp.repeat(k, groups, axis=1)
+            vf = jnp.repeat(v, groups, axis=1)
+            if cfg.use_flash:
+                attn = flash_attention(q, kf, vf, True)
+            else:
+                attn = mha_reference(q, kf, vf, causal=True)
+            new_cache = None
+        else:
+            k_cache, v_cache = cache                 # [b, max, kvh, d]
+            max_len = k_cache.shape[1]
+            rows = jnp.arange(b)[:, None]            # [b, 1]
+            # positions is [b, s]: per-row write offsets (rows of a batch
+            # may be at different lengths).
+            k_cache = k_cache.at[rows, positions].set(
+                k.transpose(0, 2, 1, 3).astype(k_cache.dtype))
+            v_cache = v_cache.at[rows, positions].set(
+                v.transpose(0, 2, 1, 3).astype(v_cache.dtype))
+            kf = jnp.repeat(k_cache, groups, axis=2)  # [b, max, h, d]
+            vf = jnp.repeat(v_cache, groups, axis=2)
+            # Causal over absolute positions, per row: query at absolute
+            # position p sees cache slots <= p; unwritten/pad slots are
+            # beyond every query's position and masked out.
+            kv_pos = jnp.arange(max_len)
+            mask = kv_pos[None, None, :] <= positions[:, :, None]  # [b,s,max]
+            scores = jnp.einsum("bhqd,bkhd->bhqk",
+                                q.astype(jnp.float32),
+                                kf.astype(jnp.float32)) / (hd ** 0.5)
+            scores = jnp.where(mask[:, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bhqd", probs,
+                              vf.astype(jnp.float32)).astype(cfg.dtype)
+            new_cache = (k_cache, v_cache)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_head * hd)
+        x = x + _dense(cfg.n_embd, ("heads", "embed"), cfg, "wo")(attn)
+
+        h2 = RMSNorm(cfg, name="mlp_norm")(x)
+        gate = _dense(cfg.intermediate, ("embed", "mlp"), cfg, "w_gate")(h2)
+        up = _dense(cfg.intermediate, ("embed", "mlp"), cfg, "w_up")(h2)
+        h2 = nn.silu(gate) * up
+        x = x + _dense(cfg.n_embd, ("mlp", "embed"), cfg, "w_down")(h2)
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed")), \
+            new_cache
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("vocab", "embed")),
+            (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        self.blocks = [block(cfg, name=f"layer_{i}")
+                       for i in range(cfg.n_layer)]
+        self.final_norm = RMSNorm(cfg, name="final_norm")
+        self.lm_head = _dense(cfg.vocab_size, ("embed", "vocab"), cfg,
+                              "lm_head")
+
+    def __call__(self, input_ids):
+        cfg = self.config
+        b, s = input_ids.shape
+        x = self.embed.astype(cfg.dtype)[input_ids]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        positions = jnp.arange(s)
+        for blk in self.blocks:
+            x, _ = blk(x, positions)
+        x = self.final_norm(x)
+        logits = self.lm_head(x)
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+    def decode(self, input_ids, cache, row_pos):
+        """Incremental forward: each row writes K/V at its own offset
+        (`row_pos` [b]) and gets logits for its s tokens. One jitted
+        program serves both multi-token prefill and 1-token decode."""
+        cfg = self.config
+        b, s = input_ids.shape
+        x = self.embed.astype(cfg.dtype)[input_ids]
+        positions = row_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+        new_cache = []
+        for i, blk in enumerate(self.blocks):
+            x, layer_cache = blk(x, positions, cache=cache[i])
+            new_cache.append(layer_cache)
+        x = self.final_norm(x)
+        return self.lm_head(x), new_cache
+
+
+def make_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """Preallocated per-layer (k, v) cache [b, max_len, kv_heads, head_dim]
+    (length-major so per-row writes are a single advanced-index set)."""
+    shape = (batch, max_len, cfg.n_kv_head, cfg.head_dim)
+    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.n_layer)]
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token: 6N for the matmuls + attention term."""
+    per_layer = (2 * cfg.n_embd * (cfg.n_head + 2 * cfg.n_kv_head)
+                 * cfg.head_dim                       # qkv
+                 + cfg.n_head * cfg.head_dim * cfg.n_embd  # out proj
+                 + 3 * cfg.n_embd * cfg.intermediate)      # swiglu
+    n = cfg.n_layer * per_layer + 2 * cfg.vocab_size * cfg.n_embd
+    attn = 12 * cfg.n_layer * cfg.n_embd * seq_len
+    return 6.0 * n + 2.0 * attn

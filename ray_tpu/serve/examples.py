@@ -13,9 +13,45 @@ from typing import Any, Dict, List
 
 from ray_tpu import serve
 
+# One compiled batch shape: @serve.batch caps request batches here and the
+# samplers pad row counts to exactly this.
+SAMPLER_BATCH = 8
+
+
+def _prompts_and_budgets(requests: List[Dict[str, Any]], max_seq: int,
+                         default_new: int):
+    """Truncated prompts + per-request decode budgets (shared by all
+    sampler deployments so clamping semantics can't drift)."""
+    import numpy as np
+
+    prompts = [list(r.get("ids", []))[: max_seq - 1] or [0]
+               for r in requests]
+    budgets = np.zeros(len(prompts), np.int32)
+    for i, r in enumerate(requests):
+        budgets[i] = max(1, min(int(r.get("max_new_tokens", default_new)),
+                                max_seq - 1 - len(prompts[i])))
+    return prompts, budgets
+
+
+class _SamplerMetrics:
+    _batches_served = 0
+    _batch_size_sum = 0
+
+    def _observe_batch(self, n: int):
+        self._batches_served += 1
+        self._batch_size_sum += n
+
+    def metrics(self, _=None) -> Dict[str, Any]:
+        served = self._batches_served
+        return {
+            "batches_served": served,
+            "mean_batch_size":
+                (self._batch_size_sum / served) if served else 0.0,
+        }
+
 
 @serve.deployment(max_concurrent_queries=32)
-class GPT2Sampler:
+class GPT2Sampler(_SamplerMetrics):
     """Greedy sampler over a GPT-2 checkpoint (randomly initialized by
     default — serving-path benchmarking doesn't need trained weights).
 
@@ -49,30 +85,18 @@ class GPT2Sampler:
             return jnp.argmax(last, axis=-1).astype(jnp.int32)
 
         self._next_token = jax.jit(next_token)
-        self._batches_served = 0
-        self._batch_size_sum = 0
 
-    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    @serve.batch(max_batch_size=SAMPLER_BATCH, batch_wait_timeout_s=0.02)
     async def __call__(self, requests: List[Dict[str, Any]]):
         import jax.numpy as jnp
         import numpy as np
 
-        self._batches_served += 1
-        self._batch_size_sum += len(requests)
-        prompts = [list(r.get("ids", []))[: self._max_seq - 1]
-                   or [0] for r in requests]
-        # Per-request decode budget: rows stop advancing at their own
-        # max_new_tokens; the loop runs to the batch max.
-        budgets = np.zeros(len(prompts), np.int32)
-        for i, r in enumerate(requests):
-            budgets[i] = max(1, min(
-                int(r.get("max_new_tokens", self._default_new)),
-                self._max_seq - 1 - len(prompts[i])))
-        # Pad the batch dim to max_batch_size too: one XLA compilation for
+        self._observe_batch(len(requests))
+        prompts, budgets = _prompts_and_budgets(requests, self._max_seq,
+                                                self._default_new)
+        # Pad the batch dim to the decorator's cap: one XLA compilation for
         # every batch the flusher can produce, not one per distinct size.
-        padded_b = 8
-        while padded_b < len(prompts):
-            padded_b *= 2
+        padded_b = SAMPLER_BATCH
         ids = np.zeros((padded_b, self._max_seq), np.int32)
         lengths = np.ones(padded_b, np.int32)
         lengths[: len(prompts)] = [len(p) for p in prompts]
@@ -94,10 +118,101 @@ class GPT2Sampler:
         return [{"ids": out_ids[i, : out_lens[i]].tolist()}
                 for i in range(len(prompts))]
 
-    def metrics(self, _=None) -> Dict[str, Any]:
-        served = self._batches_served
-        return {
-            "batches_served": served,
-            "mean_batch_size":
-                (self._batch_size_sum / served) if served else 0.0,
-        }
+
+@serve.deployment(max_concurrent_queries=32)
+class LlamaSampler(_SamplerMetrics):
+    """KV-cached greedy sampler over a Llama-family model (BASELINE.json's
+    Serve Llama deployment). Unlike GPT2Sampler's recompute-per-token
+    loop, this prefills the prompt K/V once and then runs O(1)-attention
+    decode steps against the cache — the TPU-serving decode shape.
+
+    Request: {"ids": [int, ...], "max_new_tokens": int} -> {"ids": [...]}.
+    """
+
+    def __init__(self, model_size: str = "tiny", max_seq: int = 256,
+                 default_new_tokens: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import Llama, LlamaConfig, make_cache
+
+        cfg = {"tiny": LlamaConfig.tiny(seq=max_seq),
+               "small": LlamaConfig.small(),
+               "7b": LlamaConfig.llama7b()}[model_size]
+        self._cfg = cfg
+        self._max_seq = min(max_seq, cfg.n_positions)
+        self._default_new = default_new_tokens
+        self._model = Llama(cfg)
+        rng = jax.random.PRNGKey(0)
+        self._params = jax.jit(lambda: self._model.init(
+            rng, jnp.zeros((1, 8), jnp.int32)))()
+        # One preallocated cache, reused across batches: every slot a query
+        # can see is rewritten during its own call (prefill writes the
+        # prompt span, decode overwrites onward; the position mask hides
+        # the rest), so cross-batch reuse is safe and avoids re-zeroing
+        # gigabytes per request batch on big configs.
+        self._cache = make_cache(self._cfg, SAMPLER_BATCH, self._max_seq)
+
+        def prefill(params, ids, cache, lens):
+            logits, cache = self._model.apply(
+                params, ids, cache, jnp.zeros(ids.shape[0], jnp.int32),
+                method=Llama.decode)
+            # Each row's next token comes from ITS last real position.
+            first = jnp.argmax(jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1)[:, 0],
+                axis=-1).astype(jnp.int32)
+            return first, cache
+
+        def decode_step(params, tok, cache, out, lens, budgets, step):
+            # Append tok at each active row's position, then decode the
+            # next token — all on-device, no host sync per token.
+            active = (step < budgets) & (lens < self._max_seq - 1)
+            rows = jnp.arange(out.shape[0])
+            appended = out.at[rows, lens].set(tok)
+            out = jnp.where(active[:, None], appended, out)
+            lens = jnp.where(active, lens + 1, lens)
+            logits, cache = self._model.apply(params, tok[:, None], cache,
+                                              lens - 1, method=Llama.decode)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, cache, out, lens
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode_step)
+
+    @serve.batch(max_batch_size=SAMPLER_BATCH, batch_wait_timeout_s=0.02)
+    async def __call__(self, requests: List[Dict[str, Any]]):
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._observe_batch(len(requests))
+        prompts, budgets = _prompts_and_budgets(requests, self._max_seq,
+                                                self._default_new)
+        b = SAMPLER_BATCH
+        # Prompt pad to a power of two: a handful of prefill programs total.
+        plen = max(len(p) for p in prompts)
+        pad = 8
+        while pad < plen:
+            pad *= 2
+        pad = min(pad, self._max_seq)
+        ids = np.zeros((b, pad), np.int32)
+        lens = np.ones(b, np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+            lens[i] = len(p)
+        full_budgets = np.zeros(b, np.int32)
+        full_budgets[: len(prompts)] = budgets
+
+        tok, self._cache = self._prefill(self._params, jnp.asarray(ids),
+                                         self._cache, jnp.asarray(lens))
+        out = jnp.zeros((b, self._max_seq), jnp.int32)
+        out = out.at[:, :pad].set(jnp.asarray(ids))
+        lens_j = jnp.asarray(lens)
+        budgets_j = jnp.asarray(full_budgets)
+        for step in range(int(budgets.max())):
+            tok, self._cache, out, lens_j = self._decode(
+                self._params, tok, self._cache, out, lens_j, budgets_j,
+                jnp.int32(step))
+        out_np = np.asarray(out)
+        out_lens = np.asarray(lens_j)
+        return [{"ids": out_np[i, : out_lens[i]].tolist()}
+                for i in range(len(prompts))]
