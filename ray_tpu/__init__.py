@@ -82,6 +82,15 @@ def init(
         GLOBAL_CONFIG.initialize(_system_config)
         from ray_tpu.core.node import Node
 
+        if address is not None and address.startswith("ray://"):
+            # Client mode (reference Ray Client): no local raylet or shared
+            # memory — every operation proxies to the head's client server.
+            from ray_tpu.client import connect
+
+            _global_runtime = connect(address[len("ray://"):],
+                                      namespace=namespace)
+            atexit.register(shutdown)
+            return _context_info()
         if address is None or address == "local":
             _global_node = Node(
                 head=True,
@@ -132,7 +141,8 @@ def init(
 def _context_info() -> Dict[str, Any]:
     return {
         "gcs_address": _global_runtime.gcs.address,
-        "raylet_address": _global_runtime.raylet.address,
+        "raylet_address": getattr(
+            getattr(_global_runtime, "raylet", None), "address", None),
         "node_id": _global_runtime.node_id.hex() if _global_runtime.node_id else None,
         "job_id": _global_runtime.job_id.hex(),
         "session_dir": getattr(_global_node, "session_dir", None),
@@ -243,20 +253,7 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     queued tasks are dropped; running tasks are interrupted (force=True
     kills the worker process). get() on the ref raises
     TaskCancelledError. Actor tasks cannot be cancelled."""
-    runtime = _require_runtime()
-    rec = runtime._tasks.get(
-        runtime._object_to_task.get(ref.object_id.binary(), b""))
-    if rec is None or rec.spec is None:
-        return  # unknown or already pruned: nothing to do
-    if rec.spec.actor_id is not None:
-        raise TypeError("ray_tpu.cancel() cannot cancel actor tasks")
-    if rec.event.is_set():
-        return  # already finished
-    addr = rec.submitted_addr
-    client = runtime.raylet if addr in (None, runtime.raylet.address) \
-        else runtime._raylet_for(addr)
-    client.call("cancel_task", {"task_id": rec.spec.task_id, "force": force},
-                timeout=30)
+    _require_runtime().cancel(ref.object_id, force=force)
 
 
 # ----------------------------------------------------------------- cluster

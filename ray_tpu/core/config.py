@@ -69,6 +69,7 @@ _flag("object_transfer_chunk_bytes", int, 16 * 1024 * 1024, "Node-to-node object
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")
+_flag("enable_client_server", bool, True, "Start the ray:// client proxy on the head node")
 
 # --- TPU / JAX specifics ----------------------------------------------------
 _flag("tpu_chips_per_host", int, 4, "Default chips per TPU host when not detected")

@@ -94,6 +94,22 @@ class Node:
             object_store_memory=object_store_memory,
         )
         self.raylet.start()
+        self.client_server = None
+        if head:
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            if GLOBAL_CONFIG.enable_client_server:
+                try:
+                    from ray_tpu.client import ClientServer
+
+                    self.client_server = ClientServer(
+                        self.gcs_address, self.raylet_address,
+                        self.session_suffix, self.node_id).start()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "client server failed to start", exc_info=True)
 
     @property
     def raylet_address(self) -> str:
@@ -108,6 +124,11 @@ class Node:
         return self.raylet.node_id
 
     def shutdown(self):
+        if self.client_server is not None:
+            try:
+                self.client_server.stop()
+            except Exception:
+                pass
         self.raylet.stop()
         if self.dashboard is not None:
             try:

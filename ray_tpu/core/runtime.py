@@ -816,6 +816,24 @@ class CoreRuntime:
                 pass
         self._completion_event.set()
 
+    def cancel(self, oid: ObjectID, force: bool = False):
+        """Cancel the task producing `oid` (reference ray.cancel): queued
+        tasks are dropped, running tasks interrupted (force kills the
+        worker). No-op for unknown/finished tasks; actor tasks refuse."""
+        rec = self._tasks.get(self._object_to_task.get(oid.binary(), b""))
+        if rec is None or rec.spec is None:
+            return
+        if rec.spec.actor_id is not None:
+            raise TypeError("cancel() cannot cancel actor tasks")
+        if rec.event.is_set():
+            return
+        addr = rec.submitted_addr
+        client = self.raylet if addr in (None, self.raylet.address) \
+            else self._raylet_for(addr)
+        client.call("cancel_task",
+                    {"task_id": rec.spec.task_id, "force": force},
+                    timeout=30)
+
     def _dep_alive(self, oid: ObjectID) -> bool:
         """Cluster-visible existence: inline in the directory or at least
         one live node holds a copy."""

@@ -1,0 +1,139 @@
+"""ray:// client mode: drive the cluster from a process that isn't in it.
+
+Mirrors the reference's Ray Client tests (`ray/util/client/`): the client
+process has no raylet and no shared-memory attachment — everything proxies
+through the head's client server.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import json, sys
+    import ray_tpu
+
+    ray_tpu.init(address="ray://" + sys.argv[1])
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+        def bump(self, by=1):
+            self.n += by
+            return self.n
+
+    out = {}
+    out["tasks"] = ray_tpu.get([square.remote(i) for i in range(5)])
+    big = ray_tpu.put(list(range(50000)))           # forces proxy put path
+    out["big_len"] = len(ray_tpu.get(big))
+    c = Counter.options(name="cli-counter").remote(10)
+    out["bumps"] = [ray_tpu.get(c.bump.remote()) for _ in range(3)]
+    again = ray_tpu.get_actor("cli-counter")
+    out["named"] = ray_tpu.get(again.bump.remote(5))
+    ready, pending = ray_tpu.wait([square.remote(9)], timeout=30)
+    out["wait_ready"] = len(ready)
+    try:
+        ray_tpu.get(square.remote("nope"), timeout=30)
+        out["error"] = "MISSED"
+    except TypeError:
+        out["error"] = "TypeError"
+    out["nodes"] = len([n for n in ray_tpu.nodes() if n["Alive"]])
+    print("RESULT:" + json.dumps(out))
+    ray_tpu.shutdown()
+""")
+
+
+def test_client_mode_end_to_end(ray_start_regular, tmp_path):
+    gcs_address = ray_tpu._global_runtime.gcs.address
+    script = tmp_path / "client.py"
+    script.write_text(CLIENT_SCRIPT)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, str(script), gcs_address],
+                          capture_output=True, text=True, timeout=240,
+                          env=env)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("RESULT:")), None)
+    assert line, f"stdout={proc.stdout[-500:]} stderr={proc.stderr[-1500:]}"
+    out = json.loads(line[len("RESULT:"):])
+    assert out["tasks"] == [0, 1, 4, 9, 16]
+    assert out["big_len"] == 50000
+    assert out["bumps"] == [11, 12, 13]
+    assert out["named"] == 18
+    assert out["wait_ready"] == 1
+    assert out["error"] == "TypeError"
+    assert out["nodes"] >= 1
+
+
+def test_client_cancel_and_sliced_get(ray_start_regular):
+    """cancel() proxies in client mode, and a get longer than one server
+    slice still completes (the client loops bounded slices)."""
+    import time
+
+    from ray_tpu.client import ClientRuntime
+    from ray_tpu.client.server import CLIENT_SERVER_KV_KEY
+
+    addr = ray_tpu._global_runtime.gcs.call(
+        "kv_get", {"namespace": "cluster",
+                   "key": CLIENT_SERVER_KV_KEY})["value"].decode()
+    client = ClientRuntime(addr)
+    client._SLICE_S = 1.0  # force multiple slices
+    try:
+        from ray_tpu.core import serialization
+        from ray_tpu.core.common import TaskSpec
+        from ray_tpu.core.ids import TaskID
+
+        def nap():
+            import time as _t
+
+            _t.sleep(3)
+            return "done"
+
+        blob = serialization.dumps(nap)
+        fn_id = client.export_function(blob)
+        spec = TaskSpec(task_id=TaskID.for_task(client.job_id),
+                        job_id=client.job_id, name="nap",
+                        function_id=fn_id, function_blob=None,
+                        resources={"CPU": 1.0})
+        (oid,) = client.submit_task(spec)
+        assert client.get([oid], timeout=60) == ["done"]  # > 2 slices
+    finally:
+        client.shutdown()
+
+
+def test_client_refs_released_on_disconnect(ray_start_regular):
+    """The server registers refs per client and drops them when the client
+    connection closes (no leak across client sessions)."""
+    from ray_tpu.client import ClientRuntime
+    from ray_tpu.client.server import CLIENT_SERVER_KV_KEY
+
+    addr = ray_tpu._global_runtime.gcs.call(
+        "kv_get", {"namespace": "cluster",
+                   "key": CLIENT_SERVER_KV_KEY})["value"].decode()
+    client = ClientRuntime(addr)
+    oid = client.put([1, 2, 3])
+    assert client.get([oid]) == [[1, 2, 3]]
+    server = ray_tpu._global_node.client_server
+    assert any(oid.binary() in refs
+               for refs in server._client_refs.values())
+    client.shutdown()
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not any(oid.binary() in refs
+                   for refs in server._client_refs.values()):
+            break
+        time.sleep(0.2)
+    assert not any(oid.binary() in refs
+                   for refs in server._client_refs.values())
