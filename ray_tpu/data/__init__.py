@@ -126,11 +126,33 @@ def read_binary_files(paths, *, include_paths: bool = False,
     return Dataset([(_ds.read_binary_file, (f, include_paths)) for f in files])
 
 
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """Raw TFRecord payloads as {"data": bytes} rows (framing + crc32c
+    validated; no TensorFlow dependency)."""
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_tfrecord_file, (f,)) for f in files])
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1
+             ) -> Dataset:
+    """One read task running `sql` through a DB-API connection factory
+    (reference `ray.data.read_sql`)."""
+    return Dataset([(_ds.read_sql_query, (sql, connection_factory))])
+
+
+def read_images(paths, *, size=None, mode: Optional[str] = None,
+                parallelism: int = -1) -> Dataset:
+    """Decoded images as {"image": ndarray, "path": str} rows."""
+    files = _ds.expand_paths(paths)
+    return Dataset([(_ds.read_image_file, (f, size, mode)) for f in files])
+
+
 __all__ = [
     "ActorPoolStrategy", "Dataset", "DataIterator",
     "StreamSplitDataIterator", "DataContext",
     "Block", "BlockAccessor", "BlockMetadata",
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_numpy", "read_binary_files",
+    "read_numpy", "read_binary_files", "read_tfrecords", "read_sql",
+    "read_images",
 ]

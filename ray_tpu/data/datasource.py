@@ -76,6 +76,123 @@ def read_binary_file(path: str, include_paths: bool = False):
     return [data]
 
 
+# --------------------------------------------------------------- tfrecords #
+# TFRecord framing (no TF dependency): each record is
+#   [8B LE length][4B masked crc32c(length)][data][4B masked crc32c(data)]
+# crc32c implemented table-driven (Castagnoli polynomial), mask per the
+# TFRecord spec, so files round-trip with TensorFlow's readers.
+
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    try:  # native implementations when present — the Python loop is slow
+        import google_crc32c
+
+        return int.from_bytes(google_crc32c.Checksum(data).digest(), "big")
+    except ImportError:
+        pass
+    try:
+        import crc32c as _c32
+
+        return _c32.crc32c(data)
+    except ImportError:
+        pass
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def read_tfrecord_file(path: str, validate: bool = True) -> List[Dict[str, Any]]:
+    import struct
+
+    rows: List[Dict[str, Any]] = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                break
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:])
+            if validate and _masked_crc(header[:8]) != len_crc:
+                raise ValueError(f"corrupt tfrecord length crc in {path}")
+            data = f.read(length)
+            footer = f.read(4)
+            if len(data) < length or len(footer) < 4:
+                raise ValueError(f"truncated tfrecord in {path}")
+            (data_crc,) = struct.unpack("<I", footer)
+            if validate and _masked_crc(data) != data_crc:
+                raise ValueError(f"corrupt tfrecord data crc in {path}")
+            rows.append({"data": data})
+    return rows
+
+
+def write_tfrecords(records, path: str) -> str:
+    import struct
+
+    with open(path, "wb") as f:
+        for rec in records:
+            data = rec["data"] if isinstance(rec, dict) else bytes(rec)
+            header = struct.pack("<Q", len(data))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+    return path
+
+
+# --------------------------------------------------------------------- sql #
+
+
+def read_sql_query(sql: str, connection_factory, params=()) -> Dict[str, np.ndarray]:
+    """Run one query through a DB-API connection factory (reference
+    `ray.data.read_sql`); returns a columnar block."""
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.execute(sql, params)
+        cols = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    if not rows:
+        return {c: np.array([]) for c in cols}
+    arrays = [np.array([r[i] for r in rows]) for i in range(len(cols))]
+    return dict(zip(cols, arrays))
+
+
+# ------------------------------------------------------------------- images #
+
+
+def read_image_file(path: str, size=None, mode: Optional[str] = None
+                    ) -> List[Dict[str, Any]]:
+    """Decode one image to a numpy row (reference `ray.data.read_images`)."""
+    from PIL import Image
+
+    with Image.open(path) as img:
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize(tuple(size))
+        arr = np.asarray(img)
+    return [{"image": arr, "path": path}]
+
+
 def make_range_block(start: int, stop: int) -> Dict[str, np.ndarray]:
     return {"id": np.arange(start, stop, dtype=np.int64)}
 

@@ -49,7 +49,14 @@ class RayActorError(RayTpuError):
 
     def __init__(self, actor_id=None, message: str = "The actor died unexpectedly."):
         self.actor_id = actor_id
+        self._message = message
         super().__init__(message)
+
+    def __reduce__(self):
+        # Default Exception pickling would pass args[0] (the message) as
+        # actor_id on rebuild, silently resetting the message to the
+        # default — keep both fields explicit.
+        return (type(self), (self.actor_id, self._message))
 
 
 class ActorDiedError(RayActorError):

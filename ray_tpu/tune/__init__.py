@@ -65,6 +65,8 @@ class TuneConfig:
     # Adaptive search algorithm (e.g. TPESearcher). When set, trials are
     # suggested sequentially as results arrive instead of expanded upfront.
     search_alg: Optional[Searcher] = None
+    # Restarts per trial after actor death (from the last checkpoint).
+    max_failures: int = 0
     seed: Optional[int] = None
 
 
@@ -174,6 +176,7 @@ class Tuner:
             metric=tc.metric, mode=tc.mode,
             searcher=tc.search_alg,
             num_samples=tc.num_samples if tc.search_alg is not None else None,
+            max_failures=tc.max_failures,
         )
         controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)

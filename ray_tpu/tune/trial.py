@@ -26,6 +26,7 @@ class Trial:
     error: Optional[str] = None
     checkpoint_path: Optional[str] = None
     num_results: int = 0
+    num_failures: int = 0   # actor-death restarts consumed
     start_time: float = 0.0
     runtime_s: float = 0.0
 
@@ -43,6 +44,7 @@ class Trial:
             "error": self.error,
             "checkpoint_path": self.checkpoint_path,
             "num_results": self.num_results,
+            "num_failures": self.num_failures,
         }
 
     @staticmethod
@@ -54,4 +56,5 @@ class Trial:
         t.error = state.get("error")
         t.checkpoint_path = state.get("checkpoint_path")
         t.num_results = state.get("num_results", 0)
+        t.num_failures = state.get("num_failures", 0)
         return t

@@ -100,7 +100,8 @@ class TuneController:
                  mode: str = "min",
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  searcher: Optional[Any] = None,
-                 num_samples: Optional[int] = None):
+                 num_samples: Optional[int] = None,
+                 max_failures: int = 0):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
@@ -112,6 +113,10 @@ class TuneController:
         self.searcher = searcher
         self.num_samples = num_samples or len(trials)
         self._created = len(trials)
+        # Trial fault tolerance: a trial whose ACTOR dies (node failure,
+        # OOM kill) restarts from its last checkpoint up to max_failures
+        # times (reference FailureConfig.max_failures).
+        self.max_failures = max_failures
         self.experiment_dir = experiment_dir
         self.resources_per_trial = resources_per_trial or {}
         if max_concurrent <= 0:
@@ -135,7 +140,10 @@ class TuneController:
                 or self._more_to_create():
             self._start_pending()
             if not self._inflight:
-                if any(t.status == TrialStatus.RUNNING for t in self.trials):
+                # PENDING covers a just-restarted trial whose relaunch the
+                # next pass will attempt — breaking here would strand it.
+                if any(t.status in (TrialStatus.RUNNING, TrialStatus.PENDING)
+                       for t in self.trials):
                     time.sleep(0.05)
                     continue
                 break
@@ -146,7 +154,7 @@ class TuneController:
                 try:
                     res = ray_tpu.get(ref)
                 except Exception as e:  # actor died
-                    self._fail_trial(trial, f"trial actor died: {e}")
+                    self._maybe_restart(trial, f"trial actor died: {e}")
                     continue
                 self._handle_result(trial, res)
             self.save()
@@ -172,7 +180,8 @@ class TuneController:
                 break
             pending.remove(trial)
             self._launch(trial)
-            running += 1
+            if trial.status == TrialStatus.RUNNING:
+                running += 1  # failed launches don't consume concurrency
 
     def _launch(self, trial: Trial):
         opts: Dict[str, Any] = {}
@@ -185,14 +194,33 @@ class TuneController:
             if res:
                 opts["resources"] = res
         actor_cls = ray_tpu.remote(_TrialActor)
-        actor = actor_cls.options(**opts).remote() if opts \
-            else actor_cls.remote()
-        ray_tpu.get(actor.run.remote(self.trainable, trial.config,
-                                     trial.checkpoint_path, trial.trial_id))
+        try:
+            actor = actor_cls.options(**opts).remote() if opts \
+                else actor_cls.remote()
+            self._actors[trial.trial_id] = actor
+            trial.start_time = time.time()
+            ray_tpu.get(actor.run.remote(self.trainable, trial.config,
+                                         trial.checkpoint_path,
+                                         trial.trial_id))
+        except Exception as e:  # noqa: BLE001 — a fast-dying trainable can
+            # take the actor down before run() even acknowledges; same
+            # restart budget as a mid-trial death.
+            self._maybe_restart(trial, f"trial failed during launch: {e}")
+            return
         trial.status = TrialStatus.RUNNING
-        trial.start_time = time.time()
-        self._actors[trial.trial_id] = actor
         self._inflight[actor.next_result.remote()] = trial
+
+    def _maybe_restart(self, trial: Trial, msg: str):
+        if trial.num_failures < self.max_failures:
+            trial.num_failures += 1
+            logger.warning(
+                "trial %s died (%s); restarting from %s (failure %d/%d)",
+                trial.trial_id, msg, trial.checkpoint_path,
+                trial.num_failures, self.max_failures)
+            self._cleanup_actor(trial, kill=True)
+            trial.status = TrialStatus.PENDING
+        else:
+            self._fail_trial(trial, msg)
 
     def _handle_result(self, trial: Trial, res: Dict[str, Any]):
         actor = self._actors.get(trial.trial_id)

@@ -128,6 +128,105 @@ def test_tuner_with_tpe_search(ray_start_shared, tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# New datasources: tfrecords, sql, images
+# --------------------------------------------------------------------------- #
+
+
+def test_tfrecords_roundtrip(ray_start_shared, tmp_path):
+    from ray_tpu import data
+    from ray_tpu.data.datasource import write_tfrecords
+
+    path = str(tmp_path / "recs.tfrecord")
+    payloads = [b"alpha", b"beta", bytes(range(256))]
+    write_tfrecords([{"data": p} for p in payloads], path)
+    rows = data.read_tfrecords(path).take_all()
+    assert [r["data"] for r in rows] == payloads
+    # CRC validation catches corruption.
+    blob = bytearray(open(path, "rb").read())
+    blob[14] ^= 0xFF  # flip a data byte
+    bad = str(tmp_path / "bad.tfrecord")
+    open(bad, "wb").write(bytes(blob))
+    from ray_tpu.data.datasource import read_tfrecord_file
+
+    with pytest.raises(ValueError):
+        read_tfrecord_file(bad)
+
+
+def test_read_sql(ray_start_shared, tmp_path):
+    import sqlite3
+
+    from ray_tpu import data
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(1, "a"), (2, "b"), (3, "c")])
+    conn.commit()
+    conn.close()
+    ds = data.read_sql("SELECT id, name FROM items ORDER BY id",
+                       lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert [int(r["id"]) for r in rows] == [1, 2, 3]
+    assert [str(r["name"]) for r in rows] == ["a", "b", "c"]
+
+
+def test_read_images(ray_start_shared, tmp_path):
+    from PIL import Image
+
+    from ray_tpu import data
+
+    for i in range(3):
+        Image.new("RGB", (16 + i, 16), (i * 10, 0, 0)).save(
+            str(tmp_path / f"img{i}.png"))
+    rows = data.read_images(str(tmp_path), size=(8, 8)).take_all()
+    assert len(rows) == 3
+    assert all(r["image"].shape == (8, 8, 3) for r in rows)
+
+
+# --------------------------------------------------------------------------- #
+# Tune trial fault tolerance
+# --------------------------------------------------------------------------- #
+
+
+def test_tune_trial_restarts_after_actor_death(ray_start_regular, tmp_path):
+    import os as _os
+
+    from ray_tpu import tune
+
+    marker = str(tmp_path / "died_once")
+
+    def trainable(config):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            start = int(open(_os.path.join(ckpt.path, "step")).read())
+        for step in range(start, 6):
+            d = str(tmp_path / f"ck{step}")
+            _os.makedirs(d, exist_ok=True)
+            open(_os.path.join(d, "step"), "w").write(str(step + 1))
+            tune.report({"step": step},
+                        checkpoint=Checkpoint.from_directory(d))
+            if step == 2 and not _os.path.exists(marker):
+                open(marker, "w").write("x")
+                _os.kill(_os.getpid(), 9)  # simulate node/OOM kill
+
+    tuner = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(metric="step", mode="max",
+                                    num_samples=1, max_failures=1),
+        run_config=tune.RunConfig(name="ft", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.error is None
+    assert best.metrics["step"] == 5  # finished after restart
+    assert _os.path.exists(marker)
+
+
+# --------------------------------------------------------------------------- #
 # cancel + runtime_env
 # --------------------------------------------------------------------------- #
 
