@@ -154,28 +154,89 @@ def _ensure_proxy(host: str, port: int) -> int:
     return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
 
 
+def _graph_order(root: Application) -> list:
+    """Applications of a composed graph, dependencies first (reference
+    deployment graphs: `Driver.bind(model_a.bind(), model_b.bind())`).
+    Nested Applications in init args become DeploymentHandles at deploy
+    time. Cycles and name collisions are errors."""
+    order: list = []
+    visiting: set = set()
+
+    def walk_value(value):
+        if isinstance(value, Application):
+            walk(value)
+        elif isinstance(value, (list, tuple)):
+            for v in value:
+                walk_value(v)
+        elif isinstance(value, dict):
+            for v in value.values():
+                walk_value(v)
+
+    def walk(app: Application):
+        if any(a is app for a in order):
+            return
+        if id(app) in visiting:
+            raise ValueError("deployment graph has a cycle at "
+                             f"{app.deployment.name!r}")
+        visiting.add(id(app))
+        # Mirror _sub_handles' traversal exactly: anything that will be
+        # substituted with a handle must also be deployed.
+        for arg in list(app.init_args) + list(app.init_kwargs.values()):
+            walk_value(arg)
+        visiting.discard(id(app))
+        order.append(app)
+
+    walk(root)
+    by_name: Dict[str, Application] = {}
+    for a in order:
+        other = by_name.setdefault(a.deployment.name, a)
+        if other is not a:
+            raise ValueError(
+                f"two different bindings share the deployment name "
+                f"{a.deployment.name!r}; use .options(name=...) to rename")
+    return order
+
+
+def _sub_handles(value):
+    if isinstance(value, Application):
+        return DeploymentHandle(value.deployment.name)
+    if isinstance(value, tuple) and hasattr(value, "_fields"):  # namedtuple
+        return type(value)(*(_sub_handles(v) for v in value))
+    if isinstance(value, (list, tuple)):
+        return type(value)(_sub_handles(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _sub_handles(v) for k, v in value.items()}
+    return value
+
+
 def run(app: Union[Application, Deployment], *, _blocking: bool = False,
         http: bool = False, http_host: str = "127.0.0.1",
         http_port: int = 8000, timeout_s: float = 60.0
         ) -> DeploymentHandle:
-    """Deploy and wait until at least the initial replicas are RUNNING."""
+    """Deploy an application — or a whole composed graph (bound
+    deployments passed as init args become live DeploymentHandles) — and
+    wait until the initial replicas are RUNNING, dependencies first."""
     import ray_tpu
 
     if isinstance(app, Deployment):
         app = app.bind()
-    dep = app.deployment
     controller = _get_or_create_controller()
-    ray_tpu.get(controller.deploy.remote(
-        dep.name, dep.user_callable, app.init_args, app.init_kwargs,
-        dep.config), timeout=timeout_s)
-    ok = ray_tpu.get(controller.wait_ready.remote(dep.name, timeout_s),
-                     timeout=timeout_s + 5.0)
-    if not ok:
-        raise TimeoutError(
-            f"deployment {dep.name!r} did not become ready in {timeout_s}s")
+    for a in _graph_order(app):
+        dep = a.deployment
+        ray_tpu.get(controller.deploy.remote(
+            dep.name, dep.user_callable,
+            _sub_handles(tuple(a.init_args)),
+            _sub_handles(dict(a.init_kwargs)),
+            dep.config), timeout=timeout_s)
+        ok = ray_tpu.get(controller.wait_ready.remote(dep.name, timeout_s),
+                         timeout=timeout_s + 5.0)
+        if not ok:
+            raise TimeoutError(
+                f"deployment {dep.name!r} did not become ready "
+                f"in {timeout_s}s")
     if http:
         _ensure_proxy(http_host, http_port)
-    return DeploymentHandle(dep.name)
+    return DeploymentHandle(app.deployment.name)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

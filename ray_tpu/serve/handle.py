@@ -66,5 +66,15 @@ class DeploymentHandle:
     def __reduce__(self):
         return DeploymentHandle, (self._deployment, self._method)
 
+    def __eq__(self, other):
+        # Value equality so an unchanged redeploy (same graph, fresh handle
+        # objects) doesn't read as a code change and drain replicas.
+        return (isinstance(other, DeploymentHandle)
+                and self._deployment == other._deployment
+                and self._method == other._method)
+
+    def __hash__(self):
+        return hash((self._deployment, self._method))
+
     def __repr__(self):
         return f"DeploymentHandle({self._deployment!r}, {self._method!r})"

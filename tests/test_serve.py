@@ -205,3 +205,51 @@ def test_gpt2_sampler_deployment_batches(serve_cluster):
     m = ray_tpu.get(handle.metrics.remote(None))
     assert m["batches_served"] >= 1
     assert m["mean_batch_size"] > 1.0, "batching never engaged"
+
+
+def test_deployment_graph_composition(serve_cluster):
+    """Bound deployments as init args deploy as a graph (children first)
+    and arrive as live DeploymentHandles (reference deployment graphs)."""
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Driver:
+        def __init__(self, doubler, adder):
+            self.doubler = doubler
+            self.adder = adder
+
+        def __call__(self, x):
+            d = ray_tpu.get(self.doubler.remote(x))
+            return ray_tpu.get(self.adder.remote(d))
+
+    handle = serve.run(Driver.bind(Doubler.bind(), Adder.bind(100)))
+    assert ray_tpu.get(handle.remote(7)) == 114
+    # Name collision across distinct bindings is rejected.
+    with pytest.raises(ValueError):
+        serve.run(Driver.options(name="D2").bind(
+            Adder.bind(1), Adder.bind(2)))
+    # Container-nested bindings (a LIST of bound models) deploy too.
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, models):
+            self.models = models
+
+        def __call__(self, x):
+            return sum(ray_tpu.get(m.remote(x)) for m in self.models)
+
+    ens = serve.run(Ensemble.bind([
+        Adder.options(name="AddA").bind(1),
+        Adder.options(name="AddB").bind(2)]))
+    assert ray_tpu.get(ens.remote(10)) == 23
