@@ -575,6 +575,13 @@ class CoreRuntime:
                     self._actor_clients.pop(spec.actor_id.binary(), None)
                     self._actor_states.pop(spec.actor_id.binary(), None)
                 time.sleep(0.1)
+            except Exception as e:  # noqa: BLE001 — actor terminally DEAD
+                # (or its creation failed). Submitting to a dead actor must
+                # not raise at the call site: the reference returns refs
+                # that resolve to the death error on get.
+                rec.error = serialization.serialize_exception(e)
+                rec.event.set()
+                return spec.return_ids()
         # Mark the pending record failed so gets on its refs raise.
         rec.error = serialization.serialize_exception(
             ActorDiedError(spec.actor_id, f"actor call failed: {last_err}"))

@@ -202,13 +202,15 @@ class TuneController:
             ray_tpu.get(actor.run.remote(self.trainable, trial.config,
                                          trial.checkpoint_path,
                                          trial.trial_id))
+            ref = actor.next_result.remote()
         except Exception as e:  # noqa: BLE001 — a fast-dying trainable can
-            # take the actor down before run() even acknowledges; same
-            # restart budget as a mid-trial death.
+            # take the actor down before run() even acknowledges (or between
+            # the ack and the first next_result submission); same restart
+            # budget as a mid-trial death.
             self._maybe_restart(trial, f"trial failed during launch: {e}")
             return
         trial.status = TrialStatus.RUNNING
-        self._inflight[actor.next_result.remote()] = trial
+        self._inflight[ref] = trial
 
     def _maybe_restart(self, trial: Trial, msg: str):
         if trial.num_failures < self.max_failures:
