@@ -579,13 +579,15 @@ class CoreRuntime:
                 # (or its creation failed). Submitting to a dead actor must
                 # not raise at the call site: the reference returns refs
                 # that resolve to the death error on get.
-                rec.error = serialization.serialize_exception(e)
-                rec.event.set()
+                self._unpin_deps(spec)
+                self._fail_task_record(
+                    rec, spec, serialization.serialize_exception(e))
                 return spec.return_ids()
-        # Mark the pending record failed so gets on its refs raise.
-        rec.error = serialization.serialize_exception(
-            ActorDiedError(spec.actor_id, f"actor call failed: {last_err}"))
-        rec.event.set()
+        # Mark the pending record failed so gets on its refs raise (and so
+        # remote dependents see the error instead of waiting forever).
+        self._unpin_deps(spec)
+        self._fail_task_record(rec, spec, serialization.serialize_exception(
+            ActorDiedError(spec.actor_id, f"actor call failed: {last_err}")))
         return spec.return_ids()
 
     def _on_actor_conn_lost(self, actor_id: ActorID):

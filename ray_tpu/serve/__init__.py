@@ -24,6 +24,7 @@ Typical flow:
 
 from __future__ import annotations
 
+import copy
 import logging
 import time
 from dataclasses import replace as _dc_replace
@@ -197,16 +198,55 @@ def _graph_order(root: Application) -> list:
     return order
 
 
+def _contains_app(value) -> bool:
+    if isinstance(value, Application):
+        return True
+    if isinstance(value, (list, tuple)):
+        return any(_contains_app(v) for v in value)
+    if isinstance(value, dict):
+        return any(_contains_app(v) for v in value.values())
+    return False
+
+
 def _sub_handles(value):
     if isinstance(value, Application):
         return DeploymentHandle(value.deployment.name)
+    if not _contains_app(value):
+        # Identity fast-path: containers without bindings pass through
+        # untouched (preserving dict/list subclasses and their state).
+        return value
     if isinstance(value, tuple) and hasattr(value, "_fields"):  # namedtuple
         return type(value)(*(_sub_handles(v) for v in value))
     if isinstance(value, (list, tuple)):
         return type(value)(_sub_handles(v) for v in value)
     if isinstance(value, dict):
-        return {k: _sub_handles(v) for k, v in value.items()}
+        subbed = {k: _sub_handles(v) for k, v in value.items()}
+        try:  # keep dict subclasses (defaultdict, OrderedDict, ...) intact
+            out = copy.copy(value)
+            out.clear()
+            out.update(subbed)
+            return out
+        except Exception:  # noqa: BLE001 — exotic mapping; plain dict is fine
+            return subbed
     return value
+
+
+def _check_no_stray_apps(value, owner: str):
+    """Applications hiding in containers the graph traversal does not
+    descend into (sets, frozensets, arbitrary object attributes) would be
+    pickled as inert data — fail loudly at deploy time instead."""
+    if isinstance(value, Application):
+        raise ValueError(
+            f"un-substituted bound deployment in init args of {owner!r}: "
+            "nested Applications are only resolved inside lists, tuples and "
+            "dict values")
+    if isinstance(value, (list, tuple, set, frozenset)):
+        for v in value:
+            _check_no_stray_apps(v, owner)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _check_no_stray_apps(k, owner)  # bindings as KEYS escape
+            _check_no_stray_apps(v, owner)  # the substitution traversals
 
 
 def run(app: Union[Application, Deployment], *, _blocking: bool = False,
@@ -223,10 +263,12 @@ def run(app: Union[Application, Deployment], *, _blocking: bool = False,
     controller = _get_or_create_controller()
     for a in _graph_order(app):
         dep = a.deployment
+        sub_args = _sub_handles(tuple(a.init_args))
+        sub_kwargs = _sub_handles(dict(a.init_kwargs))
+        _check_no_stray_apps(sub_args, dep.name)
+        _check_no_stray_apps(sub_kwargs, dep.name)
         ray_tpu.get(controller.deploy.remote(
-            dep.name, dep.user_callable,
-            _sub_handles(tuple(a.init_args)),
-            _sub_handles(dict(a.init_kwargs)),
+            dep.name, dep.user_callable, sub_args, sub_kwargs,
             dep.config), timeout=timeout_s)
         ok = ray_tpu.get(controller.wait_ready.remote(dep.name, timeout_s),
                          timeout=timeout_s + 5.0)

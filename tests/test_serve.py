@@ -253,3 +253,40 @@ def test_deployment_graph_composition(serve_cluster):
         Adder.options(name="AddA").bind(1),
         Adder.options(name="AddB").bind(2)]))
     assert ray_tpu.get(ens.remote(10)) == 23
+
+
+def test_graph_init_args_pass_through_untouched(serve_cluster):
+    """Init args with no nested bindings keep their exact types (dict
+    subclasses included); bindings hidden in sets fail loudly at deploy
+    time instead of reaching the replica as inert pickled data."""
+    import collections
+
+    @serve.deployment
+    class KeepsDefaultDict:
+        def __init__(self, counts):
+            self.counts = counts
+
+        def __call__(self, key):
+            self.counts[key].append(1)
+            return len(self.counts[key])
+
+    dd = collections.defaultdict(list)
+    h = serve.run(KeepsDefaultDict.bind(dd))
+    assert ray_tpu.get(h.remote("a")) == 1
+    assert ray_tpu.get(h.remote("a")) == 2  # default_factory survived
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, n):
+            self.n = n
+
+        def __call__(self, x):
+            return x + self.n
+
+    @serve.deployment
+    class SetEnsemble:
+        def __init__(self, models):
+            self.models = models
+
+    with pytest.raises(ValueError, match="un-substituted"):
+        serve.run(SetEnsemble.bind({Adder.bind(1), Adder.bind(2)}))
