@@ -610,8 +610,8 @@ class CoreRuntime:
                            f"The actor {actor_id.hex()[:12]} died while this "
                            "task was in flight."))
         for rec in pending:
-            rec.error = err
-            rec.event.set()
+            self._unpin_deps(rec.spec)
+            self._fail_task_record(rec, rec.spec, err)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})

@@ -1,6 +1,9 @@
 """Model zoo: flax models with logical-axis sharding annotations."""
 
 from ray_tpu.models.gpt2 import GPT2, GPT2Config
+from ray_tpu.models.llama import Llama, LlamaConfig
 from ray_tpu.models.mlp import MLP
+from ray_tpu.models.moe import MoE, MoEConfig
 
-__all__ = ["GPT2", "GPT2Config", "MLP"]
+__all__ = ["GPT2", "GPT2Config", "Llama", "LlamaConfig", "MLP",
+           "MoE", "MoEConfig"]

@@ -208,12 +208,16 @@ def next_token_loss(logits, targets, ignore_index: int = -100):
 
 
 def make_train_step(model: nn.Module, optimizer, mesh=None,
-                    donate: bool = True):
+                    donate: bool = True, loss_fn=None):
     """Jitted (params, opt_state, batch) -> (params, opt_state, loss).
 
     With a mesh: logical axis rules resolve the with_logical_constraint
     annotations; data enters sharded ("batch" over dp+fsdp, "seq" over sp);
     XLA places the psums over tp/sp on ICI.
+
+    `loss_fn(params, batch) -> (objective, displayed_loss)` customizes the
+    training objective (MoE adds router losses to the cross-entropy); the
+    default is next-token cross-entropy for both.
     """
     from flax.linen import logical_axis_rules as flax_rules
 
@@ -222,17 +226,20 @@ def make_train_step(model: nn.Module, optimizer, mesh=None,
     rules = logical_axis_rules(
         mesh_axes=mesh.axis_names if mesh is not None else None)
 
-    def step(params, opt_state, batch):
-        def loss_fn(p):
+    if loss_fn is None:
+        def loss_fn(p, batch):
             logits = model.apply(p, batch["input_ids"])
-            return next_token_loss(logits, batch["labels"])
+            ce = next_token_loss(logits, batch["labels"])
+            return ce, ce
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+    def step(params, opt_state, batch):
+        (_, shown), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch), has_aux=True)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         import optax
 
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, shown
 
     def step_with_rules(params, opt_state, batch):
         with flax_rules(rules):
