@@ -159,6 +159,11 @@ class CoreRuntime:
                 rec = self._tasks.get(task_id.binary())
             if rec is None:
                 return
+            if rec.event.is_set():
+                # Already terminally resolved (e.g. failed by the actor-death
+                # path): a late raylet notification must not unpin deps a
+                # second time or resubmit the failed task.
+                return
             if data.get("crashed") and rec.spec is not None and \
                     rec.attempts < rec.spec.max_retries:
                 rec.attempts += 1

@@ -72,7 +72,10 @@ class MoEConfig:
 
 
 def expert_capacity(cfg: MoEConfig, n_tokens: int) -> int:
-    cap = int(n_tokens * cfg.top_k * cfg.capacity_factor / cfg.n_experts)
+    import math
+
+    cap = math.ceil(n_tokens * cfg.top_k * cfg.capacity_factor
+                    / cfg.n_experts)
     return max(cap, cfg.top_k)
 
 

@@ -9,6 +9,7 @@ data parallelism (the "How to Scale Your Model" recipe).
 Axis conventions (used by models/, train/, rllib/):
   dp    data parallel (pure replication of params, sharded batch)
   fsdp  fully-sharded data parallel (params sharded over this axis too)
+  pp    pipeline parallel (layer stages; GPipe microbatch schedule)
   tp    tensor/model parallel (matmul contraction sharding)
   sp    sequence/context parallel (ring attention shards over this)
   ep    expert parallel (MoE experts)
@@ -20,7 +21,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp", "ep")
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp", "ep")
 
 
 @dataclass

@@ -21,6 +21,7 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("kv", None),
     ("vocab", "tp"),
     ("expert", "ep"),
+    ("stage", "pp"),
     ("norm", None),
 )
 
