@@ -36,7 +36,15 @@ class RayTaskError(RayTpuError):
         name = f"RayTaskError({cause_cls.__name__})"
         cls = type(name, (RayTaskError, cause_cls), {})
         err = cls.__new__(cls)
-        RayTaskError.__init__(err, self.function_name, self.traceback_str, self.cause)
+        # Initialize fields directly: RayTaskError.__init__'s super() call
+        # would resolve through the dual class's MRO into the CAUSE's
+        # __init__ (e.g. RayActorError swallowing the message as actor_id),
+        # replacing the remote traceback with the cause's default text.
+        err.function_name = self.function_name
+        err.traceback_str = self.traceback_str
+        err.cause = self.cause
+        Exception.__init__(
+            err, f"Task {self.function_name} failed:\n{self.traceback_str}")
         return err
 
 

@@ -27,7 +27,19 @@ from ray_tpu.rllib.impala import (
     IMPALALearner,
     vtrace_returns,
 )
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    iter_learner_batches,
+    read_batches,
+    write_batches,
+)
+from ray_tpu.rllib.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.rl_module import (
     ConvPolicyModule,
@@ -48,4 +60,8 @@ __all__ = [
     "Learner", "LearnerGroup", "RolloutWorker", "WorkerSet",
     "PPO", "PPOConfig", "PPOLearner",
     "IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace_returns",
+    "DQN", "DQNConfig", "DQNLearner", "QModule",
+    "ReplayBuffer", "PrioritizedReplayBuffer",
+    "BC", "BCConfig", "write_batches", "read_batches",
+    "iter_learner_batches",
 ]

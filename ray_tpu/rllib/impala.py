@@ -296,8 +296,17 @@ class IMPALA:
                         "failures)")
                 return 0
         refs = list(self._inflight.keys())
-        ready, _ = self._ray.wait(
+        ready, rest = self._ray.wait(
             refs, num_returns=1, timeout=None if block else 0.0)
+        if rest:
+            # Drain everything else already finished too — in particular
+            # error-resolved refs from a dead worker (submission to a dead
+            # actor returns errored refs rather than raising), so the
+            # replacement path always runs even when a live worker's
+            # fragment came back first.
+            more, _ = self._ray.wait(rest, num_returns=len(rest),
+                                     timeout=0.0)
+            ready = list(ready) + list(more)
         got = 0
         for ref in ready:
             idx = self._inflight.pop(ref, None)

@@ -159,6 +159,15 @@ class LearnerGroup:
 
         return ray_tpu.get(self._actor.update_many.remote(stacked))
 
+    def call(self, method: str, *args, **kwargs):
+        """Dispatch an algorithm-specific learner method (DQN's update_dqn,
+        sync_target, ...) through whichever mode this group runs in."""
+        if self._learner is not None:
+            return getattr(self._learner, method)(*args, **kwargs)
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.call.remote(method, *args, **kwargs))
+
     def get_weights(self):
         if self._learner is not None:
             return self._learner.get_weights()
@@ -197,6 +206,11 @@ class _LearnerActor:
 
     def ping(self):
         return True
+
+    def call(self, method: str, *args, **kwargs):
+        """Algorithm-specific learner methods (e.g. DQN's update_dqn /
+        sync_target) without a dedicated RPC per method."""
+        return getattr(self._learner, method)(*args, **kwargs)
 
     def update(self, batch):
         return self._learner.update(batch)

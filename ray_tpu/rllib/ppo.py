@@ -190,7 +190,10 @@ class PPO:
                      ) -> Dict[str, np.ndarray]:
         cfg = self.config
         T, n = batch.pop("_shape")
-        batch.pop("_last_obs", None)  # IMPALA-only bootstrap obs
+        batch.pop("_last_obs", None)       # IMPALA-only bootstrap obs
+        batch.pop("_final_obs", None)      # DQN-only truncation bootstrap
+        batch.pop("_final_obs_at", None)   # (optional keys would break
+        #                                    concat_batches' key union)
         rewards = batch[sb.REWARDS].reshape(T, n)
         values = batch[sb.VF_PREDS].reshape(T, n)
         dones = batch[sb.DONES].reshape(T, n)

@@ -149,6 +149,14 @@ class RolloutWorker:
             "_next_vf": next_vf_buf.reshape(-1),
             "_shape": np.array([num_steps, n]),
         }
+        if final_obs_fixups:
+            # True final observations for done rows (flat [T*n] indices):
+            # off-policy learners bootstrap truncated episodes from the
+            # real final state instead of the auto-reset observation.
+            batch["_final_obs_at"] = np.concatenate(
+                [t * n + rows for t, rows, _ in final_obs_fixups])
+            batch["_final_obs"] = np.concatenate(
+                [fo for _, _, fo in final_obs_fixups])
         return batch
 
     def episode_stats(self, clear: bool = True) -> Dict[str, Any]:
@@ -172,18 +180,19 @@ class WorkerSet:
                  hidden=(64, 64), seed: int = 0,
                  num_cpus_per_worker: float = 0.5,
                  jax_platform: Optional[str] = None,
-                 connectors: Any = None):
+                 connectors: Any = None, module: Optional[Any] = None):
         import ray_tpu
 
         self._ctor = dict(env=env, n_envs=n_envs, hidden=tuple(hidden),
                           jax_platform=jax_platform, seed=seed,
                           num_cpus=num_cpus_per_worker,
-                          connectors=connectors)
+                          connectors=connectors, module=module)
         actor_cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
             actor_cls.options(num_cpus=num_cpus_per_worker).remote(
                 env, n_envs=n_envs, seed=seed + i, hidden=tuple(hidden),
-                jax_platform=jax_platform, connectors=connectors)
+                jax_platform=jax_platform, connectors=connectors,
+                module=module)
             for i in range(num_workers)]
         self.num_workers = num_workers
 
@@ -202,14 +211,21 @@ class WorkerSet:
             num_cpus=c["num_cpus"]).remote(
             c["env"], n_envs=c["n_envs"], seed=c["seed"] + idx,
             hidden=c["hidden"], jax_platform=c["jax_platform"],
-            connectors=c["connectors"])
+            connectors=c["connectors"], module=c["module"])
         return self.workers[idx]
 
     def sync_weights(self, weights: Any):
         import ray_tpu
 
         ref = ray_tpu.put(weights)
-        ray_tpu.get([w.set_weights.remote(ref) for w in self.workers])
+        refs = [w.set_weights.remote(ref) for w in self.workers]  # fan out
+        for r in refs:
+            try:
+                ray_tpu.get(r)
+            except Exception:  # noqa: BLE001 — dead worker: the algorithm's
+                # fault path replaces it with fresh weights; don't let a
+                # broadcast die over it.
+                logger.warning("sync_weights: a rollout worker is dead")
 
     def sample(self, steps_per_worker: int) -> List[Dict[str, np.ndarray]]:
         import ray_tpu
@@ -220,7 +236,15 @@ class WorkerSet:
     def episode_stats(self) -> List[Dict[str, Any]]:
         import ray_tpu
 
-        return ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        refs = [w.episode_stats.remote() for w in self.workers]  # fan out
+        out = []
+        for r in refs:
+            try:
+                out.append(ray_tpu.get(r))
+            except Exception:  # noqa: BLE001 — dead worker: stats are
+                # advisory; its replacement reports next iteration.
+                pass
+        return out
 
     def env_spec(self) -> Dict[str, int]:
         import ray_tpu
