@@ -327,6 +327,73 @@ class Dataset:
     def num_blocks(self) -> int:
         return len(self._work)
 
+    # ------------------------------------------------------------ column ops
+
+    def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
+        """Add a column computed per row (reference Dataset.add_column)."""
+        return self.map(lambda r: {**r, name: fn(r)})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map(
+            lambda r: {k: v for k, v in r.items() if k not in drop})
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        keep = list(cols)
+        return self.map(lambda r: {k: r[k] for k in keep})
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column: per-block sets union'd on the
+        driver (map-side dedup keeps the transfer small)."""
+        def transform(block):
+            seen = {row[column] for row in BlockAccessor(block).rows()}
+            return [{"u": v} for v in seen]
+
+        out = set()
+        for b in self._derive(transform)._iter_block_values():
+            for row in BlockAccessor(b).rows():
+                out.add(row["u"])
+        try:
+            return sorted(out)
+        except TypeError:  # mixed/unorderable values
+            return list(out)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Positional zip (reference Dataset.zip): rows pair up in order;
+        dict rows merge (collisions suffixed _1), others become tuples."""
+        left = list(self._iter_block_values())
+        right_rows = []
+        for b in other._iter_block_values():
+            right_rows.extend(BlockAccessor(b).rows())
+        blocks: List[Block] = []
+        pos = 0
+        for b in left:
+            rows = list(BlockAccessor(b).rows())
+            merged = []
+            for r in rows:
+                if pos >= len(right_rows):
+                    raise ValueError("zip: datasets have different lengths")
+                o = right_rows[pos]
+                pos += 1
+                if isinstance(r, dict) and isinstance(o, dict):
+                    m = dict(r)
+                    for k, v in o.items():
+                        m[f"{k}_1" if k in m else k] = v
+                    merged.append(m)
+                else:
+                    merged.append((r, o))
+            blocks.append(merged)
+        if pos != len(right_rows):
+            raise ValueError("zip: datasets have different lengths")
+        return Dataset([(None, (b,)) for b in blocks])
+
+    # --------------------------------------------------------------- groupby
+
+    def groupby(self, key: Union[str, Callable[[Any], Any]]) -> "GroupedData":
+        """Group rows by a column name or key function (reference
+        Dataset.groupby -> GroupedData)."""
+        return GroupedData(self, key)
+
     def sum(self, on: Optional[str] = None):
         return self._agg(np.sum, on)
 
@@ -540,3 +607,113 @@ class _DeferredDataset(Dataset):
     def num_blocks(self) -> int:
         self._resolve()
         return len(self._work)
+
+
+class GroupedData:
+    """Result of `Dataset.groupby`: distributed map-side partial aggregates
+    merged on the driver (reference `GroupedData` / `AggregateFn` — the
+    shuffle-free path, which is exact for the algebraic aggregations here).
+    Aggregations return a Dataset of `{key, <agg>}` rows sorted by key;
+    `map_groups` applies a function to each group's rows in parallel tasks.
+    """
+
+    def __init__(self, ds: Dataset, key: Union[str, Callable[[Any], Any]]):
+        self._ds = ds
+        self._key = key
+
+    def _key_fn(self) -> Callable[[Any], Any]:
+        k = self._key
+        if callable(k):
+            return k
+        return lambda row: row[k]
+
+    def _key_name(self) -> str:
+        return self._key if isinstance(self._key, str) else "key"
+
+    def _merged_partials(self, on: Optional[str]) -> Dict[Any, Dict[str, Any]]:
+        keyf = self._key_fn()
+
+        def transform(block):
+            acc: Dict[Any, Dict[str, Any]] = {}
+            for row in BlockAccessor(block).rows():
+                kv = keyf(row)
+                v = row[on] if on is not None else None
+                slot = acc.get(kv)
+                if slot is None:
+                    acc[kv] = {"k": kv, "count": 1, "sum": v,
+                               "min": v, "max": v}
+                else:
+                    slot["count"] += 1
+                    if v is not None:
+                        slot["sum"] = slot["sum"] + v
+                        slot["min"] = min(slot["min"], v)
+                        slot["max"] = max(slot["max"], v)
+            return list(acc.values())
+
+        merged: Dict[Any, Dict[str, Any]] = {}
+        for b in self._ds._derive(transform)._iter_block_values():
+            for part in BlockAccessor(b).rows():
+                slot = merged.get(part["k"])
+                if slot is None:
+                    merged[part["k"]] = dict(part)
+                else:
+                    slot["count"] += part["count"]
+                    if part["sum"] is not None:
+                        slot["sum"] = slot["sum"] + part["sum"]
+                        slot["min"] = min(slot["min"], part["min"])
+                        slot["max"] = max(slot["max"], part["max"])
+        return merged
+
+    def _result(self, rows: List[Dict[str, Any]]) -> Dataset:
+        try:
+            rows.sort(key=lambda r: r[self._key_name()])
+        except TypeError:
+            pass
+        return Dataset([(None, (rows,))])
+
+    def count(self) -> Dataset:
+        kn = self._key_name()
+        merged = self._merged_partials(None)
+        return self._result(
+            [{kn: m["k"], "count()": m["count"]} for m in merged.values()])
+
+    def sum(self, on: str) -> Dataset:
+        kn = self._key_name()
+        merged = self._merged_partials(on)
+        return self._result(
+            [{kn: m["k"], f"sum({on})": m["sum"]} for m in merged.values()])
+
+    def mean(self, on: str) -> Dataset:
+        kn = self._key_name()
+        merged = self._merged_partials(on)
+        return self._result(
+            [{kn: m["k"], f"mean({on})": m["sum"] / m["count"]}
+             for m in merged.values()])
+
+    def min(self, on: str) -> Dataset:
+        kn = self._key_name()
+        merged = self._merged_partials(on)
+        return self._result(
+            [{kn: m["k"], f"min({on})": m["min"]} for m in merged.values()])
+
+    def max(self, on: str) -> Dataset:
+        kn = self._key_name()
+        merged = self._merged_partials(on)
+        return self._result(
+            [{kn: m["k"], f"max({on})": m["max"]} for m in merged.values()])
+
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
+        """Apply `fn` to each group's full row list; one task per group.
+        fn returns a row or a list of rows."""
+        keyf = self._key_fn()
+        groups: Dict[Any, List[Any]] = {}
+        for b in self._ds._iter_block_values():
+            for row in BlockAccessor(b).rows():
+                groups.setdefault(keyf(row), []).append(row)
+        ds = Dataset([(None, (rows,)) for rows in groups.values()])
+
+        def transform(block):
+            out = fn(list(BlockAccessor(block).rows()))
+            return out if isinstance(out, list) else [out]
+
+        return ds._derive(transform)

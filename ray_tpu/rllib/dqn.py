@@ -47,16 +47,10 @@ class QModule(RLModule):
         self.spec = spec
         self.hidden = tuple(hidden)
         if len(spec.shape()) >= 2:
-            # Auto-size the conv stack like ConvPolicyModule: nature-DQN
-            # filters need >= 40 px; small frames get a shallower stack.
-            if spec.shape()[0] >= 40:
-                conv = dict(channels=(32, 64, 64), kernels=(8, 4, 3),
-                            strides=(4, 2, 1))
-            else:
-                conv = dict(channels=(16, 32), kernels=(4, 3),
-                            strides=(2, 1))
-            self.model = _ConvPolicyValueNet(n_actions=spec.n_actions,
-                                             **conv)
+            from ray_tpu.rllib.rl_module import conv_spec_for
+
+            self.model = _ConvPolicyValueNet(
+                n_actions=spec.n_actions, **conv_spec_for(spec.shape()[0]))
         else:
             self.model = _PolicyValueNet(hidden=self.hidden,
                                          n_actions=spec.n_actions)
@@ -190,13 +184,12 @@ class DQNLearner(Learner):
             td = q_taken - jax.lax.stop_gradient(targets)
             weights = batch.get("weights", jnp.ones_like(td))
             loss = jnp.mean(weights * optax.huber_loss(td, delta=1.0))
-            return loss, td
+            return loss, (td, jnp.mean(q))
 
-        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (loss, (td, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        q_mean = jnp.mean(self.module.q_values(params["net"],
-                                               batch[sb.OBS]))
         metrics = {"td_loss": loss, "q_mean": q_mean,
                    "grad_norm": optax.global_norm(grads)}
         return params, opt_state, metrics, jnp.abs(td)

@@ -76,19 +76,13 @@ def write_batches(path: str, batches: List[Dict[str, np.ndarray]],
 
 def read_batches(path: str, format: str = "json"):
     """Load an experience dataset written by `write_batches` as a
-    `ray_tpu.data.Dataset` of rows (compose transforms freely)."""
-    import glob as _glob
-
+    `ray_tpu.data.Dataset` of rows (compose transforms freely). Directory
+    expansion is the standard read_* path expansion."""
     import ray_tpu.data as rdata
 
-    if os.path.isdir(path):
-        ext = "parquet" if format == "parquet" else "json"
-        paths = sorted(_glob.glob(os.path.join(path, f"*.{ext}")))
-    else:
-        paths = [path]
     if format == "parquet":
-        return rdata.read_parquet(paths)
-    return rdata.read_json(paths)
+        return rdata.read_parquet(path)
+    return rdata.read_json(path)
 
 
 def iter_learner_batches(ds, batch_size: int = 256,

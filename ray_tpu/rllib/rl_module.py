@@ -89,6 +89,16 @@ class _ConvPolicyValueNet(nn.Module):
         return logits, value[..., 0]
 
 
+def conv_spec_for(height: int) -> Dict[str, Any]:
+    """Conv-stack sizing shared by every vision module (PPO's
+    ConvPolicyModule, DQN's QModule): nature-DQN filters need >= 40 px
+    frames; smaller synthetic envs get a shallower stack."""
+    if height >= 40:
+        return dict(channels=(32, 64, 64), kernels=(8, 4, 3),
+                    strides=(4, 2, 1))
+    return dict(channels=(16, 32), kernels=(4, 3), strides=(2, 1))
+
+
 class RLModule:
     """Base class; subclasses define the flax model + forward semantics."""
 
@@ -175,14 +185,9 @@ class ConvPolicyModule(DiscretePolicyModule):
                 f"ConvPolicyModule needs [H, W] or [H, W, C] observations, "
                 f"got shape {spec.shape()} — a color env plus FrameStack "
                 f"yields rank 4; add GrayscaleResize before the stack")
-        h = spec.shape()[0]
-        if h >= 40:
-            conv = dict(channels=(32, 64, 64), kernels=(8, 4, 3),
-                        strides=(4, 2, 1))
-        else:
-            conv = dict(channels=(16, 32), kernels=(4, 3), strides=(2, 1))
         self.model = _ConvPolicyValueNet(n_actions=spec.n_actions,
-                                         dense=dense, **conv)
+                                         dense=dense,
+                                         **conv_spec_for(spec.shape()[0]))
         self._sample = jax.jit(self._sample_impl)
         self._greedy = jax.jit(self._greedy_impl)
 

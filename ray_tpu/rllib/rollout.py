@@ -222,16 +222,28 @@ class WorkerSet:
         for r in refs:
             try:
                 ray_tpu.get(r)
-            except Exception:  # noqa: BLE001 — dead worker: the algorithm's
-                # fault path replaces it with fresh weights; don't let a
-                # broadcast die over it.
+            except Exception:  # noqa: BLE001 — dead worker: the next
+                # sample() replaces it and the following broadcast re-syncs
+                # its weights; don't die mid-broadcast.
                 logger.warning("sync_weights: a rollout worker is dead")
 
     def sample(self, steps_per_worker: int) -> List[Dict[str, np.ndarray]]:
+        """Fan out one sample task per worker. A dead worker is replaced in
+        place and its fragment re-collected from the replacement (reference
+        FaultTolerantActorManager) — PPO/DQN iterations survive worker loss
+        without their own fault logic."""
         import ray_tpu
 
-        return ray_tpu.get([w.sample.remote(steps_per_worker)
-                            for w in self.workers])
+        refs = [w.sample.remote(steps_per_worker) for w in self.workers]
+        out = []
+        for i, r in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(r))
+            except Exception:  # noqa: BLE001 — dead worker
+                logger.warning("sample: restarting dead rollout worker %d", i)
+                w = self.restart_worker(i)
+                out.append(ray_tpu.get(w.sample.remote(steps_per_worker)))
+        return out
 
     def episode_stats(self) -> List[Dict[str, Any]]:
         import ray_tpu

@@ -178,3 +178,60 @@ def test_dataset_feeds_trainer_shards(ray_start_shared, tmp_path):
     ).fit()
     assert result.error is None, result.error
     assert ray_tpu.get(tally.get.remote()) == 64
+
+
+# --------------------------------------------------------------------------- #
+# groupby / zip / column ops
+# --------------------------------------------------------------------------- #
+
+
+def test_groupby_aggregations(ray_start_shared):
+    ds = rd.from_items([{"g": i % 3, "v": float(i)} for i in range(30)])
+    counts = {r["g"]: r["count()"] for r in ds.groupby("g").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["g"]: r["sum(v)"] for r in ds.groupby("g").sum("v").take_all()}
+    assert sums[0] == sum(float(i) for i in range(0, 30, 3))
+    means = {r["g"]: r["mean(v)"]
+             for r in ds.groupby("g").mean("v").take_all()}
+    assert abs(means[1] - np.mean([i for i in range(30) if i % 3 == 1])) < 1e-9
+    mins = {r["g"]: r["min(v)"] for r in ds.groupby("g").min("v").take_all()}
+    maxs = {r["g"]: r["max(v)"] for r in ds.groupby("g").max("v").take_all()}
+    assert mins == {0: 0.0, 1: 1.0, 2: 2.0}
+    assert maxs == {0: 27.0, 1: 28.0, 2: 29.0}
+    # Results arrive sorted by key.
+    assert [r["g"] for r in ds.groupby("g").count().take_all()] == [0, 1, 2]
+
+
+def test_groupby_key_function_and_map_groups(ray_start_shared):
+    ds = rd.from_items(list(range(20)))
+    grouped = ds.groupby(lambda x: x % 2)
+    out = grouped.map_groups(lambda rows: {"parity": rows[0] % 2,
+                                           "total": sum(rows)})
+    rows = sorted(out.take_all(), key=lambda r: r["parity"])
+    assert rows == [{"parity": 0, "total": sum(range(0, 20, 2))},
+                    {"parity": 1, "total": sum(range(1, 20, 2))}]
+
+
+def test_zip_merges_rows(ray_start_shared):
+    a = rd.from_items([{"x": i} for i in range(5)])
+    b = rd.from_items([{"y": 10 * i} for i in range(5)])
+    rows = a.zip(b).take_all()
+    assert rows[3] == {"x": 3, "y": 30}
+    # Collisions get the _1 suffix.
+    c = rd.from_items([{"x": -i} for i in range(5)])
+    rows = a.zip(c).take_all()
+    assert rows[2] == {"x": 2, "x_1": -2}
+    # Scalar rows pair into tuples; length mismatch is an error.
+    assert rd.from_items([1, 2]).zip(rd.from_items([3, 4])).take_all() \
+        == [(1, 3), (2, 4)]
+    with pytest.raises(ValueError):
+        rd.from_items([1, 2, 3]).zip(rd.from_items([1])).take_all()
+
+
+def test_column_ops_and_unique(ray_start_shared):
+    ds = rd.from_items([{"a": i, "b": i % 4} for i in range(12)])
+    with_c = ds.add_column("c", lambda r: r["a"] * 2)
+    assert with_c.take(1)[0] == {"a": 0, "b": 0, "c": 0}
+    assert with_c.drop_columns(["a", "b"]).take(1) == [{"c": 0}]
+    assert with_c.select_columns(["b"]).take(2) == [{"b": 0}, {"b": 1}]
+    assert ds.unique("b") == [0, 1, 2, 3]

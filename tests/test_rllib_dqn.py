@@ -239,3 +239,19 @@ def test_dqn_transitions_bootstrap_truncation():
     # Fragment tail without done: bootstraps from _last_obs (t=2,row0
     # flattens to index 4; _last_obs row 0 is 100.0).
     assert out["next_obs"][4, 0] == 100.0
+
+
+def test_workerset_sample_replaces_dead_worker(ray_start_shared):
+    """WorkerSet.sample survives a dead worker by replacing it in place —
+    the fault tolerance PPO/DQN rely on."""
+    from ray_tpu.rllib.rollout import WorkerSet
+
+    ws = WorkerSet("CartPole-v1", num_workers=2, n_envs=2)
+    try:
+        ws.sample(4)
+        ray_tpu.kill(ws.workers[0])
+        frags = ws.sample(8)
+        assert len(frags) == 2
+        assert all(f["obs"].shape == (16, 4) for f in frags)
+    finally:
+        ws.shutdown()
