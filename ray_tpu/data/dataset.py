@@ -360,32 +360,37 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Positional zip (reference Dataset.zip): rows pair up in order;
-        dict rows merge (collisions suffixed _1), others become tuples."""
-        left = list(self._iter_block_values())
-        right_rows = []
-        for b in other._iter_block_values():
-            right_rows.extend(BlockAccessor(b).rows())
-        blocks: List[Block] = []
-        pos = 0
-        for b in left:
-            rows = list(BlockAccessor(b).rows())
-            merged = []
-            for r in rows:
-                if pos >= len(right_rows):
-                    raise ValueError("zip: datasets have different lengths")
-                o = right_rows[pos]
-                pos += 1
-                if isinstance(r, dict) and isinstance(o, dict):
-                    m = dict(r)
-                    for k, v in o.items():
-                        m[f"{k}_1" if k in m else k] = v
-                    merged.append(m)
-                else:
-                    merged.append((r, o))
-            blocks.append(merged)
-        if pos != len(right_rows):
-            raise ValueError("zip: datasets have different lengths")
-        return Dataset([(None, (b,)) for b in blocks])
+        dict rows merge (collisions suffixed _1), others become tuples.
+        All-to-all barrier — deferred until consumed, like repartition."""
+        parent, rhs = self, other
+
+        def work() -> List[WorkItem]:
+            right_rows = []
+            for b in rhs._iter_block_values():
+                right_rows.extend(BlockAccessor(b).rows())
+            blocks: List[Block] = []
+            pos = 0
+            for b in parent._iter_block_values():
+                merged = []
+                for r in BlockAccessor(b).rows():
+                    if pos >= len(right_rows):
+                        raise ValueError(
+                            "zip: datasets have different lengths")
+                    o = right_rows[pos]
+                    pos += 1
+                    if isinstance(r, dict) and isinstance(o, dict):
+                        m = dict(r)
+                        for k, v in o.items():
+                            m[f"{k}_1" if k in m else k] = v
+                        merged.append(m)
+                    else:
+                        merged.append((r, o))
+                blocks.append(merged)
+            if pos != len(right_rows):
+                raise ValueError("zip: datasets have different lengths")
+            return [(None, (b,)) for b in blocks]
+
+        return _DeferredDataset(work)
 
     # --------------------------------------------------------------- groupby
 
@@ -640,11 +645,14 @@ class GroupedData:
                 v = row[on] if on is not None else None
                 slot = acc.get(kv)
                 if slot is None:
-                    acc[kv] = {"k": kv, "count": 1, "sum": v,
-                               "min": v, "max": v}
-                else:
-                    slot["count"] += 1
-                    if v is not None:
+                    slot = acc[kv] = {"k": kv, "count": 0, "vcount": 0,
+                                      "sum": None, "min": None, "max": None}
+                slot["count"] += 1
+                if v is not None:  # None = missing (reference ignore_nulls)
+                    slot["vcount"] += 1
+                    if slot["sum"] is None:
+                        slot["sum"], slot["min"], slot["max"] = v, v, v
+                    else:
                         slot["sum"] = slot["sum"] + v
                         slot["min"] = min(slot["min"], v)
                         slot["max"] = max(slot["max"], v)
@@ -656,12 +664,18 @@ class GroupedData:
                 slot = merged.get(part["k"])
                 if slot is None:
                     merged[part["k"]] = dict(part)
+                elif part["sum"] is None:
+                    slot["count"] += part["count"]
+                elif slot["sum"] is None:
+                    count = slot["count"]
+                    slot.update(part)
+                    slot["count"] = count + part["count"]
                 else:
                     slot["count"] += part["count"]
-                    if part["sum"] is not None:
-                        slot["sum"] = slot["sum"] + part["sum"]
-                        slot["min"] = min(slot["min"], part["min"])
-                        slot["max"] = max(slot["max"], part["max"])
+                    slot["vcount"] += part["vcount"]
+                    slot["sum"] = slot["sum"] + part["sum"]
+                    slot["min"] = min(slot["min"], part["min"])
+                    slot["max"] = max(slot["max"], part["max"])
         return merged
 
     def _result(self, rows: List[Dict[str, Any]]) -> Dataset:
@@ -687,7 +701,9 @@ class GroupedData:
         kn = self._key_name()
         merged = self._merged_partials(on)
         return self._result(
-            [{kn: m["k"], f"mean({on})": m["sum"] / m["count"]}
+            [{kn: m["k"],
+              f"mean({on})": (m["sum"] / m["vcount"]) if m["vcount"]
+              else None}
              for m in merged.values()])
 
     def min(self, on: str) -> Dataset:
@@ -704,16 +720,20 @@ class GroupedData:
 
     def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
         """Apply `fn` to each group's full row list; one task per group.
-        fn returns a row or a list of rows."""
+        fn returns a row or a list of rows. The grouping shuffle is an
+        all-to-all barrier, deferred until the result is consumed."""
         keyf = self._key_fn()
-        groups: Dict[Any, List[Any]] = {}
-        for b in self._ds._iter_block_values():
-            for row in BlockAccessor(b).rows():
-                groups.setdefault(keyf(row), []).append(row)
-        ds = Dataset([(None, (rows,)) for rows in groups.values()])
+        parent = self._ds
+
+        def work() -> List[WorkItem]:
+            groups: Dict[Any, List[Any]] = {}
+            for b in parent._iter_block_values():
+                for row in BlockAccessor(b).rows():
+                    groups.setdefault(keyf(row), []).append(row)
+            return [(None, (rows,)) for rows in groups.values()]
 
         def transform(block):
             out = fn(list(BlockAccessor(block).rows()))
             return out if isinstance(out, list) else [out]
 
-        return ds._derive(transform)
+        return _DeferredDataset(work)._derive(transform)

@@ -26,6 +26,7 @@ from ray_tpu.rllib.rl_module import (
     SpecDict,
     _ConvPolicyValueNet,
     _PolicyValueNet,
+    conv_spec_for,
 )
 from ray_tpu.rllib.rollout import WorkerSet
 
@@ -47,8 +48,6 @@ class QModule(RLModule):
         self.spec = spec
         self.hidden = tuple(hidden)
         if len(spec.shape()) >= 2:
-            from ray_tpu.rllib.rl_module import conv_spec_for
-
             self.model = _ConvPolicyValueNet(
                 n_actions=spec.n_actions, **conv_spec_for(spec.shape()[0]))
         else:

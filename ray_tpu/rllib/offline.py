@@ -76,13 +76,18 @@ def write_batches(path: str, batches: List[Dict[str, np.ndarray]],
 
 def read_batches(path: str, format: str = "json"):
     """Load an experience dataset written by `write_batches` as a
-    `ray_tpu.data.Dataset` of rows (compose transforms freely). Directory
-    expansion is the standard read_* path expansion."""
+    `ray_tpu.data.Dataset` of rows (compose transforms freely). Uses the
+    standard read_* path expansion, filtered to this format's extension so
+    a directory holding both formats (or sidecar files) reads cleanly."""
     import ray_tpu.data as rdata
+    from ray_tpu.data.datasource import expand_paths
 
+    ext = ".parquet" if format == "parquet" else ".json"
+    paths = [p for p in expand_paths(path) if p.endswith(ext)] \
+        if os.path.isdir(path) else path
     if format == "parquet":
-        return rdata.read_parquet(path)
-    return rdata.read_json(path)
+        return rdata.read_parquet(paths)
+    return rdata.read_json(paths)
 
 
 def iter_learner_batches(ds, batch_size: int = 256,

@@ -195,6 +195,7 @@ class WorkerSet:
                 module=module)
             for i in range(num_workers)]
         self.num_workers = num_workers
+        self._last_weights_ref = None  # re-sync replacements (see sample)
 
     def restart_worker(self, idx: int):
         """Replace a dead worker actor in place (fault tolerance —
@@ -218,6 +219,7 @@ class WorkerSet:
         import ray_tpu
 
         ref = ray_tpu.put(weights)
+        self._last_weights_ref = ref
         refs = [w.set_weights.remote(ref) for w in self.workers]  # fan out
         for r in refs:
             try:
@@ -242,6 +244,11 @@ class WorkerSet:
             except Exception:  # noqa: BLE001 — dead worker
                 logger.warning("sample: restarting dead rollout worker %d", i)
                 w = self.restart_worker(i)
+                if self._last_weights_ref is not None:
+                    # The replacement initialized random weights; re-sync
+                    # the last broadcast before sampling so its fragment
+                    # is on-policy.
+                    ray_tpu.get(w.set_weights.remote(self._last_weights_ref))
                 out.append(ray_tpu.get(w.sample.remote(steps_per_worker)))
         return out
 

@@ -1,0 +1,209 @@
+"""ParallelIterator: sharded lazy iterators over actors.
+
+Equivalent of the reference's `python/ray/util/iter.py:132`
+(`ParallelIterator` / `ParallelIteratorWorker` :1136 — the base of RLlib's
+old RolloutWorker): a logical iterator split into shards, each shard a
+chain of local transforms hosted by one actor; `gather_sync`/`gather_async`
+pull batches back to the driver either round-robin or completion-order.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class _ShardWorker:
+    """Actor hosting one shard: a base iterable + transform chain."""
+
+    def __init__(self, items: List[Any], transforms: List[tuple]):
+        self._items = items
+        self._transforms = transforms
+        self._it: Optional[Iterator] = None
+
+    def _build(self) -> Iterator:
+        it: Iterable = iter(self._items)
+        for kind, fn in self._transforms:
+            if kind == "for_each":
+                it = builtins.map(fn, it)
+            elif kind == "filter":
+                it = (x for x in it if fn(x))
+            elif kind == "flatten":
+                it = (y for x in it for y in x)
+            elif kind == "batch":
+                it = _batched(it, fn)
+        return iter(it)
+
+    def reset(self):
+        self._it = self._build()
+        return True
+
+    def next_batch(self, n: int) -> List[Any]:
+        """Up to n items; empty list = exhausted."""
+        if self._it is None:
+            self.reset()
+        out = []
+        try:
+            for _ in range(n):
+                out.append(next(self._it))
+        except StopIteration:
+            pass
+        return out
+
+
+def _batched(it: Iterator, n: int) -> Iterator[List[Any]]:
+    buf: List[Any] = []
+    for x in it:
+        buf.append(x)
+        if len(buf) == n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+class ParallelIterator:
+    """Declarative sharded iterator; transforms stay lazy until gathered."""
+
+    def __init__(self, shards: List[List[Any]],
+                 transforms: Optional[List[tuple]] = None):
+        self._shards = shards
+        self._transforms = list(transforms or [])
+
+    # ----------------------------------------------------------- transforms
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return ParallelIterator(self._shards,
+                                self._transforms + [("for_each", fn)])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return ParallelIterator(self._shards,
+                                self._transforms + [("filter", fn)])
+
+    def flatten(self) -> "ParallelIterator":
+        return ParallelIterator(self._shards,
+                                self._transforms + [("flatten", None)])
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return ParallelIterator(self._shards,
+                                self._transforms + [("batch", n)])
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._transforms or other._transforms:
+            # Materialize transform chains into the shard data first.
+            return ParallelIterator(
+                [list(s) for s in self._materialized_shards()]
+                + [list(s) for s in other._materialized_shards()])
+        return ParallelIterator(self._shards + other._shards)
+
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    # ------------------------------------------------------------- gathering
+
+    def _spawn(self) -> List[Any]:
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(_ShardWorker)
+        workers = [actor_cls.options(num_cpus=0.1).remote(
+            s, self._transforms) for s in self._shards]
+        ray_tpu.get([w.reset.remote() for w in workers])
+        return workers
+
+    def _materialized_shards(self, batch: int = 256) -> List[List[Any]]:
+        import ray_tpu
+
+        workers = self._spawn()
+        out: List[List[Any]] = []
+        try:
+            for w in workers:
+                shard: List[Any] = []
+                while True:
+                    got = ray_tpu.get(w.next_batch.remote(batch))
+                    if not got:
+                        break
+                    shard.extend(got)
+                out.append(shard)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+        return out
+
+    def gather_sync(self, batch: int = 32) -> Iterator[Any]:
+        """Round-robin over shards, in shard order within each round."""
+        import ray_tpu
+
+        workers = self._spawn()
+        try:
+            live = list(workers)
+            while live:
+                refs = [w.next_batch.remote(batch) for w in live]
+                next_live = []
+                for w, ref in zip(live, refs):
+                    got = ray_tpu.get(ref)
+                    if got:
+                        next_live.append(w)
+                        yield from got
+                live = next_live
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def gather_async(self, batch: int = 32) -> Iterator[Any]:
+        """Completion-order gathering: whichever shard finishes its batch
+        first is consumed (and re-pumped) first."""
+        import ray_tpu
+
+        workers = self._spawn()
+        try:
+            inflight = {w.next_batch.remote(batch): w for w in workers}
+            while inflight:
+                ready, _ = ray_tpu.wait(list(inflight), num_returns=1)
+                w = inflight.pop(ready[0])
+                got = ray_tpu.get(ready[0])
+                if got:
+                    inflight[w.next_batch.remote(batch)] = w
+                    yield from got
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def take(self, n: int) -> List[Any]:
+        out = []
+        for x in self.gather_sync():
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def show(self, n: int = 20):
+        for x in self.take(n):
+            print(x)
+
+    def __repr__(self):
+        return (f"ParallelIterator[{len(self._shards)} shards, "
+                f"{len(self._transforms)} transforms]")
+
+
+def from_items(items: List[Any], num_shards: int = 2) -> ParallelIterator:
+    shards: List[List[Any]] = [[] for _ in range(num_shards)]
+    for i, x in enumerate(items):
+        shards[i % num_shards].append(x)
+    return ParallelIterator(shards)
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards)
+
+
+def from_iterators(generators: List[Iterable]) -> ParallelIterator:
+    return ParallelIterator([list(g) for g in generators])

@@ -1,0 +1,206 @@
+"""Pool implementation: chunked task submission over the core runtime."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class AsyncResult:
+    """Handle for apply_async/map_async (mirrors multiprocessing's)."""
+
+    def __init__(self, refs: List[Any], single: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        threading.Thread(target=self._collect, daemon=True).start()
+
+    def _collect(self):
+        import ray_tpu
+
+        try:
+            values = ray_tpu.get(self._refs)
+            out: List[Any] = []
+            for chunk in values:
+                out.extend(chunk)
+            self._value = out[0] if self._single else out
+            if self._callback is not None:
+                try:
+                    self._callback(self._value)
+                except Exception:  # noqa: BLE001 — user callback
+                    pass
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+            if self._error_callback is not None:
+                try:
+                    self._error_callback(e)
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            self._done.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: Optional[float] = None):
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self._done.is_set():
+            raise ValueError("result not ready")
+        return self._error is None
+
+
+def _run_chunk(fn, chunk, mode):
+    if mode == "star":
+        return [fn(*args) for args in chunk]
+    if mode == "call":
+        return [fn(*args, **kwds) for args, kwds in chunk]
+    return [fn(x) for x in chunk]
+
+
+class Pool:
+    """Task-backed process pool: `processes` bounds concurrency via the
+    scheduler's CPU accounting, not a fixed set of forked children."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (), ray_address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=ray_address)
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        self._processes = processes
+        self._closed = False
+        # Pools don't own workers, so the initializer runs prepended to
+        # every chunk's task (cheap; mirrors reference semantics closely
+        # enough for setup-style initializers).
+        self._initializer = initializer
+        self._initargs = initargs
+        self._remote_chunk = ray_tpu.remote(self._make_runner())
+
+    def _make_runner(self):
+        initializer, initargs = self._initializer, self._initargs
+
+        def run_chunk(fn, chunk, mode):
+            if initializer is not None:
+                initializer(*initargs)
+            return _run_chunk(fn, chunk, mode)
+
+        return run_chunk
+
+    # ------------------------------------------------------------------ api
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]
+                ) -> List[list]:
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_open()
+        ref = self._remote_chunk.remote(fn, [(tuple(args), kwds or {})],
+                                        "call")
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None,
+                  callback: Optional[Callable] = None,
+                  error_callback: Optional[Callable] = None) -> AsyncResult:
+        self._check_open()
+        refs = [self._remote_chunk.remote(fn, c, "map")
+                for c in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        refs = [self._remote_chunk.remote(fn, c, "star")
+                for c in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1) -> Iterator[Any]:
+        """Ordered lazy iteration; chunks resolve as they finish."""
+        self._check_open()
+        import ray_tpu
+
+        refs = [self._remote_chunk.remote(fn, c, "map")
+                for c in self._chunks(iterable, chunksize)]
+
+        def gen():
+            for ref in refs:
+                for v in ray_tpu.get(ref):
+                    yield v
+
+        return gen()
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1) -> Iterator[Any]:
+        """Completion-order iteration."""
+        self._check_open()
+        import ray_tpu
+
+        refs = [self._remote_chunk.remote(fn, c, "map")
+                for c in self._chunks(iterable, chunksize)]
+
+        def gen():
+            pending = list(refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1)
+                for v in ray_tpu.get(ready[0]):
+                    yield v
+
+        return gen()
+
+    # -------------------------------------------------------------- lifecycle
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

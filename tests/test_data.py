@@ -235,3 +235,16 @@ def test_column_ops_and_unique(ray_start_shared):
     assert with_c.drop_columns(["a", "b"]).take(1) == [{"c": 0}]
     assert with_c.select_columns(["b"]).take(2) == [{"b": 0}, {"b": 1}]
     assert ds.unique("b") == [0, 1, 2, 3]
+
+
+def test_groupby_none_values(ray_start_shared):
+    """None = missing (reference ignore_nulls): sums/means skip Nones but
+    count() still counts the rows."""
+    ds = rd.from_items([{"g": 1, "v": None}, {"g": 1, "v": 2.0},
+                        {"g": 2, "v": None}])
+    assert ds.groupby("g").sum("v").take_all() == [
+        {"g": 1, "sum(v)": 2.0}, {"g": 2, "sum(v)": None}]
+    assert ds.groupby("g").mean("v").take_all() == [
+        {"g": 1, "mean(v)": 2.0}, {"g": 2, "mean(v)": None}]
+    assert ds.groupby("g").count().take_all() == [
+        {"g": 1, "count()": 2}, {"g": 2, "count()": 1}]

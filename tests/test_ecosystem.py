@@ -1,0 +1,109 @@
+"""Ecosystem shims: multiprocessing Pool, ParallelIterator, joblib backend
+(reference `python/ray/util/{multiprocessing,iter,joblib}`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_and_apply(ray_start_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as pool:
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(_add, (3, 4)) == 7
+        r = pool.apply_async(_add, (1, 2))
+        assert r.get(timeout=30) == 3
+        assert r.successful()
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_imap_orders(ray_start_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as pool:
+        assert list(pool.imap(_sq, range(8), chunksize=2)) \
+            == [x * x for x in range(8)]
+        assert sorted(pool.imap_unordered(_sq, range(8), chunksize=2)) \
+            == sorted(x * x for x in range(8))
+
+
+def test_pool_async_error_and_close(ray_start_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise ValueError("boom")
+
+    pool = Pool(processes=2)
+    r = pool.map_async(boom, [1, 2])
+    with pytest.raises(ValueError):
+        r.get(timeout=30)
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(_sq, [1])
+    pool.join()
+
+
+def test_parallel_iterator_transforms(ray_start_shared):
+    from ray_tpu.util import iter as rit
+
+    it = rit.from_range(12, num_shards=3).for_each(lambda x: x * 2) \
+        .filter(lambda x: x % 3 == 0)
+    got = sorted(it.gather_sync())
+    assert got == sorted(x * 2 for x in range(12) if (x * 2) % 3 == 0)
+
+    batches = list(rit.from_items(list(range(6)), num_shards=2)
+                   .batch(2).gather_sync())
+    assert all(len(b) <= 2 for b in batches)
+    assert sorted(x for b in batches for x in b) == list(range(6))
+
+    flat = sorted(rit.from_items([[1, 2], [3], [4, 5]], num_shards=2)
+                  .flatten().gather_async())
+    assert flat == [1, 2, 3, 4, 5]
+
+
+def test_parallel_iterator_union_take(ray_start_shared):
+    from ray_tpu.util import iter as rit
+
+    a = rit.from_items([1, 2, 3], num_shards=1)
+    b = rit.from_items([10, 20], num_shards=1)
+    u = a.union(b)
+    assert u.num_shards() == 2
+    assert sorted(u.gather_sync()) == [1, 2, 3, 10, 20]
+    assert len(a.take(2)) == 2
+
+
+def _inv(x):
+    return 1 // x
+
+
+def test_joblib_backend(ray_start_shared):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
+    # Errors inside remote batches surface as the original exception type.
+    with joblib.parallel_backend("ray", n_jobs=2):
+        with pytest.raises(ZeroDivisionError):
+            joblib.Parallel()(joblib.delayed(_inv)(i) for i in [1, 0])
