@@ -7,6 +7,11 @@ user's SPMD loop with `session.report` streaming metrics/checkpoints back.
 
 from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.batch_predictor import (
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+)
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -34,6 +39,7 @@ from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 __all__ = [
     "Backend", "BackendConfig", "JaxBackend", "JaxConfig", "BackendExecutor",
     "TrainingFailedError", "Checkpoint", "CheckpointManager",
+    "BatchPredictor", "Predictor", "JaxPredictor",
     "CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_mesh", "get_world_rank", "get_world_size", "BaseTrainer",

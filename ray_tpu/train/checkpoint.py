@@ -77,6 +77,18 @@ class Checkpoint:
         assert self._path, "pytree checkpoints are directory-backed"
         return restore_pytree(os.path.join(self._path, "pytree"), target)
 
+    def to_uri(self, uri: str) -> str:
+        """Persist to a URI (file:// without cloud deps) and return it."""
+        if not uri.startswith("file://"):
+            raise NotImplementedError(
+                f"Only file:// URIs are supported without cloud deps ({uri})")
+        self.to_directory(uri[len("file://"):])
+        return uri
+
+    @property
+    def uri(self) -> Optional[str]:
+        return f"file://{self._path}" if self._path else None
+
     @property
     def path(self) -> Optional[str]:
         return self._path
@@ -119,7 +131,35 @@ def restore_pytree(path: str, target: Any = None) -> Any:
     ckptr = ocp.PyTreeCheckpointer()
     if target is not None:
         return ckptr.restore(os.path.abspath(path), item=target)
-    return ckptr.restore(os.path.abspath(path))
+    try:
+        return ckptr.restore(os.path.abspath(path))
+    except ValueError:
+        # Without a target, arrays need an explicit restore type — ask for
+        # host numpy (a worker restoring for inference re-shards or
+        # device_puts afterwards itself).
+        import jax
+        import numpy as _np
+
+        meta = ckptr.metadata(os.path.abspath(path))
+        tree = getattr(getattr(meta, "item_metadata", meta), "tree", meta)
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=_np.ndarray), tree)
+        return ckptr.restore(os.path.abspath(path),
+                             restore_args=restore_args)
+
+
+def unbox_value_nodes(tree: Any) -> Any:
+    """Flax `LogicallyPartitioned`/`Partitioned` boxes serialize through
+    orbax as {'value': leaf} subtrees; a targetless restore surfaces them.
+    Callers that want plain arrays (inference without a mesh — e.g.
+    JaxPredictor) unbox explicitly with this. Only {'value': leaf} dicts
+    are collapsed, so unboxed trees pass through unchanged."""
+    if isinstance(tree, dict):
+        if set(tree.keys()) == {"value"} and not isinstance(
+                tree["value"], dict):
+            return tree["value"]
+        return {k: unbox_value_nodes(v) for k, v in tree.items()}
+    return tree
 
 
 # --------------------------------------------------------------------------- #

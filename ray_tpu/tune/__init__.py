@@ -31,6 +31,8 @@ from ray_tpu.train.session import get_checkpoint, get_session
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -198,7 +200,8 @@ class Tuner:
 __all__ = [
     "Tuner", "TuneConfig", "Result", "ResultGrid", "report",
     "Trial", "TrialStatus", "TrialScheduler", "FIFOScheduler",
-    "ASHAScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "PopulationBasedTraining", "HyperBandScheduler",
+    "MedianStoppingRule",
     "grid_search", "choice", "uniform", "loguniform", "randint", "quniform",
     "sample_from", "get_checkpoint", "Searcher", "TPESearcher",
 ]

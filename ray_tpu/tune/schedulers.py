@@ -89,6 +89,120 @@ class ASHAScheduler(TrialScheduler):
         return value >= cutoff if self.mode == "max" else value <= cutoff
 
 
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    the other trials' running averages at the same point (reference
+    `schedulers/median_stopping_rule.py`)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of metric values (one per result)
+        self._histories: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        value = result.get(self.metric)
+        if value is None:
+            return self.CONTINUE
+        hist = self._histories.setdefault(trial.trial_id, [])
+        hist.append(float(value))
+        t = result.get(self.time_attr, trial.num_results)
+        if t < self.grace_period:
+            return self.CONTINUE
+        # Running average of every OTHER trial up to this step count.
+        others = []
+        for tid, h in self._histories.items():
+            if tid == trial.trial_id or not h:
+                continue
+            others.append(sum(h[:len(hist)]) / min(len(h), len(hist)))
+        if len(others) < self.min_samples:
+            return self.CONTINUE
+        median = sorted(others)[len(others) // 2]
+        best = max(hist) if self.mode == "max" else min(hist)
+        worse = best < median if self.mode == "max" else best > median
+        return self.STOP if worse else self.CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronized HyperBand (reference `schedulers/hyperband.py`):
+    brackets of successive halving with different (n, r) trade-offs; each
+    bracket halves its cohort at its milestones, keeping the top 1/eta.
+
+    Trials are assigned to brackets round-robin at first result; within a
+    bracket, halving is enforced asynchronously at each milestone (a trial
+    past a milestone stops unless in the bracket's top 1/eta there) — the
+    asynchronous-cutoff variant of the synchronized algorithm, which never
+    idles a chip waiting for bracket stragglers.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # Bracket s starts at r0 = max_t * eta^-s with milestones up to max_t.
+        self._brackets: List[Dict[str, Any]] = []
+        for s in range(s_max, -1, -1):
+            r0 = max(1, int(max_t * self.eta ** (-s)))
+            milestones = []
+            t = r0
+            while t < max_t:
+                milestones.append(int(t))
+                t *= self.eta
+            self._brackets.append({"milestones": milestones, "rungs": {}})
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def _bracket_for(self, trial: Trial) -> Dict[str, Any]:
+        b = self._assignment.get(trial.trial_id)
+        if b is None:
+            b = self._next_bracket
+            self._assignment[trial.trial_id] = b
+            self._next_bracket = (self._next_bracket + 1) % len(self._brackets)
+        return self._brackets[b]
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        value = result.get(self.metric)
+        if value is None:
+            return self.CONTINUE
+        t = result.get(self.time_attr, trial.num_results)
+        if t >= self.max_t:
+            return self.STOP
+        bracket = self._bracket_for(trial)
+        seen = trial.last_result.setdefault("_hb_rungs", [])
+        # Record only at the HIGHEST newly-crossed milestone: appending one
+        # late value to every skipped rung would compare it against peers'
+        # genuinely-early values and systematically favor coarse reporters.
+        crossed = [r for r in bracket["milestones"]
+                   if t >= r and r not in seen]
+        if crossed:
+            rung = crossed[-1]
+            recorded = bracket["rungs"].setdefault(rung, [])
+            recorded.append(float(value))
+            seen.extend(crossed)  # skipped rungs count as passed, unscored
+            if len(recorded) >= self.eta and \
+                    not self._in_top_fraction(float(value), recorded):
+                return self.STOP
+        return self.CONTINUE
+
+    def _in_top_fraction(self, value: float, recorded: List[float]) -> bool:
+        ranked = sorted(recorded, reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) / self.eta))
+        cutoff = ranked[k - 1]
+        return value >= cutoff if self.mode == "max" else value <= cutoff
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT (reference `pbt.py`): every `perturbation_interval` results, a
     bottom-quantile trial is stopped and respawned from a top-quantile

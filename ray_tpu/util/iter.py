@@ -63,41 +63,45 @@ def _batched(it: Iterator, n: int) -> Iterator[List[Any]]:
 
 
 class ParallelIterator:
-    """Declarative sharded iterator; transforms stay lazy until gathered."""
+    """Declarative sharded iterator; transforms stay lazy until gathered.
 
-    def __init__(self, shards: List[List[Any]],
-                 transforms: Optional[List[tuple]] = None):
-        self._shards = shards
-        self._transforms = list(transforms or [])
+    Internally a list of (shard_items, transform_chain) segments: a union
+    is just segment concatenation (each side keeps its own chain), so
+    nothing materializes until a gather spawns the shard actors."""
+
+    def __init__(self, shards: Optional[List[List[Any]]] = None,
+                 transforms: Optional[List[tuple]] = None,
+                 segments: Optional[List[tuple]] = None):
+        if segments is not None:
+            self._segments = list(segments)
+        else:
+            t = list(transforms or [])
+            self._segments = [(s, t) for s in (shards or [])]
 
     # ----------------------------------------------------------- transforms
 
+    def _with_transform(self, step: tuple) -> "ParallelIterator":
+        return ParallelIterator(segments=[
+            (items, chain + [step]) for items, chain in self._segments])
+
     def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
-        return ParallelIterator(self._shards,
-                                self._transforms + [("for_each", fn)])
+        return self._with_transform(("for_each", fn))
 
     def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
-        return ParallelIterator(self._shards,
-                                self._transforms + [("filter", fn)])
+        return self._with_transform(("filter", fn))
 
     def flatten(self) -> "ParallelIterator":
-        return ParallelIterator(self._shards,
-                                self._transforms + [("flatten", None)])
+        return self._with_transform(("flatten", None))
 
     def batch(self, n: int) -> "ParallelIterator":
-        return ParallelIterator(self._shards,
-                                self._transforms + [("batch", n)])
+        return self._with_transform(("batch", n))
 
     def union(self, other: "ParallelIterator") -> "ParallelIterator":
-        if self._transforms or other._transforms:
-            # Materialize transform chains into the shard data first.
-            return ParallelIterator(
-                [list(s) for s in self._materialized_shards()]
-                + [list(s) for s in other._materialized_shards()])
-        return ParallelIterator(self._shards + other._shards)
+        return ParallelIterator(
+            segments=self._segments + other._segments)
 
     def num_shards(self) -> int:
-        return len(self._shards)
+        return len(self._segments)
 
     # ------------------------------------------------------------- gathering
 
@@ -105,32 +109,10 @@ class ParallelIterator:
         import ray_tpu
 
         actor_cls = ray_tpu.remote(_ShardWorker)
-        workers = [actor_cls.options(num_cpus=0.1).remote(
-            s, self._transforms) for s in self._shards]
+        workers = [actor_cls.options(num_cpus=0.1).remote(items, chain)
+                   for items, chain in self._segments]
         ray_tpu.get([w.reset.remote() for w in workers])
         return workers
-
-    def _materialized_shards(self, batch: int = 256) -> List[List[Any]]:
-        import ray_tpu
-
-        workers = self._spawn()
-        out: List[List[Any]] = []
-        try:
-            for w in workers:
-                shard: List[Any] = []
-                while True:
-                    got = ray_tpu.get(w.next_batch.remote(batch))
-                    if not got:
-                        break
-                    shard.extend(got)
-                out.append(shard)
-        finally:
-            for w in workers:
-                try:
-                    ray_tpu.kill(w)
-                except Exception:  # noqa: BLE001
-                    pass
-        return out
 
     def gather_sync(self, batch: int = 32) -> Iterator[Any]:
         """Round-robin over shards, in shard order within each round."""
@@ -190,8 +172,9 @@ class ParallelIterator:
             print(x)
 
     def __repr__(self):
-        return (f"ParallelIterator[{len(self._shards)} shards, "
-                f"{len(self._transforms)} transforms]")
+        steps = max((len(c) for _, c in self._segments), default=0)
+        return (f"ParallelIterator[{len(self._segments)} shards, "
+                f"{steps} transforms]")
 
 
 def from_items(items: List[Any], num_shards: int = 2) -> ParallelIterator:

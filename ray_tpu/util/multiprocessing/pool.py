@@ -1,20 +1,53 @@
-"""Pool implementation: chunked task submission over the core runtime."""
+"""Pool implementation: chunked, windowed task submission over the core.
+
+`processes` really bounds concurrency: every Pool method pushes its chunk
+tasks through a window of at most `processes` unresolved refs (submit as
+slots free), so a Pool(2) over an 8-CPU cluster runs 2 chunks at a time —
+the contract callers limiting a rate-limited API or memory-heavy fn rely
+on.
+"""
 
 from __future__ import annotations
 
-import itertools
+import multiprocessing
 import threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+def _windowed(thunks: List[Callable[[], Any]], window: int
+              ) -> Iterator[tuple]:
+    """Run ref-producing thunks keeping <= window in flight; yield
+    (index, value_or_exception) in COMPLETION order."""
+    import ray_tpu
+
+    inflight = {}
+    i = 0
+    while i < len(thunks) or inflight:
+        while i < len(thunks) and len(inflight) < window:
+            inflight[thunks[i]()] = i
+            i += 1
+        ready, _ = ray_tpu.wait(list(inflight), num_returns=1)
+        idx = inflight.pop(ready[0])
+        try:
+            yield idx, ray_tpu.get(ready[0])
+        except BaseException as e:  # noqa: BLE001 — delivered to caller
+            yield idx, _Failure(e)
+
+
+class _Failure:
+    def __init__(self, error: BaseException):
+        self.error = error
 
 
 class AsyncResult:
     """Handle for apply_async/map_async (mirrors multiprocessing's)."""
 
-    def __init__(self, refs: List[Any], single: bool,
-                 callback: Optional[Callable] = None,
+    def __init__(self, thunks: List[Callable[[], Any]], single: bool,
+                 window: int, callback: Optional[Callable] = None,
                  error_callback: Optional[Callable] = None):
-        self._refs = refs
+        self._thunks = thunks
         self._single = single
+        self._window = window
         self._callback = callback
         self._error_callback = error_callback
         self._value: Any = None
@@ -23,12 +56,14 @@ class AsyncResult:
         threading.Thread(target=self._collect, daemon=True).start()
 
     def _collect(self):
-        import ray_tpu
-
         try:
-            values = ray_tpu.get(self._refs)
+            chunks: List[Any] = [None] * len(self._thunks)
+            for idx, val in _windowed(self._thunks, self._window):
+                if isinstance(val, _Failure):
+                    raise val.error
+                chunks[idx] = val
             out: List[Any] = []
-            for chunk in values:
+            for chunk in chunks:
                 out.extend(chunk)
             self._value = out[0] if self._single else out
             if self._callback is not None:
@@ -48,7 +83,9 @@ class AsyncResult:
 
     def get(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
-            raise TimeoutError("result not ready")
+            # The drop-in contract: multiprocessing.TimeoutError (a
+            # ProcessError subclass), not the builtin.
+            raise multiprocessing.TimeoutError("result not ready")
         if self._error is not None:
             raise self._error
         return self._value
@@ -74,8 +111,8 @@ def _run_chunk(fn, chunk, mode):
 
 
 class Pool:
-    """Task-backed process pool: `processes` bounds concurrency via the
-    scheduler's CPU accounting, not a fixed set of forked children."""
+    """Task-backed process pool spanning the cluster; at most `processes`
+    chunk tasks run concurrently."""
 
     def __init__(self, processes: Optional[int] = None,
                  initializer: Optional[Callable] = None,
@@ -119,7 +156,15 @@ class Pool:
         return [items[i:i + chunksize]
                 for i in range(0, len(items), chunksize)]
 
-    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+    def _thunks(self, fn, chunks: List[list], mode: str
+                ) -> List[Callable[[], Any]]:
+        return [
+            (lambda c=c: self._remote_chunk.remote(fn, c, mode))
+            for c in chunks
+        ]
+
+    def apply(self, fn: Callable, args: tuple = (),
+              kwds: Optional[dict] = None):
         return self.apply_async(fn, args, kwds).get()
 
     def apply_async(self, fn: Callable, args: tuple = (),
@@ -127,10 +172,9 @@ class Pool:
                     callback: Optional[Callable] = None,
                     error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
-        ref = self._remote_chunk.remote(fn, [(tuple(args), kwds or {})],
-                                        "call")
-        return AsyncResult([ref], single=True, callback=callback,
-                           error_callback=error_callback)
+        thunks = self._thunks(fn, [[(tuple(args), kwds or {})]], "call")
+        return AsyncResult(thunks, single=True, window=self._processes,
+                           callback=callback, error_callback=error_callback)
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -141,48 +185,48 @@ class Pool:
                   callback: Optional[Callable] = None,
                   error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
-        refs = [self._remote_chunk.remote(fn, c, "map")
-                for c in self._chunks(iterable, chunksize)]
-        return AsyncResult(refs, single=False, callback=callback,
-                           error_callback=error_callback)
+        thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
+        return AsyncResult(thunks, single=False, window=self._processes,
+                           callback=callback, error_callback=error_callback)
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
         self._check_open()
-        refs = [self._remote_chunk.remote(fn, c, "star")
-                for c in self._chunks(iterable, chunksize)]
-        return AsyncResult(refs, single=False).get()
+        thunks = self._thunks(fn, self._chunks(iterable, chunksize), "star")
+        return AsyncResult(thunks, single=False,
+                           window=self._processes).get()
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: int = 1) -> Iterator[Any]:
-        """Ordered lazy iteration; chunks resolve as they finish."""
+        """Ordered lazy iteration; windowed submission."""
         self._check_open()
-        import ray_tpu
-
-        refs = [self._remote_chunk.remote(fn, c, "map")
-                for c in self._chunks(iterable, chunksize)]
+        thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
 
         def gen():
-            for ref in refs:
-                for v in ray_tpu.get(ref):
-                    yield v
+            buffered = {}
+            emit = 0
+            for idx, val in _windowed(thunks, self._processes):
+                if isinstance(val, _Failure):
+                    raise val.error
+                buffered[idx] = val
+                while emit in buffered:
+                    for v in buffered.pop(emit):
+                        yield v
+                    emit += 1
 
         return gen()
 
     def imap_unordered(self, fn: Callable, iterable: Iterable,
                        chunksize: int = 1) -> Iterator[Any]:
-        """Completion-order iteration."""
+        """Completion-order iteration; windowed submission."""
         self._check_open()
-        import ray_tpu
-
-        refs = [self._remote_chunk.remote(fn, c, "map")
-                for c in self._chunks(iterable, chunksize)]
+        thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
 
         def gen():
-            pending = list(refs)
-            while pending:
-                ready, pending = ray_tpu.wait(pending, num_returns=1)
-                for v in ray_tpu.get(ready[0]):
+            for _idx, val in _windowed(thunks, self._processes):
+                if isinstance(val, _Failure):
+                    raise val.error
+                for v in val:
                     yield v
 
         return gen()

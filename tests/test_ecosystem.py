@@ -7,14 +7,6 @@ import pytest
 import ray_tpu
 
 
-@pytest.fixture(scope="module")
-def ray_start_shared():
-    ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=8)
-    yield
-    ray_tpu.shutdown()
-
-
 def _sq(x):
     return x * x
 
@@ -107,3 +99,24 @@ def test_joblib_backend(ray_start_shared):
     with joblib.parallel_backend("ray", n_jobs=2):
         with pytest.raises(ZeroDivisionError):
             joblib.Parallel()(joblib.delayed(_inv)(i) for i in [1, 0])
+
+
+def _stamped_sleep(x):
+    import time as _t
+
+    start = _t.monotonic()
+    _t.sleep(0.4)
+    return (start, _t.monotonic())
+
+
+def test_pool_bounds_concurrency(ray_start_shared):
+    """processes=2 really limits parallelism: no instant where more than
+    two chunk tasks overlap."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        pool.map(_sq, range(4))  # warm the worker pool
+        spans = pool.map(_stamped_sleep, range(6), chunksize=1)
+    for t in {s for span in spans for s in span}:
+        overlap = sum(1 for a, b in spans if a < t < b)
+        assert overlap <= 2, f"{overlap} chunks ran concurrently"

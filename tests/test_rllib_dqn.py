@@ -7,14 +7,6 @@ import pytest
 import ray_tpu
 
 
-@pytest.fixture(scope="module")
-def ray_start_shared():
-    ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=8)
-    yield
-    ray_tpu.shutdown()
-
-
 # --------------------------------------------------------------------------- #
 # replay buffers
 # --------------------------------------------------------------------------- #

@@ -183,3 +183,62 @@ def test_pbt_exploits(ray_start_shared, tmp_path):
     assert not results.errors, results.errors
     best = results.get_best_result()
     assert best.metrics["score"] > 0
+
+
+def test_median_stopping_rule_stops_laggard(ray_start_shared, tmp_path):
+    """Trials well under the field's median stop early (reference
+    median_stopping_rule.py)."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        for step in range(12):
+            tune.report({"score": config["level"] + step * 0.01})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"level": tune.grid_search([0.0, 0.0, 10.0, 10.0,
+                                                10.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.MedianStoppingRule(
+                metric="score", mode="max", grace_period=2,
+                min_samples_required=2)),
+        run_config=tune.RunConfig(name="median", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    by_level = {}
+    for r in results:
+        by_level.setdefault(r.config["level"], []).append(
+            r.metrics.get("training_iteration", 0))
+    # The high-level trials run to completion; low-level ones cut early.
+    assert max(by_level[10.0]) == 12
+    assert min(by_level[0.0]) < 12
+
+
+def test_hyperband_scheduler_halves(ray_start_shared, tmp_path):
+    """HyperBand brackets cut under-performers at their milestones while
+    the best survive to max_t."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        for step in range(9):
+            tune.report({"loss": config["quality"] / (step + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search(
+            [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=tune.HyperBandScheduler(
+                metric="loss", mode="min", max_t=9, reduction_factor=3)),
+        run_config=tune.RunConfig(name="hb", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    iters = {r.config["quality"]: r.metrics.get("training_iteration", 0)
+             for r in results}
+    # The best config survives to the end; the worst is cut before max_t.
+    assert iters[1.0] == 9
+    assert iters[128.0] < 9
+    best = results.get_best_result()
+    assert best.config["quality"] == 1.0
