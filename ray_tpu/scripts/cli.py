@@ -50,6 +50,19 @@ def main(argv=None):
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", default="timeline.json")
     sub.add_parser("metrics")
+    p_serve = sub.add_parser("serve")
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    p_sd = serve_sub.add_parser("deploy")
+    p_sd.add_argument("config", help="YAML config file (serve schema)")
+    serve_sub.add_parser("status")
+    serve_sub.add_parser("shutdown")
+    p_sb = serve_sub.add_parser("build")
+    p_sb.add_argument("import_path", help="module:app to describe")
+    p_sb.add_argument("--output", default=None)
+    p_dbg = sub.add_parser("debug")
+    p_dbg.add_argument("--index", type=int, default=None,
+                       help="breakpoint index to attach (default: newest)")
+    p_dbg.add_argument("--list", action="store_true", dest="list_only")
     args = ap.parse_args(argv)
 
     ray_tpu, owns_runtime = _connect(args.address)
@@ -67,6 +80,49 @@ def main(argv=None):
     elif args.cmd == "metrics":
         print(ray_tpu._require_runtime().gcs.call(
             "metrics_prometheus")["text"])
+    elif args.cmd == "serve":
+        from ray_tpu import serve as _serve
+
+        if args.serve_cmd == "deploy":
+            from ray_tpu.serve.schema import deploy_config_file
+
+            deploy_config_file(args.config)
+            _dump(_serve.status())
+        elif args.serve_cmd == "status":
+            _dump(_serve.status())
+        elif args.serve_cmd == "shutdown":
+            _serve.shutdown()
+            print("serve: shut down")
+        elif args.serve_cmd == "build":
+            import yaml as _yaml
+
+            from ray_tpu.serve.schema import build as _build, import_attr
+
+            cfg = _build(import_attr(args.import_path))
+            cfg["applications"][0]["import_path"] = args.import_path
+            text = _yaml.safe_dump(cfg, sort_keys=False)
+            if args.output:
+                open(args.output, "w").write(text)
+                print(f"wrote {args.output}")
+            else:
+                print(text)
+    elif args.cmd == "debug":
+        from ray_tpu.util import rpdb
+
+        entries = rpdb.list_breakpoints()
+        if args.list_only or not entries:
+            _dump(entries or {"breakpoints": []})
+        else:
+            idx = args.index if args.index is not None else len(entries) - 1
+            if not 0 <= idx < len(entries):
+                print(f"error: no breakpoint #{idx} "
+                      f"({len(entries)} active; run with --list)",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            entry = entries[idx]
+            print(f"attaching to {entry['filename']}:{entry['lineno']} "
+                  f"(pid {entry['pid']})")
+            rpdb.attach(entry)
     if owns_runtime:
         ray_tpu.shutdown()
 

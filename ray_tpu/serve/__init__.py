@@ -337,9 +337,23 @@ def shutdown() -> None:
         pass
 
 
+def build(app):
+    """Application -> editable config dict (reference `serve build`)."""
+    from ray_tpu.serve.schema import build as _build
+
+    return _build(app)
+
+
+def deploy_config(config, *, timeout_s: float = 60.0):
+    """Deploy applications from a config dict (reference REST deploy)."""
+    from ray_tpu.serve.schema import deploy_config as _deploy
+
+    return _deploy(config, timeout_s=timeout_s)
+
+
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "batch", "delete", "deployment",
-    "get_deployment_handle", "http_port", "run", "shutdown", "start",
-    "status",
+    "DeploymentHandle", "batch", "build", "delete", "deploy_config",
+    "deployment", "get_deployment_handle", "http_port", "run", "shutdown",
+    "start", "status",
 ]

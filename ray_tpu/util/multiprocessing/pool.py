@@ -14,24 +14,46 @@ import threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 
-def _windowed(thunks: List[Callable[[], Any]], window: int
-              ) -> Iterator[tuple]:
-    """Run ref-producing thunks keeping <= window in flight; yield
-    (index, value_or_exception) in COMPLETION order."""
-    import ray_tpu
-
+def _prime(thunks: List[Callable[[], Any]], slots) -> tuple:
+    """Submit as many thunks as free slots allow; returns (inflight, i)."""
     inflight = {}
     i = 0
-    while i < len(thunks) or inflight:
-        while i < len(thunks) and len(inflight) < window:
-            inflight[thunks[i]()] = i
-            i += 1
-        ready, _ = ray_tpu.wait(list(inflight), num_returns=1)
-        idx = inflight.pop(ready[0])
-        try:
-            yield idx, ray_tpu.get(ready[0])
-        except BaseException as e:  # noqa: BLE001 — delivered to caller
-            yield idx, _Failure(e)
+    while i < len(thunks) and slots.acquire(blocking=False):
+        inflight[thunks[i]()] = i
+        i += 1
+    return inflight, i
+
+
+def _windowed(thunks: List[Callable[[], Any]], slots,
+              primed: tuple = None) -> Iterator[tuple]:
+    """Run ref-producing thunks bounded by the POOL-wide slot semaphore
+    (shared across concurrent map/imap calls, like multiprocessing.Pool's
+    fixed worker count); yield (index, value_or_exception) in COMPLETION
+    order."""
+    import ray_tpu
+
+    inflight, i = primed if primed is not None else _prime(thunks, slots)
+    try:
+        while i < len(thunks) or inflight:
+            while i < len(thunks) and slots.acquire(blocking=False):
+                inflight[thunks[i]()] = i
+                i += 1
+            if not inflight:
+                # Another call holds every slot: block for one.
+                slots.acquire()
+                inflight[thunks[i]()] = i
+                i += 1
+            ready, _ = ray_tpu.wait(list(inflight), num_returns=1)
+            idx = inflight.pop(ready[0])
+            slots.release()
+            try:
+                yield idx, ray_tpu.get(ready[0])
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                yield idx, _Failure(e)
+    finally:
+        # Abandoned mid-iteration (generator closed): give the slots back.
+        for _ in inflight:
+            slots.release()
 
 
 class _Failure:
@@ -43,11 +65,11 @@ class AsyncResult:
     """Handle for apply_async/map_async (mirrors multiprocessing's)."""
 
     def __init__(self, thunks: List[Callable[[], Any]], single: bool,
-                 window: int, callback: Optional[Callable] = None,
+                 slots, callback: Optional[Callable] = None,
                  error_callback: Optional[Callable] = None):
         self._thunks = thunks
         self._single = single
-        self._window = window
+        self._slots = slots
         self._callback = callback
         self._error_callback = error_callback
         self._value: Any = None
@@ -58,7 +80,7 @@ class AsyncResult:
     def _collect(self):
         try:
             chunks: List[Any] = [None] * len(self._thunks)
-            for idx, val in _windowed(self._thunks, self._window):
+            for idx, val in _windowed(self._thunks, self._slots):
                 if isinstance(val, _Failure):
                     raise val.error
                 chunks[idx] = val
@@ -124,6 +146,7 @@ class Pool:
         if processes is None:
             processes = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
         self._processes = processes
+        self._slots = threading.Semaphore(processes)  # pool-wide window
         self._closed = False
         # Pools don't own workers, so the initializer runs prepended to
         # every chunk's task (cheap; mirrors reference semantics closely
@@ -173,7 +196,7 @@ class Pool:
                     error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
         thunks = self._thunks(fn, [[(tuple(args), kwds or {})]], "call")
-        return AsyncResult(thunks, single=True, window=self._processes,
+        return AsyncResult(thunks, single=True, slots=self._slots,
                            callback=callback, error_callback=error_callback)
 
     def map(self, fn: Callable, iterable: Iterable,
@@ -186,7 +209,7 @@ class Pool:
                   error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
-        return AsyncResult(thunks, single=False, window=self._processes,
+        return AsyncResult(thunks, single=False, slots=self._slots,
                            callback=callback, error_callback=error_callback)
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
@@ -194,18 +217,20 @@ class Pool:
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "star")
         return AsyncResult(thunks, single=False,
-                           window=self._processes).get()
+                           slots=self._slots).get()
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: int = 1) -> Iterator[Any]:
         """Ordered lazy iteration; windowed submission."""
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
+        primed = _prime(thunks, self._slots)  # work starts NOW, not at
+        #                                       first next() (mp semantics)
 
         def gen():
             buffered = {}
             emit = 0
-            for idx, val in _windowed(thunks, self._processes):
+            for idx, val in _windowed(thunks, self._slots, primed):
                 if isinstance(val, _Failure):
                     raise val.error
                 buffered[idx] = val
@@ -221,9 +246,10 @@ class Pool:
         """Completion-order iteration; windowed submission."""
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
+        primed = _prime(thunks, self._slots)
 
         def gen():
-            for _idx, val in _windowed(thunks, self._processes):
+            for _idx, val in _windowed(thunks, self._slots, primed):
                 if isinstance(val, _Failure):
                     raise val.error
                 for v in val:

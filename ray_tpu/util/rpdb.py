@@ -1,0 +1,256 @@
+"""Remote pdb: breakpoints inside tasks/actors, attached from the CLI.
+
+Equivalent of the reference's `python/ray/util/rpdb.py` (`ray debug`): a
+worker hitting `set_trace()` opens a TCP listener, advertises itself in
+the GCS KV, and blocks in a socket-backed Pdb until a debugger client
+attaches (`python -m ray_tpu debug`) or the wait times out. Post-mortem
+via `post_mortem()` in an except block.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_KV_PREFIX = "__rpdb__:"
+
+
+class _SocketIO:
+    """File-like stdin/stdout over one accepted connection."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r")
+        self._wfile = conn.makefile("w")
+
+    def readline(self):
+        return self._rfile.readline()
+
+    def read(self, n):
+        return self._rfile.read(n)
+
+    def write(self, data):
+        self._wfile.write(data)
+        return len(data)
+
+    def flush(self):
+        try:
+            self._wfile.flush()
+        except Exception:  # noqa: BLE001 — client went away mid-session
+            pass
+
+    def close(self):
+        for f in (self._rfile, self._wfile, self._conn):
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class _RemotePdb(pdb.Pdb):
+    def __init__(self, io: _SocketIO):
+        super().__init__(stdin=io, stdout=io)
+        self.use_rawinput = False
+        self.prompt = "(ray_tpu-pdb) "
+        self._io = io
+
+    # continue/quit end the remote session: stop tracing BEFORE control
+    # returns to the worker (otherwise the next traced call lands the
+    # debugger inside this module's own cleanup code).
+    def do_continue(self, arg):
+        self.clear_all_breaks()
+        self.set_continue()
+        self._io.close()
+        return 1
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        self.clear_all_breaks()
+        self.set_continue()  # quit must not kill the worker: continue
+        self._io.close()
+        return 1
+
+    do_q = do_exit = do_quit
+
+
+def _node_ip() -> str:
+    """This node's address as seen by the rest of the cluster: the raylet
+    address workers were launched with, else a best-effort local IP."""
+    addr = os.environ.get("RAY_TPU_RAYLET_ADDRESS", "")
+    if ":" in addr:
+        host = addr.rsplit(":", 1)[0]
+        if host not in ("", "0.0.0.0"):
+            return host
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))  # no traffic sent; just picks a route
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except Exception:  # noqa: BLE001
+        return "127.0.0.1"
+
+
+def _advertise(entry: Dict[str, Any]) -> Optional[str]:
+    try:
+        import ray_tpu
+
+        runtime = ray_tpu._require_runtime()
+        key = f"{_KV_PREFIX}{entry['id']}"
+        runtime.gcs.call("kv_put", {"key": key,
+                                    "value": json.dumps(entry).encode()})
+        return key
+    except Exception:  # noqa: BLE001 — no cluster: local-only breakpoint
+        return None
+
+
+def _unadvertise(key: Optional[str]) -> None:
+    if key is None:
+        return
+    try:
+        import ray_tpu
+
+        ray_tpu._require_runtime().gcs.call("kv_del", {"key": key})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def set_trace(frame=None, timeout_s: float = 300.0):
+    """Block in a remote pdb session at the caller's frame.
+
+    Advertises `host:port` in the GCS KV so `python -m ray_tpu debug` can
+    list and attach; gives up (and continues execution) after `timeout_s`
+    with no client.
+    """
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("0.0.0.0", 0))  # attachable from other machines
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    host = _node_ip()
+    frame = frame or sys._getframe().f_back
+    entry = {
+        "id": f"{os.getpid()}-{port}",
+        "host": host,
+        "port": port,
+        "pid": os.getpid(),
+        "filename": frame.f_code.co_filename,
+        "lineno": frame.f_lineno,
+        "function": frame.f_code.co_name,
+        "ts": time.time(),
+    }
+    key = _advertise(entry)
+    print(f"ray_tpu debugger waiting on {host}:{port} "
+          f"({entry['filename']}:{entry['lineno']}) — attach with "
+          "`python -m ray_tpu debug`", file=sys.stderr, flush=True)
+    listener.settimeout(timeout_s)
+    try:
+        conn, _ = listener.accept()
+    except socket.timeout:
+        print("ray_tpu debugger: no client attached; continuing",
+              file=sys.stderr)
+        return
+    finally:
+        _unadvertise(key)
+        listener.close()
+    io = _SocketIO(conn)
+    # Last statement on purpose: the first trace event after this call
+    # must land in the CALLER's frame, not in cleanup code here.
+    _RemotePdb(io).set_trace(frame)
+
+
+def post_mortem(tb=None, timeout_s: float = 300.0):
+    """Debug an exception's traceback remotely (call in an except block)."""
+    if tb is None:
+        tb = sys.exc_info()[2]
+    if tb is None:
+        raise ValueError("no traceback to debug")
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("0.0.0.0", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    host = _node_ip()
+    entry = {"id": f"{os.getpid()}-{port}", "host": host, "port": port,
+             "pid": os.getpid(), "filename": "<post-mortem>", "lineno": 0,
+             "function": "post_mortem", "ts": time.time()}
+    key = _advertise(entry)
+    print(f"ray_tpu post-mortem waiting on {host}:{port}",
+          file=sys.stderr, flush=True)
+    listener.settimeout(timeout_s)
+    try:
+        conn, _ = listener.accept()
+    except socket.timeout:
+        return
+    finally:
+        _unadvertise(key)
+        listener.close()
+    io = _SocketIO(conn)
+    try:
+        _RemotePdb(io).interaction(None, tb)
+    finally:
+        io.close()
+
+
+# --------------------------------------------------------------------------- #
+# Client side (CLI)
+# --------------------------------------------------------------------------- #
+
+
+def list_breakpoints() -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    runtime = ray_tpu._require_runtime()
+    keys = runtime.gcs.call("kv_keys", {"prefix": _KV_PREFIX})["keys"]
+    out = []
+    for k in keys:
+        try:
+            v = runtime.gcs.call("kv_get", {"key": k})["value"]
+            if v:
+                out.append(json.loads(v))
+        except Exception:  # noqa: BLE001
+            pass
+    return sorted(out, key=lambda e: e["ts"])
+
+
+def attach(entry: Dict[str, Any], stdin=None, stdout=None) -> None:
+    """Bridge this terminal to the advertised pdb session."""
+    import threading
+
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    conn = socket.create_connection((entry["host"], entry["port"]),
+                                    timeout=10)
+
+    def pump_out():
+        # Byte-wise pump: the pdb prompt has no trailing newline, so a
+        # line-based reader would never show it to an interactive user.
+        while True:
+            try:
+                data = conn.recv(4096)
+            except OSError:
+                break
+            if not data:
+                break
+            stdout.write(data.decode(errors="replace"))
+            stdout.flush()
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        for line in stdin:
+            conn.sendall(line.encode() if isinstance(line, str) else line)
+            if line.strip() in ("c", "continue", "q", "quit", "exit"):
+                break
+    finally:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        t.join(timeout=2)

@@ -1,0 +1,23 @@
+"""Importable serve app for schema tests."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment
+class Pipeline:
+    def __init__(self, inner, bonus):
+        self.inner = inner
+        self.bonus = bonus
+
+    def __call__(self, x):
+        import ray_tpu
+
+        return ray_tpu.get(self.inner.remote(x)) + self.bonus
+
+
+app = Pipeline.bind(Doubler.bind(), 5)

@@ -290,3 +290,41 @@ def test_runtime_env_env_vars(ray_start_regular):
     assert val == "on"
     # A task without the env gets a worker without it.
     assert ray_tpu.get(read_env.remote(), timeout=60) is None
+
+# --------------------------------------------------------------------------- #
+# remote debugger (reference util/rpdb.py / `ray debug`)
+# --------------------------------------------------------------------------- #
+
+
+def test_rpdb_breakpoint_attach_inspect_continue(ray_start_regular):
+    """A task blocks at set_trace, advertises in KV, a client attaches,
+    inspects a local, continues, and the task completes."""
+    import io as _io
+    import threading
+    import time as _time
+
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy(x):
+        from ray_tpu.util import rpdb as _rpdb
+
+        secret = x * 10
+        _rpdb.set_trace(timeout_s=60)
+        return secret
+
+    ref = buggy.remote(7)
+    deadline = _time.time() + 30
+    entries = []
+    while _time.time() < deadline and not entries:
+        entries = rpdb.list_breakpoints()
+        _time.sleep(0.2)
+    assert entries, "breakpoint never advertised"
+    assert entries[0]["function"] == "buggy"
+
+    out = _io.StringIO()
+    rpdb.attach(entries[0], stdin=_io.StringIO("p secret\nc\n"), stdout=out)
+    assert ray_tpu.get(ref, timeout=30) == 70
+    assert "70" in out.getvalue()
+    # The breakpoint unregisters after the session.
+    assert not rpdb.list_breakpoints()

@@ -290,3 +290,58 @@ def test_graph_init_args_pass_through_untouched(serve_cluster):
 
     with pytest.raises(ValueError, match="un-substituted"):
         serve.run(SetEnsemble.bind({Adder.bind(1), Adder.bind(2)}))
+
+
+def test_schema_build_validate_deploy(serve_cluster, tmp_path):
+    """serve.build -> edit -> deploy_config round trip (reference
+    serve build / REST deploy), with per-deployment overrides applied."""
+    import serve_app_mod
+
+    from ray_tpu.serve.schema import build, deploy_config, validate_config
+
+    cfg = build(serve_app_mod.app)
+    deps = {d["name"] for d in cfg["applications"][0]["deployments"]}
+    assert deps == {"Doubler", "Pipeline"}
+
+    config = {
+        "applications": [{
+            "name": "default",
+            "import_path": "serve_app_mod:app",
+            "deployments": [
+                {"name": "Doubler", "num_replicas": 2,
+                 "max_concurrent_queries": 16},
+            ],
+        }],
+    }
+    validate_config(config)
+    handle = deploy_config(config)
+    assert ray_tpu.get(handle.remote(10)) == 25  # 2*10 + 5
+
+    st = serve.status()
+    assert st["Doubler"]["target"] == 2  # override applied
+    # The module-level objects were not mutated by the override.
+    assert serve_app_mod.Doubler.config.num_replicas == 1
+
+    with pytest.raises(ValueError, match="unknown deployment option"):
+        validate_config({"applications": [{
+            "import_path": "serve_app_mod:app",
+            "deployments": [{"name": "Doubler", "replicas": 2}]}]})
+    with pytest.raises(ValueError, match="import_path"):
+        validate_config({"applications": [{"name": "x"}]})
+
+
+def test_serve_cli_deploy_and_status(serve_cluster, tmp_path):
+    """The serve CLI deploys from YAML against a running cluster."""
+    import yaml
+
+    from ray_tpu.scripts.cli import main
+
+    cfg = {"applications": [{"name": "default",
+                             "import_path": "serve_app_mod:app"}]}
+    path = str(tmp_path / "serve.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    addr = ray_tpu._require_runtime().gcs.address
+    main(["--address", f"{addr[0]}:{addr[1]}", "serve", "deploy", path])
+    handle = serve.get_deployment_handle("Pipeline")
+    assert ray_tpu.get(handle.remote(1)) == 7
