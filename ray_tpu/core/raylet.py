@@ -674,12 +674,17 @@ class Raylet:
         tpus = spec.resources.get(TPU, 0)
         if tpus:
             env["RAY_TPU_GRANTED_TPU"] = str(tpus)
-        # runtime_env env_vars (reference runtime_env system, minimal
-        # slice): workers are leased by matching granted env, so tasks
-        # with different env_vars get different worker processes.
+        # runtime_env (reference runtime_env system): workers are leased
+        # by matching granted env, so tasks with different env_vars or
+        # working_dir/py_modules get different worker processes; the
+        # worker materializes URI packages at startup.
         renv = spec.runtime_env or {}
         for k, v in (renv.get("env_vars") or {}).items():
             env[str(k)] = str(v)
+        if renv.get("working_dir") or renv.get("py_modules"):
+            from ray_tpu.core import runtime_env as renv_mod
+
+            env.update(renv_mod.granted_env(renv))
         return env
 
     def _dispatch_to(self, worker: WorkerHandle, qt: QueuedTask):

@@ -1,0 +1,169 @@
+"""Runtime environments: working_dir / py_modules / env_vars per task.
+
+Equivalent of the reference's runtime-env system
+(`python/ray/_private/runtime_env/{working_dir,py_modules,packaging}.py`,
+design doc `python/ray/runtime_env/ARCHITECTURE.md`), collapsed to the
+framework's needs:
+
+- **Packaging** (driver side): a local directory zips into a
+  content-addressed blob stored once in the GCS KV
+  (`kv://runtime_env/<sha>.zip`); the task spec carries only URIs.
+- **Isolation** (raylet side): URIs become part of the worker's granted
+  env (`RAY_TPU_RUNTIME_ENV`), so the worker pool leases tasks only to
+  workers built with the same environment — two tasks with different
+  working_dirs never share a process.
+- **Materialization** (worker side): at startup the worker fetches blobs
+  it hasn't cached under `session_dir/runtime_env/<sha>/`, extracts,
+  chdirs into the working_dir and prepends py_modules to sys.path.
+
+conda/pip/container isolation is out of scope (no package installs in the
+target environment); `env_vars` pass through as before.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+URI_PREFIX = "kv://runtime_env/"
+_KV_NS = "runtime_env"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 256 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for f in files:
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, base))
+    blob = buf.getvalue()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(blob)} bytes "
+            f"(max {MAX_PACKAGE_BYTES}); exclude large data directories")
+    return blob
+
+
+def _upload(gcs, blob: bytes) -> str:
+    sha = hashlib.sha256(blob).hexdigest()[:32]
+    uri = f"{URI_PREFIX}{sha}.zip"
+    key = uri.encode()
+    exists = gcs.call("kv_exists", {"namespace": _KV_NS, "key": key})
+    if not exists.get("exists"):
+        gcs.call("kv_put", {"namespace": _KV_NS, "key": key, "value": blob})
+    return uri
+
+
+def prepare(runtime_env: Optional[Dict[str, Any]], gcs
+            ) -> Optional[Dict[str, Any]]:
+    """Driver side: replace local paths with uploaded content URIs.
+    Idempotent (URIs pass through untouched)."""
+    if not runtime_env:
+        return runtime_env
+    out = dict(runtime_env)
+    wd = out.get("working_dir")
+    if wd and not wd.startswith(URI_PREFIX):
+        if not os.path.isdir(wd):
+            raise ValueError(f"runtime_env working_dir {wd!r} is not a "
+                             "directory")
+        out["working_dir"] = _upload(gcs, _zip_dir(wd))
+    mods = out.get("py_modules")
+    if mods:
+        uris: List[str] = []
+        for m in mods:
+            if isinstance(m, str) and m.startswith(URI_PREFIX):
+                uris.append(m)
+            elif isinstance(m, str) and os.path.isdir(m):
+                # The module DIRECTORY itself is the importable package:
+                # wrap it so extraction recreates `<name>/...` on sys.path.
+                name = os.path.basename(os.path.normpath(m))
+                buf = io.BytesIO()
+                base = os.path.abspath(m)
+                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+                    for root, dirs, files in os.walk(base):
+                        dirs[:] = [d for d in dirs
+                                   if d not in _EXCLUDE_DIRS]
+                        for f in files:
+                            full = os.path.join(root, f)
+                            rel = os.path.join(
+                                name, os.path.relpath(full, base))
+                            zf.write(full, rel)
+                uris.append(_upload(gcs, buf.getvalue()))
+            else:
+                raise ValueError(
+                    f"py_modules entry {m!r} must be a directory")
+        out["py_modules"] = uris
+    return out
+
+
+def granted_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
+    """Raylet side: the env-var marker that isolates worker pools per
+    runtime environment (URIs only — env_vars are granted separately)."""
+    if not runtime_env:
+        return {}
+    uris = {k: runtime_env[k] for k in ("working_dir", "py_modules")
+            if runtime_env.get(k)}
+    if not uris:
+        return {}
+    return {"RAY_TPU_RUNTIME_ENV": json.dumps(uris, sort_keys=True)}
+
+
+def materialize(gcs, session_dir: str) -> None:
+    """Worker side: fetch + extract this process's runtime env (from the
+    RAY_TPU_RUNTIME_ENV marker), chdir into the working_dir, prepend
+    py_modules to sys.path. Runs once at worker startup."""
+    marker = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if not marker:
+        return
+    uris = json.loads(marker)
+    cache = os.path.join(session_dir, "runtime_env")
+    os.makedirs(cache, exist_ok=True)
+
+    def fetch(uri: str) -> str:
+        import shutil
+        import tempfile
+
+        sha = uri[len(URI_PREFIX):-len(".zip")]
+        dest = os.path.join(cache, sha)
+        if not os.path.isdir(dest):
+            blob = gcs.call("kv_get", {"namespace": _KV_NS,
+                                       "key": uri.encode()})["value"]
+            if blob is None:
+                raise RuntimeError(f"runtime_env blob {uri} missing from "
+                                   "GCS KV")
+            # Unique staging dir + tolerate losing the rename race:
+            # several workers with the same env extract concurrently.
+            tmp = tempfile.mkdtemp(prefix=f"{sha}.", dir=cache)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                if not os.path.isdir(dest):
+                    raise
+                shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+        return dest
+
+    for uri in uris.get("py_modules", []) or []:
+        path = fetch(uri)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    wd = uris.get("working_dir")
+    if wd:
+        path = fetch(wd)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+        logger.info("runtime_env: working_dir %s", path)

@@ -282,6 +282,11 @@ def main():
                 "%(levelname)s %(name)s: %(message)s"),
     )
     runtime = WorkerRuntime()
+    if os.environ.get("RAY_TPU_RUNTIME_ENV"):
+        from ray_tpu.core import runtime_env as renv_mod
+
+        renv_mod.materialize(runtime.gcs,
+                             os.environ.get("RAY_TPU_SESSION_DIR", "/tmp"))
     if GLOBAL_CONFIG.log_to_driver:
         from ray_tpu.core.log_streaming import LogStreamer
 

@@ -24,36 +24,61 @@ def _prime(thunks: List[Callable[[], Any]], slots) -> tuple:
     return inflight, i
 
 
+class _WindowedIter:
+    """Iterator over thunk results bounded by the POOL-wide slot semaphore
+    (shared across concurrent map/imap calls, like multiprocessing.Pool's
+    fixed worker count); yields (index, value_or_exception) in COMPLETION
+    order. A real object rather than a generator so eagerly-primed slots
+    are released even if the caller never iterates (__del__/close)."""
+
+    def __init__(self, thunks: List[Callable[[], Any]], slots,
+                 primed: tuple = None):
+        self._thunks = thunks
+        self._slots = slots
+        self._inflight, self._i = primed if primed is not None \
+            else _prime(thunks, slots)
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        thunks, slots = self._thunks, self._slots
+        if self._closed or (self._i >= len(thunks) and not self._inflight):
+            self.close()
+            raise StopIteration
+        while self._i < len(thunks) and slots.acquire(blocking=False):
+            self._inflight[thunks[self._i]()] = self._i
+            self._i += 1
+        if not self._inflight:
+            # Another call holds every slot: block for one.
+            slots.acquire()
+            self._inflight[thunks[self._i]()] = self._i
+            self._i += 1
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+        idx = self._inflight.pop(ready[0])
+        slots.release()
+        try:
+            return idx, ray_tpu.get(ready[0])
+        except BaseException as e:  # noqa: BLE001 — delivered to caller
+            return idx, _Failure(e)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            for _ in self._inflight:
+                self._slots.release()
+            self._inflight = {}
+
+    def __del__(self):
+        self.close()
+
+
 def _windowed(thunks: List[Callable[[], Any]], slots,
               primed: tuple = None) -> Iterator[tuple]:
-    """Run ref-producing thunks bounded by the POOL-wide slot semaphore
-    (shared across concurrent map/imap calls, like multiprocessing.Pool's
-    fixed worker count); yield (index, value_or_exception) in COMPLETION
-    order."""
-    import ray_tpu
-
-    inflight, i = primed if primed is not None else _prime(thunks, slots)
-    try:
-        while i < len(thunks) or inflight:
-            while i < len(thunks) and slots.acquire(blocking=False):
-                inflight[thunks[i]()] = i
-                i += 1
-            if not inflight:
-                # Another call holds every slot: block for one.
-                slots.acquire()
-                inflight[thunks[i]()] = i
-                i += 1
-            ready, _ = ray_tpu.wait(list(inflight), num_returns=1)
-            idx = inflight.pop(ready[0])
-            slots.release()
-            try:
-                yield idx, ray_tpu.get(ready[0])
-            except BaseException as e:  # noqa: BLE001 — delivered to caller
-                yield idx, _Failure(e)
-    finally:
-        # Abandoned mid-iteration (generator closed): give the slots back.
-        for _ in inflight:
-            slots.release()
+    return _WindowedIter(thunks, slots, primed)
 
 
 class _Failure:
@@ -224,13 +249,14 @@ class Pool:
         """Ordered lazy iteration; windowed submission."""
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
-        primed = _prime(thunks, self._slots)  # work starts NOW, not at
-        #                                       first next() (mp semantics)
+        # Work starts NOW, not at first next() (mp semantics); the iterator
+        # object owns the primed slots, so discarding it releases them.
+        win = _windowed(thunks, self._slots, _prime(thunks, self._slots))
 
         def gen():
             buffered = {}
             emit = 0
-            for idx, val in _windowed(thunks, self._slots, primed):
+            for idx, val in win:
                 if isinstance(val, _Failure):
                     raise val.error
                 buffered[idx] = val
@@ -246,10 +272,10 @@ class Pool:
         """Completion-order iteration; windowed submission."""
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
-        primed = _prime(thunks, self._slots)
+        win = _windowed(thunks, self._slots, _prime(thunks, self._slots))
 
         def gen():
-            for _idx, val in _windowed(thunks, self._slots, primed):
+            for _idx, val in win:
                 if isinstance(val, _Failure):
                     raise val.error
                 for v in val:

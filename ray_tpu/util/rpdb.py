@@ -78,6 +78,11 @@ class _RemotePdb(pdb.Pdb):
 
     do_q = do_exit = do_quit
 
+    def do_EOF(self, arg):
+        # Client disconnected: detach-and-continue. The inherited handler
+        # would raise BdbQuit into the traced task, killing it.
+        return self.do_continue(arg)
+
 
 def _node_ip() -> str:
     """This node's address as seen by the rest of the cluster: the raylet
@@ -245,10 +250,21 @@ def attach(entry: Dict[str, Any], stdin=None, stdout=None) -> None:
     t.start()
     try:
         for line in stdin:
-            conn.sendall(line.encode() if isinstance(line, str) else line)
-            if line.strip() in ("c", "continue", "q", "quit", "exit"):
+            try:
+                conn.sendall(line.encode() if isinstance(line, str)
+                             else line)
+            except OSError:  # server ended the session already
+                break
+            if line.strip() in ("c", "cont", "continue",
+                                "q", "quit", "exit"):
+                break
+            if not t.is_alive():  # server closed: stop reading stdin
                 break
     finally:
+        # Drain remaining output first: the server closes its side when
+        # the session ends (do_continue/do_quit), which ends the pump —
+        # closing before that races away the last responses.
+        t.join(timeout=5)
         try:
             conn.close()
         except Exception:  # noqa: BLE001

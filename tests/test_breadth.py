@@ -328,3 +328,39 @@ def test_rpdb_breakpoint_attach_inspect_continue(ray_start_regular):
     assert "70" in out.getvalue()
     # The breakpoint unregisters after the session.
     assert not rpdb.list_breakpoints()
+
+
+def test_runtime_env_working_dir_and_py_modules(ray_start_regular, tmp_path):
+    """working_dir/py_modules package to content-addressed KV blobs; a
+    worker with that env chdirs into the extracted dir and can import the
+    shipped module (reference runtime_env working_dir/py_modules)."""
+    import os as _os
+
+    wd = tmp_path / "appdir"
+    wd.mkdir()
+    (wd / "data.txt").write_text("shipped-data")
+    mod = tmp_path / "shipped_pkg"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 'xyzzy'\n")
+
+    @ray_tpu.remote
+    def probe():
+        import shipped_pkg  # only importable via the shipped py_module
+
+        return (open("data.txt").read(), shipped_pkg.MAGIC,
+                _os.path.basename(_os.getcwd()) != "appdir")
+
+    ref = probe.options(runtime_env={
+        "working_dir": str(wd),
+        "py_modules": [str(mod)],
+    }).remote()
+    data, magic, _ = ray_tpu.get(ref, timeout=60)
+    assert data == "shipped-data"
+    assert magic == "xyzzy"
+
+    # A plain task (no runtime_env) does NOT see the working_dir.
+    @ray_tpu.remote
+    def plain():
+        return _os.path.exists("data.txt")
+
+    assert ray_tpu.get(plain.remote(), timeout=60) is False
