@@ -80,6 +80,7 @@ class ClientRuntime:
         self.gcs = _GcsShim(self, gcs_address or server_address)
         self._lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
+        self._prepared_envs: Dict[str, Any] = {}
         self._closed = False
 
     # ------------------------------------------------------------ plumbing
@@ -173,11 +174,30 @@ class ClientRuntime:
         return out, list(kwargs.keys())
 
     def submit_task(self, spec) -> List:
+        spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)
         return self._call("client_submit", {"spec": spec})
+
+    def _prepare_runtime_env(self, renv):
+        """Package working_dir/py_modules on the CLIENT machine (the paths
+        are client-local) and upload through the GCS proxy; the in-cluster
+        server then sees only content URIs."""
+        if not renv or not (renv.get("working_dir")
+                            or renv.get("py_modules")):
+            return renv
+        key = repr(sorted((k, repr(v)) for k, v in renv.items()))
+        cached = self._prepared_envs.get(key)
+        if cached is not None:
+            return cached
+        from ray_tpu.core import runtime_env as renv_mod
+
+        prepared = renv_mod.prepare(renv, self.gcs)
+        self._prepared_envs[key] = prepared
+        return prepared
 
     # ------------------------------------------------------- actor surface
 
     def create_actor(self, spec):
+        spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)
         return self._call("client_create_actor", {"spec": spec})
 
     def submit_actor_task(self, spec, retry_on_restart: int = 1) -> List:

@@ -39,6 +39,8 @@ def _parse_bool(v) -> bool:
 
 # --- Core runtime -----------------------------------------------------------
 _flag("raylet_heartbeat_period_ms", int, 1000, "Raylet -> GCS resource report period")
+_flag("runtime_env_cache_bytes", int, 1 << 30,
+      "LRU byte cap for runtime_env packages in the GCS KV")
 _flag("health_check_period_ms", int, 2000, "GCS node health check period")
 _flag("health_check_failure_threshold", int, 5, "Missed health checks before a node is marked dead")
 _flag("worker_lease_timeout_ms", int, 30000, "Max time waiting for a worker lease")

@@ -108,6 +108,8 @@ class GcsServer:
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.jobs: Dict[JobID, JobInfo] = {}
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._kv_access_order: Dict[Tuple[str, bytes], int] = {}
+        self._kv_access_tick = 0
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         # Object directory: object_id -> {nodes: set[NodeID], size, inline: bytes|None, owner}
         self.objects: Dict[ObjectID, Dict[str, Any]] = {}
@@ -426,11 +428,41 @@ class GcsServer:
             if exists and not overwrite:
                 return {"added": False}
             self.kv[(ns, key)] = data["value"]
+            if ns == "runtime_env":
+                self._kv_access_tick += 1
+                self._kv_access_order[(ns, key)] = self._kv_access_tick
+                self._evict_runtime_env_locked(keep=(ns, key))
         return {"added": True}
 
+    def _evict_runtime_env_locked(self, keep):
+        """LRU-cap runtime_env package blobs: the KV is in-memory, and a
+        cluster where users iterate on code would otherwise accumulate
+        every historical zip until OOM (reference: URI cache with eviction,
+        `runtime_env/uri_cache.py`). Caller holds self._lock."""
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        cap = GLOBAL_CONFIG.runtime_env_cache_bytes
+        entries = [(k, len(v)) for k, v in self.kv.items()
+                   if k[0] == "runtime_env"]
+        total = sum(s for _, s in entries)
+        if total <= cap:
+            return
+        order = self._kv_access_order  # key -> monotonically increasing tick
+        entries.sort(key=lambda kv: order.get(kv[0], 0))
+        for k, size in entries:
+            if k == keep or total <= cap:
+                continue
+            del self.kv[k]
+            order.pop(k, None)
+            total -= size
+
     def handle_kv_get(self, conn: Connection, data: Dict[str, Any]):
+        key = (data.get("namespace", ""), data["key"])
         with self._lock:
-            return {"value": self.kv.get((data.get("namespace", ""), data["key"]))}
+            if key[0] == "runtime_env" and key in self.kv:
+                self._kv_access_tick += 1
+                self._kv_access_order[key] = self._kv_access_tick
+            return {"value": self.kv.get(key)}
 
     def handle_kv_del(self, conn: Connection, data: Dict[str, Any]):
         ns, key = data.get("namespace", ""), data["key"]

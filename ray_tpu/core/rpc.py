@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import queue
 import socket
 import struct
 import threading
@@ -261,12 +262,34 @@ class RpcClient:
         self._pending_lock = threading.Lock()
         self._push_handler = push_handler
         self._closed = threading.Event()
+        # Pushes dispatch from their own thread, NEVER the reader: a push
+        # handler that blocks on a lock held by code awaiting an RPC
+        # response over this client would otherwise deadlock the response
+        # dispatch (observed: raylet _on_gcs_push vs _enqueue's gcs.call).
+        self._push_queue: "queue.Queue" = queue.Queue()
         self._reader = threading.Thread(target=self._read_loop, name=f"{name}-reader", daemon=True)
         self._reader.start()
+        if push_handler is not None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, name=f"{name}-push", daemon=True)
+            self._push_thread.start()
 
     @property
     def is_closed(self) -> bool:
         return self._closed.is_set()
+
+    def _push_loop(self):
+        while not self._closed.is_set():
+            try:
+                item = self._push_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            method, payload = item
+            try:
+                data = serialization.loads(payload) if payload else None
+                self._push_handler(method, data)
+            except Exception:
+                logger.exception("%s push handler failed", self._name)
 
     def _read_loop(self):
         reason = "reader exited"
@@ -283,11 +306,7 @@ class RpcClient:
                         slot["event"].set()
                 elif kind == "push":
                     if self._push_handler is not None:
-                        try:
-                            data = serialization.loads(payload) if payload else None
-                            self._push_handler(envelope["m"], data)
-                        except Exception:
-                            logger.exception("%s push handler failed", self._name)
+                        self._push_queue.put((envelope["m"], payload))
         except (ConnectionLost, OSError) as e:
             reason = f"{type(e).__name__}: {e}"
         finally:

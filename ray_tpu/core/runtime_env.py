@@ -39,7 +39,9 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 
 
-def _zip_dir(path: str) -> bytes:
+def _zip_dir(path: str, prefix: str = "") -> bytes:
+    """Zip a directory; `prefix` nests entries under `<prefix>/...` (used
+    by py_modules so extraction recreates the importable package dir)."""
     buf = io.BytesIO()
     base = os.path.abspath(path)
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -47,7 +49,8 @@ def _zip_dir(path: str) -> bytes:
             dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
             for f in files:
                 full = os.path.join(root, f)
-                zf.write(full, os.path.relpath(full, base))
+                rel = os.path.relpath(full, base)
+                zf.write(full, os.path.join(prefix, rel) if prefix else rel)
     blob = buf.getvalue()
     if len(blob) > MAX_PACKAGE_BYTES:
         raise ValueError(
@@ -87,20 +90,9 @@ def prepare(runtime_env: Optional[Dict[str, Any]], gcs
                 uris.append(m)
             elif isinstance(m, str) and os.path.isdir(m):
                 # The module DIRECTORY itself is the importable package:
-                # wrap it so extraction recreates `<name>/...` on sys.path.
+                # nest it so extraction recreates `<name>/...` on sys.path.
                 name = os.path.basename(os.path.normpath(m))
-                buf = io.BytesIO()
-                base = os.path.abspath(m)
-                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-                    for root, dirs, files in os.walk(base):
-                        dirs[:] = [d for d in dirs
-                                   if d not in _EXCLUDE_DIRS]
-                        for f in files:
-                            full = os.path.join(root, f)
-                            rel = os.path.join(
-                                name, os.path.relpath(full, base))
-                            zf.write(full, rel)
-                uris.append(_upload(gcs, buf.getvalue()))
+                uris.append(_upload(gcs, _zip_dir(m, prefix=name)))
             else:
                 raise ValueError(
                     f"py_modules entry {m!r} must be a directory")

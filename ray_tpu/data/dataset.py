@@ -77,6 +77,41 @@ def _map_batches_transform(fn, batch_size: Optional[int], fn_kwargs):
     return transform
 
 
+def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
+    """Map side of the push shuffle: scatter one block's rows into n_out
+    bucket blocks (returned as separate objects via num_returns)."""
+    rows = list(BlockAccessor(block).rows())
+    buckets: List[list] = [[] for _ in range(n_out)]
+    if mode == "hash":
+        import pickle as _pickle
+        import zlib
+
+        for row in rows:
+            k = key_fn(row) if key_fn else row
+            h = zlib.crc32(_pickle.dumps(k, protocol=4))
+            buckets[h % n_out].append(row)
+    else:  # random scatter, deterministic per (seed, block salt)
+        rng = np.random.default_rng(
+            None if seed is None else seed * 100003 + salt)
+        assignment = rng.integers(0, n_out, size=len(rows))
+        for row, b in zip(rows, assignment):
+            buckets[b].append(row)
+    return buckets[0] if n_out == 1 else tuple(buckets)
+
+
+def _shuffle_reduce_blocks(mode, seed, part_idx, *buckets):
+    """Reduce side: concat this partition's buckets (+ local shuffle for
+    random mode, so within-partition order is random too)."""
+    rows: List[Any] = []
+    for b in buckets:
+        rows.extend(b)
+    if mode == "random":
+        rng = np.random.default_rng(
+            None if seed is None else seed * 7919 + part_idx)
+        rng.shuffle(rows)
+    return rows
+
+
 class ActorPoolStrategy:
     """Compute strategy for stateful map_batches UDFs (reference
     `ActorPoolStrategy` / `actor_pool_map_operator.py`): blocks flow
@@ -203,7 +238,14 @@ class Dataset:
 
     # ----------------------------------------------------------- all-to-all
 
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int, *,
+                    shuffle: bool = False) -> "Dataset":
+        """Rebalance into num_blocks blocks. shuffle=True runs the
+        distributed push shuffle instead of the driver-side re-slice
+        (reference repartition(shuffle=True))."""
+        if shuffle:
+            return self._push_shuffle(mode="random", seed=0,
+                                      num_blocks=num_blocks)
         parent = self
 
         def work() -> List[WorkItem]:
@@ -222,24 +264,37 @@ class Dataset:
         return _DeferredDataset(work)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle as a push-based two-stage exchange (reference
+        `push_based_shuffle.py`): map tasks scatter each block's rows into
+        per-output buckets (one return object per bucket, so a reducer
+        pulls only its slice), reduce tasks concat + locally shuffle. The
+        driver never materializes the data."""
+        return self._push_shuffle(mode="random", seed=seed)
+
+    def _push_shuffle(self, *, mode: str, seed: Optional[int] = None,
+                      key_fn: Optional[Callable[[Any], Any]] = None,
+                      num_blocks: Optional[int] = None) -> "Dataset":
         parent = self
 
         def work() -> List[WorkItem]:
-            blocks = [b for b in parent._iter_block_values()]
-            if not blocks:
+            import ray_tpu
+
+            refs = list(parent.materialize()._iter_block_refs())
+            if not refs:
                 return []
-            merged = BlockAccessor.concat(blocks)
-            acc = BlockAccessor(merged)
-            n = acc.num_rows()
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(n)
-            batch = acc.to_batch()
-            shuffled = {k: v[perm] for k, v in batch.items()}
-            nb = max(1, len(blocks))
-            per = max(1, -(-n // nb))
-            sacc = BlockAccessor(shuffled)
-            return [(None, (sacc.slice(i * per, min((i + 1) * per, n)),))
-                    for i in range(nb) if i * per < n]
+            n_out = num_blocks or len(refs)
+            smap = ray_tpu.remote(_shuffle_map_block)
+            sred = ray_tpu.remote(_shuffle_reduce_blocks)
+            bucket_refs = []
+            for salt, ref in enumerate(refs):
+                out = smap.options(num_returns=n_out).remote(
+                    ref, n_out, mode, seed, salt, key_fn)
+                bucket_refs.append([out] if n_out == 1 else out)
+            reduced = [
+                sred.remote(mode, seed, j,
+                            *[b[j] for b in bucket_refs])
+                for j in range(n_out)]
+            return [(None, (r,)) for r in reduced]
 
         return _DeferredDataset(work)
 
@@ -719,21 +774,22 @@ class GroupedData:
             [{kn: m["k"], f"max({on})": m["max"]} for m in merged.values()])
 
     def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
-        """Apply `fn` to each group's full row list; one task per group.
-        fn returns a row or a list of rows. The grouping shuffle is an
-        all-to-all barrier, deferred until the result is consumed."""
+        """Apply `fn` to each group's full row list; fn returns a row or a
+        list of rows. Rows route to partitions by key hash through the
+        push shuffle (all of a group's rows land in one partition without
+        transiting the driver); each partition task then groups locally
+        and applies fn per group."""
         keyf = self._key_fn()
-        parent = self._ds
-
-        def work() -> List[WorkItem]:
-            groups: Dict[Any, List[Any]] = {}
-            for b in parent._iter_block_values():
-                for row in BlockAccessor(b).rows():
-                    groups.setdefault(keyf(row), []).append(row)
-            return [(None, (rows,)) for rows in groups.values()]
+        shuffled = self._ds._push_shuffle(mode="hash", key_fn=keyf)
 
         def transform(block):
-            out = fn(list(BlockAccessor(block).rows()))
-            return out if isinstance(out, list) else [out]
+            groups: Dict[Any, List[Any]] = {}
+            for row in BlockAccessor(block).rows():
+                groups.setdefault(keyf(row), []).append(row)
+            out: List[Any] = []
+            for rows in groups.values():
+                res = fn(rows)
+                out.extend(res if isinstance(res, list) else [res])
+            return out
 
-        return _DeferredDataset(work)._derive(transform)
+        return shuffled._derive(transform)

@@ -11,9 +11,9 @@ this framework's one-hop task dispatch:
 - at most `max_tasks_in_flight_per_op` tasks run concurrently and at most
   `max_buffered_blocks_per_op` finished blocks sit unconsumed — the pump
   stops submitting until the consumer drains them (backpressure);
-- blocks are yielded as ObjectRefs in completion order (streaming), so
-  downstream consumers (iter_batches / streaming_split) start before the
-  read finishes.
+- blocks are yielded as ObjectRefs in SUBMISSION order (streaming, like
+  the reference's ordered bundles): consumers start before the read
+  finishes and iteration order is deterministic.
 """
 
 from __future__ import annotations
@@ -51,7 +51,7 @@ class StreamingExecutor:
     def execute(self, work: Iterator[Tuple[Optional[Callable], tuple]]
                 ) -> Iterator[Any]:
         """work: iterator of (producer, args). Yields block ObjectRefs in
-        completion order."""
+        submission order (streaming)."""
         import ray_tpu
 
         remote_fn = ray_tpu.remote(_fused_apply)
@@ -59,8 +59,10 @@ class StreamingExecutor:
             remote_fn = remote_fn.options(**self._resources)
 
         work_iter = iter(work)
-        in_flight: List[Any] = []
-        buffered: List[Any] = []
+        in_flight: dict = {}          # ref -> submission index
+        buffered: dict = {}           # submission index -> ready ref
+        submitted = 0
+        emit = 0                      # next index to yield (ordered)
         exhausted = False
         while True:
             # Submit while under the in-flight cap and backpressure allows.
@@ -71,18 +73,25 @@ class StreamingExecutor:
                 except StopIteration:
                     exhausted = True
                     break
-                in_flight.append(
-                    remote_fn.remote(self._transforms, producer, *args))
-            if buffered:
-                yield buffered.pop(0)
+                ref = remote_fn.remote(self._transforms, producer, *args)
+                in_flight[ref] = submitted
+                submitted += 1
+            # Yield strictly in submission order (the reference's streaming
+            # executor preserves block order): later-finished blocks buffer
+            # until their predecessors emit — iteration is deterministic.
+            if emit in buffered:
+                yield buffered.pop(emit)
+                emit += 1
                 continue
             if not in_flight:
-                if exhausted:
+                if exhausted and not buffered:
                     return
-                continue
-            ready, in_flight = ray_tpu.wait(
-                in_flight, num_returns=1, timeout=10.0)
-            buffered.extend(ready)
+                if not buffered:
+                    continue
+            ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                    timeout=10.0)
+            for r in ready:
+                buffered[in_flight.pop(r)] = r
 
 
 def apply_transforms_local(transforms: List[Callable], block: Any) -> Any:

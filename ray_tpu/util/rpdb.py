@@ -248,23 +248,29 @@ def attach(entry: Dict[str, Any], stdin=None, stdout=None) -> None:
 
     t = threading.Thread(target=pump_out, daemon=True)
     t.start()
+    session_ended = False
     try:
         for line in stdin:
             try:
                 conn.sendall(line.encode() if isinstance(line, str)
                              else line)
             except OSError:  # server ended the session already
+                session_ended = True
                 break
             if line.strip() in ("c", "cont", "continue",
                                 "q", "quit", "exit"):
+                session_ended = True
                 break
             if not t.is_alive():  # server closed: stop reading stdin
+                session_ended = True
                 break
     finally:
-        # Drain remaining output first: the server closes its side when
-        # the session ends (do_continue/do_quit), which ends the pump —
-        # closing before that races away the last responses.
-        t.join(timeout=5)
+        if session_ended:
+            # Server is ending the session: drain its last responses
+            # before closing (closing first races them away).
+            t.join(timeout=5)
+        # stdin-EOF without a terminator: close NOW — the server is still
+        # waiting for commands, and our close triggers its do_EOF detach.
         try:
             conn.close()
         except Exception:  # noqa: BLE001

@@ -248,3 +248,26 @@ def test_groupby_none_values(ray_start_shared):
         {"g": 1, "mean(v)": 2.0}, {"g": 2, "mean(v)": None}]
     assert ds.groupby("g").count().take_all() == [
         {"g": 1, "count()": 2}, {"g": 2, "count()": 1}]
+
+
+def test_push_shuffle_preserves_rows_and_is_seeded(ray_start_shared):
+    """random_shuffle runs as the two-stage push shuffle: rows preserved,
+    order changed, deterministic per seed, no driver materialization of
+    the whole dataset in one block."""
+    ds = rd.from_items(list(range(200)))
+    a = ds.random_shuffle(seed=7)
+    rows_a = a.take_all()
+    assert sorted(rows_a) == list(range(200))
+    assert rows_a != list(range(200))
+    assert a.num_blocks() == ds.num_blocks()  # partitions preserved
+    b = ds.random_shuffle(seed=7).take_all()
+    assert rows_a == b  # seeded determinism
+    c = ds.random_shuffle(seed=8).take_all()
+    assert rows_a != c
+
+
+def test_repartition_shuffle(ray_start_shared):
+    ds = rd.from_items(list(range(120)))
+    out = ds.repartition(5, shuffle=True)
+    assert out.num_blocks() == 5
+    assert sorted(out.take_all()) == list(range(120))
