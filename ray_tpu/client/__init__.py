@@ -80,7 +80,7 @@ class ClientRuntime:
         self.gcs = _GcsShim(self, gcs_address or server_address)
         self._lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
-        self._prepared_envs: Dict[str, Any] = {}
+        self._env_cache = None  # lazy runtime_env.EnvCache
         self._closed = False
 
     # ------------------------------------------------------------ plumbing
@@ -184,15 +184,11 @@ class ClientRuntime:
         if not renv or not (renv.get("working_dir")
                             or renv.get("py_modules")):
             return renv
-        key = repr(sorted((k, repr(v)) for k, v in renv.items()))
-        cached = self._prepared_envs.get(key)
-        if cached is not None:
-            return cached
-        from ray_tpu.core import runtime_env as renv_mod
+        if self._env_cache is None:
+            from ray_tpu.core.runtime_env import EnvCache
 
-        prepared = renv_mod.prepare(renv, self.gcs)
-        self._prepared_envs[key] = prepared
-        return prepared
+            self._env_cache = EnvCache(self.gcs)
+        return self._env_cache.prepare(renv)
 
     # ------------------------------------------------------- actor surface
 

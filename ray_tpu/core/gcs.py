@@ -426,13 +426,13 @@ class GcsServer:
         with self._lock:
             exists = (ns, key) in self.kv
             if exists and not overwrite:
-                return {"added": False}
+                return {"added": False, "existed": True}
             self.kv[(ns, key)] = data["value"]
             if ns == "runtime_env":
                 self._kv_access_tick += 1
                 self._kv_access_order[(ns, key)] = self._kv_access_tick
                 self._evict_runtime_env_locked(keep=(ns, key))
-        return {"added": True}
+        return {"added": True, "existed": exists}
 
     def _evict_runtime_env_locked(self, keep):
         """LRU-cap runtime_env package blobs: the KV is in-memory, and a
@@ -471,7 +471,9 @@ class GcsServer:
                 doomed = [k for k in self.kv if k[0] == ns and k[1].startswith(key)]
                 for k in doomed:
                     del self.kv[k]
+                    self._kv_access_order.pop(k, None)
                 return {"deleted": len(doomed)}
+            self._kv_access_order.pop((ns, key), None)
             return {"deleted": int(self.kv.pop((ns, key), None) is not None)}
 
     def handle_kv_keys(self, conn: Connection, data: Dict[str, Any]):
@@ -480,8 +482,14 @@ class GcsServer:
             return {"keys": [k[1] for k in self.kv if k[0] == ns and k[1].startswith(prefix)]}
 
     def handle_kv_exists(self, conn: Connection, data: Dict[str, Any]):
+        key = (data.get("namespace", ""), data["key"])
         with self._lock:
-            return {"exists": (data.get("namespace", ""), data["key"]) in self.kv}
+            exists = key in self.kv
+            if exists and key[0] == "runtime_env":
+                # Liveness probes keep in-use packages warm in the LRU.
+                self._kv_access_tick += 1
+                self._kv_access_order[key] = self._kv_access_tick
+            return {"exists": exists}
 
     # ------------------------------------------------------- object directory
 

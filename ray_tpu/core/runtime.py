@@ -106,7 +106,7 @@ class CoreRuntime:
         self._exported_functions: set = set()
         self._actor_clients: Dict[bytes, ActorClient] = {}
         self._actor_states: Dict[bytes, Dict[str, Any]] = {}
-        self._prepared_envs: Dict[str, Dict[str, Any]] = {}
+        self._env_cache = None  # lazy runtime_env.EnvCache
         self._actor_events: Dict[bytes, threading.Event] = defaultdict(threading.Event)
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
         self._free_buffer: List[ObjectID] = []
@@ -517,23 +517,14 @@ class CoreRuntime:
 
     def _prepare_runtime_env(self, renv):
         """Local working_dir/py_modules paths -> content-addressed KV URIs
-        (see core/runtime_env.py). Memoized per spec dict: a loop
-        submitting N tasks with the same runtime_env zips the directory
-        once, not N times (content is snapshotted at first use, like the
-        reference's per-job packaging)."""
+        through the shared memoizing cache (core/runtime_env.EnvCache)."""
         if not renv or not (renv.get("working_dir") or renv.get("py_modules")):
             return renv
-        key = repr(sorted((k, repr(v)) for k, v in renv.items()))
-        with self._lock:
-            cached = self._prepared_envs.get(key)
-        if cached is not None:
-            return cached
-        from ray_tpu.core import runtime_env as renv_mod
+        if self._env_cache is None:
+            from ray_tpu.core.runtime_env import EnvCache
 
-        prepared = renv_mod.prepare(renv, self.gcs)
-        with self._lock:
-            self._prepared_envs[key] = prepared
-        return prepared
+            self._env_cache = EnvCache(self.gcs)
+        return self._env_cache.prepare(renv)
 
     def wait_for_actor(self, actor_id: ActorID, timeout: float = 120.0) -> str:
         key = actor_id.binary()

@@ -100,6 +100,55 @@ def prepare(runtime_env: Optional[Dict[str, Any]], gcs
     return out
 
 
+class EnvCache:
+    """Memoizing prepare() shared by the driver runtime and ray:// client.
+
+    A loop submitting N tasks with one runtime_env zips the directory
+    once; entries re-validate every `revalidate_s` against the KV (the
+    blob store LRU-evicts under memory pressure — a vanished package
+    re-uploads instead of failing every later worker launch)."""
+
+    def __init__(self, gcs, revalidate_s: float = 60.0):
+        import threading
+        import time as _time
+
+        self._gcs = gcs
+        self._revalidate_s = revalidate_s
+        self._lock = threading.Lock()
+        self._time = _time
+        self._entries: Dict[str, Any] = {}  # key -> (prepared, checked_ts)
+
+    def prepare(self, runtime_env: Optional[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+        if not runtime_env or not (runtime_env.get("working_dir")
+                                   or runtime_env.get("py_modules")):
+            return runtime_env
+        key = repr(sorted((k, repr(v)) for k, v in runtime_env.items()))
+        now = self._time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and now - entry[1] < self._revalidate_s:
+                return entry[0]
+        prepared = entry[0] if entry is not None else None
+        if prepared is None or not self._uris_exist(prepared):
+            prepared = prepare(runtime_env, self._gcs)
+        with self._lock:
+            self._entries[key] = (prepared, now)
+        return prepared
+
+    def _uris_exist(self, prepared: Dict[str, Any]) -> bool:
+        uris = [prepared.get("working_dir")] + list(
+            prepared.get("py_modules") or [])
+        for uri in uris:
+            if uri and uri.startswith(URI_PREFIX):
+                resp = self._gcs.call("kv_exists",
+                                      {"namespace": _KV_NS,
+                                       "key": uri.encode()})
+                if not resp.get("exists"):
+                    return False
+        return True
+
+
 def granted_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     """Raylet side: the env-var marker that isolates worker pools per
     runtime environment (URIs only — env_vars are granted separately)."""

@@ -121,12 +121,17 @@ class WorkerRuntime(CoreRuntime):
         return fn
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        # Batch-fetch every ref arg in ONE get: a reduce-style task taking
+        # n refs (push shuffle fan-in) must not pay n sequential fetch
+        # round trips.
+        ref_ids = [payload for kind, payload in spec.args if kind != "v"]
+        fetched = iter(self.get(ref_ids)) if ref_ids else iter(())
         values = []
         for kind, payload in spec.args:
             if kind == "v":
                 values.append(serialization.deserialize(payload))
             else:
-                values.append(self.get([payload])[0])
+                values.append(next(fetched))
         nk = len(spec.kwargs_keys)
         if nk:
             pos, kwvals = values[:-nk], values[-nk:]

@@ -77,19 +77,44 @@ def _map_batches_transform(fn, batch_size: Optional[int], fn_kwargs):
     return transform
 
 
+def _stable_key_hash(k) -> int:
+    """Deterministic cross-process hash that agrees wherever keys compare
+    equal: np scalars unbox, bool/integral floats collapse to int (True,
+    1, 1.0 and np.int64(1) all bucket together — a raw pickle hash would
+    split one logical group across partitions). Strings hash by bytes
+    (Python's str hash is per-process salted)."""
+    import pickle as _pickle
+    import zlib
+
+    if hasattr(k, "item"):
+        k = k.item()
+    if isinstance(k, bool):
+        k = int(k)
+    elif isinstance(k, float) and k.is_integer():
+        k = int(k)
+    if isinstance(k, int):
+        return k & 0x7FFFFFFF
+    if isinstance(k, str):
+        return zlib.crc32(k.encode())
+    if isinstance(k, bytes):
+        return zlib.crc32(k)
+    if isinstance(k, tuple):
+        h = 0x345678
+        for x in k:
+            h = (h * 1000003) ^ _stable_key_hash(x)
+        return h & 0x7FFFFFFF
+    return zlib.crc32(_pickle.dumps(k, protocol=4))
+
+
 def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
     """Map side of the push shuffle: scatter one block's rows into n_out
     bucket blocks (returned as separate objects via num_returns)."""
     rows = list(BlockAccessor(block).rows())
     buckets: List[list] = [[] for _ in range(n_out)]
     if mode == "hash":
-        import pickle as _pickle
-        import zlib
-
         for row in rows:
             k = key_fn(row) if key_fn else row
-            h = zlib.crc32(_pickle.dumps(k, protocol=4))
-            buckets[h % n_out].append(row)
+            buckets[_stable_key_hash(k) % n_out].append(row)
     else:  # random scatter, deterministic per (seed, block salt)
         rng = np.random.default_rng(
             None if seed is None else seed * 100003 + salt)

@@ -1,0 +1,5 @@
+"""Experimental utilities (reference `python/ray/experimental/`)."""
+
+from ray_tpu.experimental import internal_kv
+
+__all__ = ["internal_kv"]

@@ -5,7 +5,14 @@ forms the process group (`jax.distributed`), builds the mesh, and runs the
 user's SPMD loop with `session.report` streaming metrics/checkpoints back.
 """
 
-from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
+from ray_tpu.train.backend import (
+    Backend,
+    BackendConfig,
+    JaxBackend,
+    JaxConfig,
+    TorchBackend,
+    TorchConfig,
+)
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 from ray_tpu.train.batch_predictor import (
     BatchPredictor,
@@ -33,6 +40,7 @@ from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
     Result,
+    TorchTrainer,
 )
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
@@ -40,6 +48,7 @@ __all__ = [
     "Backend", "BackendConfig", "JaxBackend", "JaxConfig", "BackendExecutor",
     "TrainingFailedError", "Checkpoint", "CheckpointManager",
     "BatchPredictor", "Predictor", "JaxPredictor",
+    "TorchTrainer", "TorchConfig", "TorchBackend",
     "CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_mesh", "get_world_rank", "get_world_size", "BaseTrainer",

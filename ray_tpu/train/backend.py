@@ -120,3 +120,73 @@ class JaxBackend(Backend):
             worker_group.execute(shutdown_distributed)
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------- #
+# Torch backend (reference `train/torch/config.py`)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """torch.distributed process group over the worker group.
+
+    CPU hosts use gloo (this environment has no CUDA); the seam matches
+    the reference's `_TorchBackend.on_start` -> `_setup_torch_process_group`
+    (`python/ray/train/torch/config.py:69-113`).
+    """
+
+    backend_name: str = "torch"
+    backend: str = "gloo"
+    init_timeout_s: int = 120
+
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _setup_torch_process_group(backend: str, addr: str, port: int,
+                               rank: int, world: int, timeout_s: int):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = addr
+    os.environ["MASTER_PORT"] = str(port)
+    dist.init_process_group(
+        backend, init_method="env://", rank=rank, world_size=world,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return True
+
+
+def _teardown_torch_process_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: "TorchConfig"):
+        import ray_tpu
+
+        world = len(worker_group)
+        if world <= 1:
+            return
+        from ray_tpu.parallel.distributed import get_address_and_port
+
+        host, port = worker_group.execute_single(0, get_address_and_port)
+        logger.info("forming torch %s process group: %d procs via %s:%d",
+                    backend_config.backend, world, host, port)
+        refs = [w.execute.remote(_setup_torch_process_group,
+                                 backend_config.backend, host, port,
+                                 rank, world, backend_config.init_timeout_s)
+                for rank, w in enumerate(worker_group.workers)]
+        ray_tpu.get(refs)
+
+    def on_shutdown(self, worker_group, backend_config: "TorchConfig"):
+        try:
+            worker_group.execute(_teardown_torch_process_group)
+        except Exception:  # noqa: BLE001 — workers may already be gone
+            pass

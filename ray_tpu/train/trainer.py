@@ -15,7 +15,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.backend import (Backend, BackendConfig, JaxConfig,
+                                   TorchConfig)
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import RunConfig, ScalingConfig
@@ -199,3 +200,25 @@ class JaxTrainer(DataParallelTrainer):
             or JaxConfig(mesh=(kwargs.get("scaling_config") or ScalingConfig()).mesh)
         super().__init__(train_loop_per_worker,
                          backend_config=backend_config, **kwargs)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Data-parallel torch training (reference `TorchTrainer`,
+    `torch/torch_trainer.py:15`): the worker group forms a
+    torch.distributed process group (gloo on CPU hosts) and the user loop
+    wraps its model in DistributedDataParallel. On this framework the
+    TPU-native path is JaxTrainer; TorchTrainer exists for drop-in
+    migration of torch training scripts."""
+
+    _default_backend_config = TorchConfig()
+
+    def __init__(self, train_loop_per_worker, *, train_loop_config=None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config=None, run_config=None, datasets=None,
+                 resume_from_checkpoint=None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config, run_config=run_config,
+            datasets=datasets, resume_from_checkpoint=resume_from_checkpoint)

@@ -364,3 +364,20 @@ def test_runtime_env_working_dir_and_py_modules(ray_start_regular, tmp_path):
         return _os.path.exists("data.txt")
 
     assert ray_tpu.get(plain.remote(), timeout=60) is False
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_initialized()
+    assert kv._internal_kv_put("k1", b"v1") is False  # fresh key
+    assert kv._internal_kv_put("k1", b"v2") is True   # existed
+    assert kv._internal_kv_get("k1") == b"v2"
+    assert kv._internal_kv_put("k1", b"v3", overwrite=False) is True
+    assert kv._internal_kv_get("k1") == b"v2"  # not overwritten
+    kv._internal_kv_put("k2", b"x")
+    assert set(kv._internal_kv_list("k")) >= {b"k1", b"k2"}
+    assert kv._internal_kv_exists("k2")
+    assert kv._internal_kv_del("k1") == 1
+    assert kv._internal_kv_get("k1") is None
+    assert kv._internal_kv_del("k", del_by_prefix=True) >= 1

@@ -271,3 +271,17 @@ def test_repartition_shuffle(ray_start_shared):
     out = ds.repartition(5, shuffle=True)
     assert out.num_blocks() == 5
     assert sorted(out.take_all()) == list(range(120))
+
+
+def test_map_groups_equal_keys_across_types(ray_start_shared):
+    """np.int64(1), 1 and 1.0 are one logical group: the hash partitioner
+    must route them to the same partition (regression: pickle-based
+    hashing split them)."""
+    ds = rd.from_items([{"k": np.int64(1), "v": 1},
+                        {"k": 1, "v": 10},
+                        {"k": 1.0, "v": 100},
+                        {"k": 2, "v": 5}])
+    out = ds.groupby(lambda r: r["k"]).map_groups(
+        lambda rows: {"k": rows[0]["k"], "total": sum(r["v"] for r in rows)})
+    rows = sorted(out.take_all(), key=lambda r: float(r["k"]))
+    assert [r["total"] for r in rows] == [111, 5]
