@@ -68,9 +68,22 @@ class _WindowedIter:
     def close(self):
         if not self._closed:
             self._closed = True
-            for _ in self._inflight:
-                self._slots.release()
-            self._inflight = {}
+            inflight, self._inflight = self._inflight, {}
+            if inflight:
+                # The abandoned chunks are still executing remotely: their
+                # slots free only as each chunk resolves, so the pool-wide
+                # `processes` bound holds even across discarded iterators.
+                slots = self._slots
+
+                def reap(refs=list(inflight)):
+                    import ray_tpu
+
+                    while refs:
+                        ready, refs = ray_tpu.wait(refs, num_returns=1)
+                        for _ in ready:
+                            slots.release()
+
+                threading.Thread(target=reap, daemon=True).start()
 
     def __del__(self):
         self.close()
@@ -178,6 +191,7 @@ class Pool:
         # enough for setup-style initializers).
         self._initializer = initializer
         self._initargs = initargs
+        self._results: List[AsyncResult] = []
         self._remote_chunk = ray_tpu.remote(self._make_runner())
 
     def _make_runner(self):
@@ -221,8 +235,10 @@ class Pool:
                     error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
         thunks = self._thunks(fn, [[(tuple(args), kwds or {})]], "call")
-        return AsyncResult(thunks, single=True, slots=self._slots,
-                           callback=callback, error_callback=error_callback)
+        res = AsyncResult(thunks, single=True, slots=self._slots,
+                          callback=callback, error_callback=error_callback)
+        self._track(res)
+        return res
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -234,8 +250,17 @@ class Pool:
                   error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
         thunks = self._thunks(fn, self._chunks(iterable, chunksize), "map")
-        return AsyncResult(thunks, single=False, slots=self._slots,
-                           callback=callback, error_callback=error_callback)
+        res = AsyncResult(thunks, single=False, slots=self._slots,
+                          callback=callback, error_callback=error_callback)
+        self._track(res)
+        return res
+
+    def _track(self, res: AsyncResult):
+        # join() waits on outstanding results; prune finished ones here so
+        # a long-lived pool doesn't pin every past map()'s materialized
+        # values until close().
+        self._results = [r for r in self._results if not r.ready()]
+        self._results.append(res)
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
@@ -294,6 +319,9 @@ class Pool:
     def join(self):
         if not self._closed:
             raise ValueError("Pool is still running")
+        for res in self._results:
+            res.wait()
+        self._results = []
 
     def __enter__(self) -> "Pool":
         return self

@@ -84,6 +84,16 @@ class _RemotePdb(pdb.Pdb):
         return self.do_continue(arg)
 
 
+def _bind_host() -> str:
+    """Debugger listeners bind localhost by default: an attached pdb is
+    arbitrary code execution, so exposing it beyond the node requires the
+    explicit ``RAY_TPU_DEBUGGER_EXTERNAL=1`` opt-in (mirroring the
+    reference's RAY_DEBUGGER_EXTERNAL, `python/ray/util/rpdb.py`)."""
+    if os.environ.get("RAY_TPU_DEBUGGER_EXTERNAL", "") in ("1", "true"):
+        return "0.0.0.0"
+    return "127.0.0.1"
+
+
 def _node_ip() -> str:
     """This node's address as seen by the rest of the cluster: the raylet
     address workers were launched with, else a best-effort local IP."""
@@ -135,10 +145,11 @@ def set_trace(frame=None, timeout_s: float = 300.0):
     """
     listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    listener.bind(("0.0.0.0", 0))  # attachable from other machines
+    bind_host = _bind_host()
+    listener.bind((bind_host, 0))
     listener.listen(1)
     port = listener.getsockname()[1]
-    host = _node_ip()
+    host = _node_ip() if bind_host == "0.0.0.0" else "127.0.0.1"
     frame = frame or sys._getframe().f_back
     entry = {
         "id": f"{os.getpid()}-{port}",
@@ -178,10 +189,11 @@ def post_mortem(tb=None, timeout_s: float = 300.0):
         raise ValueError("no traceback to debug")
     listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    listener.bind(("0.0.0.0", 0))
+    bind_host = _bind_host()
+    listener.bind((bind_host, 0))
     listener.listen(1)
     port = listener.getsockname()[1]
-    host = _node_ip()
+    host = _node_ip() if bind_host == "0.0.0.0" else "127.0.0.1"
     entry = {"id": f"{os.getpid()}-{port}", "host": host, "port": port,
              "pid": os.getpid(), "filename": "<post-mortem>", "lineno": 0,
              "function": "post_mortem", "ts": time.time()}
