@@ -251,8 +251,10 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     """Cancel the task that produces `ref` (reference `ray.cancel`):
     queued tasks are dropped; running tasks are interrupted (force=True
-    kills the worker process). get() on the ref raises
-    TaskCancelledError. Actor tasks cannot be cancelled."""
+    kills the worker process). get() on the ref raises TaskCancelledError.
+    Actor tasks: queued calls cancel; running async calls are interrupted
+    at their next await; running sync calls are uninterruptible and
+    force=True is rejected (it would destroy actor state)."""
     _require_runtime().cancel(ref.object_id, force=force)
 
 

@@ -42,7 +42,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             try:
                 tmp = so_path + f".tmp{os.getpid()}"
                 subprocess.run(
-                    [cc, "-O3", "-shared", "-fPIC", src, "-o", tmp],
+                    [cc, "-O3", "-shared", "-fPIC", "-pthread", src,
+                     "-o", tmp],
                     check=True, capture_output=True, timeout=60)
                 os.replace(tmp, so_path)
                 break
@@ -58,9 +59,15 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.rtpu_gather_copy.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    lib.rtpu_gather_copy_mt.restype = ctypes.c_size_t
+    lib.rtpu_gather_copy_mt.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_int, ctypes.c_int]
     lib.rtpu_copy_at.restype = None
     lib.rtpu_copy_at.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                  ctypes.c_char_p, ctypes.c_size_t]
+    lib.rtpu_prefault.restype = None
+    lib.rtpu_prefault.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     return lib
 
 
@@ -96,10 +103,21 @@ def _addr_len(part: Buffer):
     return arr.ctypes.data, arr.nbytes, arr
 
 
+_MT_THRESHOLD = 8 * 1024 * 1024  # below this, thread spawn overhead dominates
+_MT_SLICE = 8 * 1024 * 1024      # target bytes per copy thread
+
+
+def _copy_threads(total: int) -> int:
+    cpus = os.cpu_count() or 1
+    return max(1, min(16, cpus, total // _MT_SLICE))
+
+
 def gather_copy(dst: memoryview, parts: List[Buffer]) -> int:
     """Copy `parts` back-to-back into `dst` (a writable buffer). Returns
-    bytes written. Uses the native library when available (GIL released),
-    else a numpy byte-view copy (still memcpy-speed, GIL held)."""
+    bytes written. Uses the native library when available (GIL released;
+    large copies pre-fault the destination and split across threads —
+    fresh tmpfs segments are page-fault bound otherwise), else a numpy
+    byte-view copy (still memcpy-speed, GIL held)."""
     lib = get_lib()
     if lib is not None:
         n = len(parts)
@@ -115,8 +133,11 @@ def gather_copy(dst: memoryview, parts: List[Buffer]) -> int:
             total += ln
         dst_addr, dst_len, dst_hold = _addr_len(dst)
         if dst_len >= total and total > 0:
-            return lib.rtpu_gather_copy(
-                ctypes.cast(dst_addr, ctypes.c_char_p), srcs, lens, n)
+            cdst = ctypes.cast(dst_addr, ctypes.c_char_p)
+            if total >= _MT_THRESHOLD:
+                return lib.rtpu_gather_copy_mt(cdst, srcs, lens, n,
+                                               _copy_threads(total))
+            return lib.rtpu_gather_copy(cdst, srcs, lens, n)
         if total == 0:
             return 0
     # Fallback: numpy byte views (fast path vs raw memoryview assignment).

@@ -57,7 +57,7 @@ class ActorHandle:
         import ray_tpu
 
         runtime = ray_tpu._require_runtime()
-        ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
+        ser_args, kwargs_keys, nested_refs = runtime.serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self._ray_actor_id),
             job_id=runtime.job_id,
@@ -70,6 +70,7 @@ class ActorHandle:
             actor_id=self._ray_actor_id,
             method_name=method_name,
             owner_address=runtime.worker_id.hex(),
+            nested_refs=nested_refs,
         )
         return_ids = runtime.submit_actor_task(spec)
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -176,7 +177,7 @@ class ActorClass:
                     placement_resources = {name: min(1.0, amt)}
         else:
             placement_resources = dict(resources) if explicit else {"CPU": 1.0}
-        ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
+        ser_args, kwargs_keys, nested_refs = runtime.serialize_args(args, kwargs)
         actor_id = ActorID.of(runtime.job_id)
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
@@ -202,6 +203,7 @@ class ActorClass:
             placement_group_bundle_index=bundle_idx,
             owner_address=runtime.worker_id.hex(),
             runtime_env=opts.get("runtime_env"),
+            nested_refs=nested_refs,
         )
         runtime.create_actor(spec)
         return ActorHandle(actor_id, self._cls.__name__)

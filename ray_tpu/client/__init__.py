@@ -156,22 +156,25 @@ class ClientRuntime:
         return fn_id
 
     def serialize_args(self, args, kwargs):
-        from ray_tpu.object_ref import ObjectRef
+        from ray_tpu.object_ref import ObjectRef, _NestedRefCapture
 
         out = []
+        nested = []
         flat = list(args) + list(kwargs.values())
         for a in flat:
             if isinstance(a, ObjectRef):
                 out.append(("r", a.object_id))
             else:
-                blob = serialization.serialize_to_bytes(a)
+                with _NestedRefCapture() as captured:
+                    blob = serialization.serialize_to_bytes(a)
+                nested.extend(captured)
                 if len(blob) > GLOBAL_CONFIG.object_inline_max_bytes:
                     # Promoted args live with the job (no per-client pin —
                     # nothing client-side would ever drop the ref).
                     out.append(("r", self.put(a, _register=False)))
                 else:
                     out.append(("v", blob))
-        return out, list(kwargs.keys())
+        return out, list(kwargs.keys()), nested
 
     def submit_task(self, spec) -> List:
         spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)

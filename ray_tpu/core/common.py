@@ -107,6 +107,14 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     owner_address: Optional[str] = None         # submitter's callback address (raylet conn)
     runtime_env: Optional[Dict[str, Any]] = None
+    # Executed over the owner's direct worker-lease channel (bypassing the
+    # per-task raylet hop); results then follow actor-result visibility
+    # rules (lazy directory publication by the owner).
+    direct: bool = False
+    # Refs pickled INSIDE argument values (not top-level): pinned by the
+    # owner until the task completes, by which time the executing worker
+    # has registered its borrow (reference reference_count.h borrowers).
+    nested_refs: List["ObjectID"] = field(default_factory=list)
     # Provenance for state API / timeline
     submitted_at: float = field(default_factory=time.time)
 

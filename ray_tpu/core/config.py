@@ -41,6 +41,9 @@ def _parse_bool(v) -> bool:
 _flag("raylet_heartbeat_period_ms", int, 1000, "Raylet -> GCS resource report period")
 _flag("runtime_env_cache_bytes", int, 1 << 30,
       "LRU byte cap for runtime_env packages in the GCS KV")
+_flag("runtime_env_eviction_grace_s", float, 300.0,
+      "Never LRU-evict a runtime_env blob accessed this recently (in-flight "
+      "task specs may still reference it)")
 _flag("health_check_period_ms", int, 2000, "GCS node health check period")
 _flag("health_check_failure_threshold", int, 5, "Missed health checks before a node is marked dead")
 _flag("worker_lease_timeout_ms", int, 30000, "Max time waiting for a worker lease")
@@ -57,6 +60,15 @@ _flag("scheduler_top_k_fraction", float, 0.2, "Hybrid policy: random choice amon
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: utilization below which packing is preferred")
 _flag("rpc_connect_timeout_s", float, 10.0, "TCP connect timeout for internal RPC")
 _flag("rpc_call_timeout_s", float, 120.0, "Default RPC call timeout")
+_flag("direct_task_enabled", _parse_bool, True,
+      "Lease-cached direct-to-worker submission for eligible normal tasks")
+_flag("direct_pipeline_depth", int, 2,
+      "Task specs in flight per leased worker (keeps the worker busy while "
+      "a result is on the wire)")
+_flag("direct_max_leases", int, 16,
+      "Max concurrent worker leases per scheduling key per owner")
+_flag("direct_lease_idle_s", float, 2.0,
+      "Idle time before a cached worker lease is returned to the raylet")
 _flag("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for pubsub subscribers")
 _flag("event_stats", bool, False, "Record per-handler event loop stats")
 _flag("task_events_max_buffer", int, 100000, "Max task events retained by the GCS task manager")

@@ -109,10 +109,13 @@ class GcsServer:
         self.jobs: Dict[JobID, JobInfo] = {}
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
         self._kv_access_order: Dict[Tuple[str, bytes], int] = {}
+        self._kv_access_ts: Dict[Tuple[str, bytes], float] = {}
         self._kv_access_tick = 0
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         # Object directory: object_id -> {nodes: set[NodeID], size, inline: bytes|None, owner}
         self.objects: Dict[ObjectID, Dict[str, Any]] = {}
+        # borrower worker hex -> objects it borrows (cleanup on death)
+        self.borrower_index: Dict[str, set] = {}
         # Task events ring buffer for the state API / timeline
         self.task_events: deque = deque(maxlen=GLOBAL_CONFIG.task_events_max_buffer)
         # Metric snapshots per reporting process (TTL-expired)
@@ -420,8 +423,15 @@ class GcsServer:
 
     # --------------------------------------------------------------- KV store
 
+    @staticmethod
+    def _kv_key(key):
+        # Callers mix str and bytes keys (internal_kv uses bytes, rpdb and
+        # friends use str); normalize to bytes so prefix scans never hit a
+        # str/bytes startswith type mismatch.
+        return key.encode() if isinstance(key, str) else key
+
     def handle_kv_put(self, conn: Connection, data: Dict[str, Any]):
-        ns, key = data.get("namespace", ""), data["key"]
+        ns, key = data.get("namespace", ""), self._kv_key(data["key"])
         overwrite = data.get("overwrite", True)
         with self._lock:
             exists = (ns, key) in self.kv
@@ -429,10 +439,14 @@ class GcsServer:
                 return {"added": False, "existed": True}
             self.kv[(ns, key)] = data["value"]
             if ns == "runtime_env":
-                self._kv_access_tick += 1
-                self._kv_access_order[(ns, key)] = self._kv_access_tick
+                self._kv_touch_locked((ns, key))
                 self._evict_runtime_env_locked(keep=(ns, key))
         return {"added": True, "existed": exists}
+
+    def _kv_touch_locked(self, key):
+        self._kv_access_tick += 1
+        self._kv_access_order[key] = self._kv_access_tick
+        self._kv_access_ts[key] = time.time()
 
     def _evict_runtime_env_locked(self, keep):
         """LRU-cap runtime_env package blobs: the KV is in-memory, and a
@@ -442,6 +456,7 @@ class GcsServer:
         from ray_tpu.core.config import GLOBAL_CONFIG
 
         cap = GLOBAL_CONFIG.runtime_env_cache_bytes
+        grace = GLOBAL_CONFIG.runtime_env_eviction_grace_s
         entries = [(k, len(v)) for k, v in self.kv.items()
                    if k[0] == "runtime_env"]
         total = sum(s for _, s in entries)
@@ -449,46 +464,56 @@ class GcsServer:
             return
         order = self._kv_access_order  # key -> monotonically increasing tick
         entries.sort(key=lambda kv: order.get(kv[0], 0))
+        now = time.time()
         for k, size in entries:
             if k == keep or total <= cap:
                 continue
+            # A blob touched recently may still be referenced by queued or
+            # leased task specs whose workers haven't materialized it yet;
+            # evicting it would crash-loop those workers until the driver's
+            # EnvCache revalidates. Let the cap be transiently exceeded
+            # instead (reference pins in-use URIs: `runtime_env/uri_cache.py`).
+            if now - self._kv_access_ts.get(k, 0.0) < grace:
+                continue
             del self.kv[k]
             order.pop(k, None)
+            self._kv_access_ts.pop(k, None)
             total -= size
 
     def handle_kv_get(self, conn: Connection, data: Dict[str, Any]):
-        key = (data.get("namespace", ""), data["key"])
+        key = (data.get("namespace", ""), self._kv_key(data["key"]))
         with self._lock:
             if key[0] == "runtime_env" and key in self.kv:
-                self._kv_access_tick += 1
-                self._kv_access_order[key] = self._kv_access_tick
+                self._kv_touch_locked(key)
             return {"value": self.kv.get(key)}
 
     def handle_kv_del(self, conn: Connection, data: Dict[str, Any]):
-        ns, key = data.get("namespace", ""), data["key"]
+        ns, key = data.get("namespace", ""), self._kv_key(data["key"])
         with self._lock:
             if data.get("prefix"):
                 doomed = [k for k in self.kv if k[0] == ns and k[1].startswith(key)]
                 for k in doomed:
                     del self.kv[k]
                     self._kv_access_order.pop(k, None)
+                    self._kv_access_ts.pop(k, None)
                 return {"deleted": len(doomed)}
             self._kv_access_order.pop((ns, key), None)
+            self._kv_access_ts.pop((ns, key), None)
             return {"deleted": int(self.kv.pop((ns, key), None) is not None)}
 
     def handle_kv_keys(self, conn: Connection, data: Dict[str, Any]):
-        ns, prefix = data.get("namespace", ""), data.get("prefix", b"")
+        ns = data.get("namespace", "")
+        prefix = self._kv_key(data.get("prefix", b""))
         with self._lock:
             return {"keys": [k[1] for k in self.kv if k[0] == ns and k[1].startswith(prefix)]}
 
     def handle_kv_exists(self, conn: Connection, data: Dict[str, Any]):
-        key = (data.get("namespace", ""), data["key"])
+        key = (data.get("namespace", ""), self._kv_key(data["key"]))
         with self._lock:
             exists = key in self.kv
             if exists and key[0] == "runtime_env":
                 # Liveness probes keep in-use packages warm in the LRU.
-                self._kv_access_tick += 1
-                self._kv_access_order[key] = self._kv_access_tick
+                self._kv_touch_locked(key)
             return {"exists": exists}
 
     # ------------------------------------------------------- object directory
@@ -519,6 +544,25 @@ class GcsServer:
     def handle_object_locations_get(self, conn: Connection, data: Dict[str, Any]):
         return self._object_entry_public(data["object_id"])
 
+    def handle_object_locations_batch(self, conn: Connection, data: Dict[str, Any]):
+        """Bulk location metadata for locality-aware placement: nodes and
+        sizes only (inline payloads are elided — a scheduler scoring
+        resident bytes must not drag the bytes over the wire)."""
+        out = []
+        with self._lock:
+            for oid in data["object_ids"]:
+                entry = self.objects.get(oid)
+                if entry is None:
+                    out.append({"known": False})
+                else:
+                    out.append({
+                        "known": True,
+                        "nodes": list(entry["nodes"]),
+                        "size": entry["size"],
+                        "has_inline": entry["inline"] is not None,
+                    })
+        return {"entries": out}
+
     def _object_entry_public(self, oid: ObjectID) -> Dict[str, Any]:
         with self._lock:
             entry = self.objects.get(oid)
@@ -533,20 +577,95 @@ class GcsServer:
             }
 
     def handle_free_objects(self, conn: Connection, data: Dict[str, Any]):
+        """Owner dropped its last reference. An object still borrowed by
+        another process (reference `reference_count.h:61,494-500` borrower
+        bookkeeping, redesigned GCS-mediated: borrowers register against
+        the directory entry instead of long-polling the owner) is only
+        MARKED pending-free; the actual free runs when the last borrower
+        leaves (handle_borrow_remove)."""
         oids: List[ObjectID] = data["object_ids"]
         by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
         with self._lock:
             for oid in oids:
-                entry = self.objects.pop(oid, None)
-                if entry:
-                    for node_id in entry["nodes"]:
-                        by_node[node_id].append(oid)
+                entry = self.objects.get(oid)
+                if entry is None:
+                    continue
+                if entry.get("borrowers"):
+                    entry["pending_free"] = True
+                    continue
+                self.objects.pop(oid, None)
+                for node_id in entry["nodes"]:
+                    by_node[node_id].append(oid)
+        self._delete_on_nodes(by_node)
+        return {}
+
+    def _delete_on_nodes(self, by_node: Dict[NodeID, List[ObjectID]]):
         for node_id, node_oids in by_node.items():
             try:
                 self._raylet(node_id).call("delete_objects", {"object_ids": node_oids}, timeout=5)
             except Exception:
                 pass
+
+    def handle_borrow_add(self, conn: Connection, data: Dict[str, Any]):
+        """A non-owner process deserialized reference(s) to object(s):
+        keep them alive past the owner's free until the borrower drops
+        them. Registered synchronously by the borrower at ref
+        deserialization, while the owner's submit-time pin still holds, so
+        the handoff can't race the owner's free. `object_ids` batches one
+        deserialization's worth of refs into a single round trip."""
+        borrower = data["borrower_id"]
+        oids = data.get("object_ids") or [data["object_id"]]
+        with self._lock:
+            for oid in oids:
+                entry = self.objects.setdefault(
+                    oid, {"nodes": set(), "size": 0, "inline": None,
+                          "owner": None})
+                entry.setdefault("borrowers", set()).add(borrower)
+                self.borrower_index.setdefault(borrower, set()).add(oid)
         return {}
+
+    def _remove_borrow_locked(self, oid: ObjectID, borrower: str,
+                              by_node: Dict[NodeID, List[ObjectID]]):
+        entry = self.objects.get(oid)
+        if entry is None:
+            return
+        borrowers = entry.get("borrowers")
+        if borrowers is not None:
+            borrowers.discard(borrower)
+        if not borrowers and entry.get("pending_free"):
+            self.objects.pop(oid, None)
+            for node_id in entry["nodes"]:
+                by_node[node_id].append(oid)
+
+    def handle_borrow_remove(self, conn: Connection, data: Dict[str, Any]):
+        oid: ObjectID = data["object_id"]
+        borrower = data["borrower_id"]
+        by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
+        with self._lock:
+            held = self.borrower_index.get(borrower)
+            if held is not None:
+                held.discard(oid)
+                if not held:
+                    self.borrower_index.pop(borrower, None)
+            self._remove_borrow_locked(oid, borrower, by_node)
+        self._delete_on_nodes(by_node)
+        return {}
+
+    def handle_borrower_gone(self, conn: Connection, data: Dict[str, Any]):
+        """A borrower process exited (graceful shutdown flush, or its
+        raylet reporting the worker's death): drop every borrow it held so
+        pending frees fire instead of leaking store bytes. Borrowers on a
+        node that dies WITH its raylet are not reported and leak until
+        owner + cluster restart (reference has the same window — borrower
+        death detection rides the raylet)."""
+        borrower = data["borrower_id"]
+        by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
+        with self._lock:
+            held = self.borrower_index.pop(borrower, set())
+            for oid in held:
+                self._remove_borrow_locked(oid, borrower, by_node)
+        self._delete_on_nodes(by_node)
+        return {"dropped": len(held)}
 
     # ------------------------------------------------------- actor management
 
@@ -920,6 +1039,19 @@ class GcsServer:
                     "bundle_locations": {i: n for i, n in pg.bundle_locations.items()},
                     "bundles": pg.bundles, "strategy": pg.strategy.value,
                     "name": pg.name}
+
+    def handle_get_named_placement_group(self, conn: Connection,
+                                         data: Dict[str, Any]):
+        """Lookup by name (reference `ray.util.get_placement_group` ->
+        GcsPlacementGroupManager name index)."""
+        name = data["name"]
+        with self._lock:
+            for pg in self.placement_groups.values():
+                if pg.name == name and pg.state != "REMOVED":
+                    return {"found": True, "pg_id": pg.pg_id,
+                            "bundles": pg.bundles,
+                            "strategy": pg.strategy.value}
+        return {"found": False}
 
     # --------------------------------------------------------- task events
 

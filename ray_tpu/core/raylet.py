@@ -221,9 +221,16 @@ class WorkerPool:
         with self._lock:
             return self._starting
 
-    def num_alive(self) -> int:
+    def num_alive(self, include_actors: bool = True) -> int:
+        """Live workers. The pool cap governs *task* workers: dedicated
+        actor workers are bounded by their own resource grants, and
+        counting them would wedge a node whose pool fills with actors
+        (no task worker could ever spawn — reference worker_pool.h keeps
+        dedicated workers outside the idle-pool cap)."""
         with self._lock:
-            return sum(1 for h in self._workers.values() if h.state != "dead")
+            return sum(1 for h in self._workers.values()
+                       if h.state != "dead"
+                       and (include_actors or not h.is_actor))
 
     def mark_dead(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
         with self._lock:
@@ -292,6 +299,10 @@ class QueuedTask:
     submitter: Connection
     deps_remaining: Set[ObjectID] = field(default_factory=set)
     queued_at: float = field(default_factory=time.monotonic)
+    # Worker-lease request (reference `RequestWorkerLease`,
+    # `direct_task_transport.h`): when dispatched, the worker is granted to
+    # the submitter for direct task pushes instead of receiving a task.
+    lease_req_id: Optional[bytes] = None
 
 
 class Raylet:
@@ -359,6 +370,9 @@ class Raylet:
         self._node_info: Optional[NodeInfo] = None
         self._peer_clients: Dict[str, RpcClient] = {}
         self._threads: List[threading.Thread] = []
+        # Granted worker leases: lease_id -> {worker, resources, conn}
+        # (reference `leased_workers_` in node_manager.h).
+        self._leases: Dict[bytes, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -499,6 +513,108 @@ class Raylet:
         self._enqueue(spec, conn)
         return {"status": "queued"}
 
+    def handle_request_worker_lease(self, conn: Connection, data: Dict[str, Any]):
+        """Grant a worker to the caller for direct task pushes (reference
+        `NodeManager::HandleRequestWorkerLease`, node_manager.cc). The
+        request queues like a task; the grant arrives as a `lease_granted`
+        push once a worker + resources are available."""
+        spec: TaskSpec = data["spec"]
+        grant_or_reject = data.get("grant_or_reject", False)
+        target = self._choose_node(spec)
+        if target is not None and target != self.node_id.hex() and not grant_or_reject:
+            addr = self._cluster_view.get(target, {}).get("address")
+            if addr:
+                return {"status": "spillback", "address": addr}
+        qt = QueuedTask(spec=spec, submitter=conn,
+                        lease_req_id=data["req_id"])
+        with self._lock:
+            self._queue.append(qt)
+        self._dispatch_event.set()
+        return {"status": "pending"}
+
+    def handle_cancel_lease_request(self, conn: Connection, data: Dict[str, Any]):
+        """Owner no longer needs a queued worker lease (demand drained) —
+        reference `CancelWorkerLease` (node_manager.cc). Queued requests
+        are dropped; already-granted ones are returned by the owner."""
+        req_ids = set(data["req_ids"])
+        with self._lock:
+            doomed = [qt for qt in self._queue
+                      if qt.lease_req_id is not None
+                      and qt.lease_req_id in req_ids]
+            for qt in doomed:
+                self._queue.remove(qt)
+        return {"cancelled": len(doomed)}
+
+    def handle_return_worker_lease(self, conn: Connection, data: Dict[str, Any]):
+        lease_id: bytes = data["lease_id"]
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return {"returned": False}
+        worker: WorkerHandle = lease["worker"]
+        # Exactly-once via the held_resources swap: a concurrent worker
+        # death releases through the same helper and whoever swaps first
+        # wins (releasing lease["resources"] directly would double-free).
+        self._release_held_resources(worker)
+        if worker.state != "dead":
+            self.pool.push_idle(worker)
+        self._dispatch_event.set()
+        return {"returned": True}
+
+    def _grant_lease(self, worker: WorkerHandle, qt: QueuedTask):
+        """Worker + resources acquired for a lease request: hand the worker
+        to the requester over its push channel."""
+        lease_id = os.urandom(16)
+        worker.held_resources = dict(qt.spec.resources)
+        with self._lock:
+            self._leases[lease_id] = {
+                "worker": worker, "resources": dict(qt.spec.resources),
+                "conn": qt.submitter,
+            }
+        try:
+            qt.submitter.push("lease_granted", {
+                "req_id": qt.lease_req_id, "lease_id": lease_id,
+                "address": worker.direct_address,
+                "raylet_address": self.server.address,
+                "node_id": self.node_id,
+                "worker_id": worker.worker_id,
+            })
+        except Exception:  # noqa: BLE001 — requester gone: unwind the grant
+            with self._lock:
+                self._leases.pop(lease_id, None)
+            self._release_held_resources(worker)
+            self.pool.push_idle(worker)
+
+    def _reclaim_conn_leases(self, conn: Connection):
+        """A lease holder disconnected: its workers may be running orphaned
+        tasks — kill them (reference: leased workers are destroyed when the
+        owner dies, node_manager.cc HandleUnexpectedWorkerFailure)."""
+        with self._lock:
+            doomed = [(lid, l) for lid, l in self._leases.items()
+                      if l["conn"] is conn]
+            for lid, _ in doomed:
+                self._leases.pop(lid, None)
+        for _, lease in doomed:
+            worker: WorkerHandle = lease["worker"]
+            # held_resources carries the lease grant; _on_worker_dead
+            # releases it exactly once.
+            self._on_worker_dead(worker, "lease holder disconnected")
+            if worker.proc is not None and worker.proc.poll() is None:
+                try:
+                    worker.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+        if doomed:
+            self._dispatch_event.set()
+
+    def handle_direct_task_event(self, conn: Connection, data: Dict[str, Any]):
+        """Task lifecycle events for directly-executed tasks, reported by
+        the worker (the raylet never sees these tasks' dispatch)."""
+        with self._lock:
+            for ev in data["events"]:
+                self._task_event_buffer.append(ev)
+        return {}
+
     def _choose_node(self, spec: TaskSpec) -> Optional[str]:
         """Hybrid scheduling policy over the gossiped cluster view
         (reference `policy/hybrid_scheduling_policy.h`): prefer local while
@@ -531,6 +647,15 @@ class Raylet:
             ordered = sorted(feasible_nodes)
             return ordered[self._spread_rr % len(ordered)]
         local = view.get(my_hex)
+        # Data locality (reference `lease_policy.h:56` LocalityAwareLeasePolicy):
+        # a task consuming large resident objects runs where the bytes are
+        # instead of pulling them across the network.
+        best_data = self._best_data_node(spec)
+        if best_data is not None and best_data != my_hex:
+            entry = view.get(best_data)
+            if entry is not None and entry.get("alive") and feasible(entry) \
+                    and available_now(entry):
+                return best_data
         if local is not None and feasible(local) and available_now(local):
             return my_hex
         ready = [nid for nid in feasible_nodes if available_now(view[nid])]
@@ -544,6 +669,41 @@ class Raylet:
         if feasible_nodes:
             return feasible_nodes[0]
         return my_hex if local is not None else None
+
+    # Below this, pulling is cheap enough that resource-based placement wins.
+    _LOCALITY_MIN_BYTES = 1 << 20
+
+    def _best_data_node(self, spec: TaskSpec) -> Optional[str]:
+        """Node holding the most resident bytes of the task's ref args, or
+        None when deps are absent/small/inline/local. One batched GCS
+        lookup, only paid by dep-carrying tasks whose bytes are NOT
+        already here (the common fast paths never leave the process).
+        NEVER call while holding self._lock — the GCS round trip would
+        stall every other handler on the node."""
+        deps = spec.dependencies()
+        if not deps:
+            return None
+        if all(self.store.contains(d) for d in deps):
+            return None  # everything local: plain placement wins, no RPC
+        try:
+            entries = self.gcs.call("object_locations_batch",
+                                    {"object_ids": deps}, timeout=5)["entries"]
+        except Exception:  # noqa: BLE001 — locality is advisory
+            return None
+        per_node: Dict[str, float] = {}
+        for e in entries:
+            if not e.get("known") or e.get("has_inline"):
+                continue
+            size = e.get("size", 0)
+            if size < self._LOCALITY_MIN_BYTES:
+                continue
+            for nid in e.get("nodes", ()):
+                key = nid.hex() if hasattr(nid, "hex") else str(nid)
+                per_node[key] = per_node.get(key, 0) + size
+        if not per_node:
+            return None
+        best = max(per_node, key=per_node.get)
+        return best if per_node[best] >= self._LOCALITY_MIN_BYTES else None
 
     def _enqueue(self, spec: TaskSpec, submitter: Connection):
         qt = QueuedTask(spec=spec, submitter=submitter)
@@ -564,14 +724,18 @@ class Raylet:
         them to their submitter for re-routing (it re-runs the normal
         submit path, which spills to the capable node)."""
         with self._lock:
-            candidates = []
-            for qt in list(self._queue):
-                if qt.deps_remaining or \
-                        self.resources.feasible(qt.spec.resources):
-                    continue
-                target = self._choose_node(qt.spec)
-                if target is not None and target != self.node_id.hex():
-                    candidates.append(qt)
+            snapshot = [qt for qt in self._queue
+                        if not qt.deps_remaining
+                        and not self.resources.feasible(qt.spec.resources)]
+        # _choose_node may consult the GCS (data locality): keep it OUTSIDE
+        # the lock — a slow GCS must not freeze dispatch for the node.
+        candidates = []
+        for qt in snapshot:
+            target = self._choose_node(qt.spec)
+            if target is not None and target != self.node_id.hex():
+                candidates.append(qt)
+        with self._lock:
+            candidates = [qt for qt in candidates if qt in self._queue]
             for qt in candidates:
                 self._queue.remove(qt)
                 self._task_submitters.pop(qt.spec.task_id.binary(), None)
@@ -647,10 +811,12 @@ class Raylet:
                 # hosts. Pool size targets the node's CPU count (reference
                 # worker_pool.h:347 prestarts one worker per core).
                 if (self.pool.num_starting() < self._spawn_parallelism
-                        and self.pool.num_alive() < self.pool.max_workers
+                        and self.pool.num_alive(include_actors=False)
+                        < self.pool.max_workers
                         and self.pool.spawn_allowed()):
                     self.pool.spawn_worker(env_extra=env)
-                elif self.pool.num_alive() >= self.pool.max_workers:
+                elif self.pool.num_alive(include_actors=False) \
+                        >= self.pool.max_workers:
                     # Pool full of env-incompatible workers: retire one so a
                     # compatible worker can be spawned on the next pass.
                     stale = self.pool.pop_idle_mismatched(env)
@@ -666,7 +832,15 @@ class Raylet:
                 with self._lock:
                     self._queue.appendleft(qt)
                 return
-            self._dispatch_to(worker, qt)
+            if qt.lease_req_id is not None:
+                if qt.submitter is None or not qt.submitter.alive:
+                    # Requester died while the lease waited in queue.
+                    self.resources.release(qt.spec.resources)
+                    self.pool.push_idle(worker)
+                else:
+                    self._grant_lease(worker, qt)
+            else:
+                self._dispatch_to(worker, qt)
             progressed = True
 
     def _env_for(self, spec: TaskSpec) -> Dict[str, str]:
@@ -782,10 +956,14 @@ class Raylet:
             oid: ObjectID = r["object_id"]
             if r["kind"] == "inline":
                 try:
-                    self.gcs.call("object_location_add",
-                                  {"object_id": oid, "inline": r["data"],
-                                   "size": len(r["data"]),
-                                   "owner": spec.owner_address}, timeout=10)
+                    # Pipelined: the submitter gets results directly via the
+                    # task_result push; the directory entry only serves
+                    # later cross-node dependents, so the completion path
+                    # need not wait a GCS round trip per task.
+                    self.gcs.call_async(
+                        "object_location_add",
+                        {"object_id": oid, "inline": r["data"],
+                         "size": len(r["data"]), "owner": spec.owner_address})
                 except Exception:
                     logger.warning("failed to register inline object %s", oid)
             else:  # sealed into the node store by the worker
@@ -859,9 +1037,24 @@ class Raylet:
         handle = self.pool.mark_dead(handle.worker_id)
         if handle is None:
             return
+        with self._lock:
+            # A leased worker dying invalidates its lease record (a late
+            # return_worker_lease must not double-release the resources —
+            # held_resources below releases them exactly once).
+            stale = [lid for lid, l in self._leases.items()
+                     if l["worker"] is handle]
+            for lid in stale:
+                self._leases.pop(lid, None)
         self._release_held_resources(handle)
         logger.warning("worker %s (pid %s) died: %s", handle.worker_id.hex()[:12],
                        handle.pid, reason)
+        try:
+            # Release any object borrows the dead worker held (the owner's
+            # pending frees would otherwise leak store bytes forever).
+            self.gcs.call_async("borrower_gone",
+                                {"borrower_id": handle.worker_id.hex()})
+        except Exception:  # noqa: BLE001
+            pass
         spec = handle.current_task
         if spec is not None:
             task_id_b = spec.task_id.binary()
@@ -909,6 +1102,7 @@ class Raylet:
         handle = self.pool.by_conn(conn)
         if handle is not None and handle.state != "dead":
             self._on_worker_dead(handle, "connection lost")
+        self._reclaim_conn_leases(conn)
         # Submitter connections: drop pending notification targets.
         with self._lock:
             doomed = [t for t, c in self._task_submitters.items() if c is conn]

@@ -301,9 +301,20 @@ class RpcClient:
                     with self._pending_lock:
                         slot = self._pending.pop(envelope["i"], None)
                     if slot is not None:
-                        slot["env"] = envelope
-                        slot["payload"] = payload
-                        slot["event"].set()
+                        cb = slot.get("cb")
+                        if cb is not None:
+                            # Async-call completion: runs ON the reader
+                            # thread — callbacks must be quick and must not
+                            # block on RPCs over this same client.
+                            try:
+                                cb(envelope, payload)
+                            except Exception:
+                                logger.exception("%s async callback failed",
+                                                 self._name)
+                        else:
+                            slot["env"] = envelope
+                            slot["payload"] = payload
+                            slot["event"].set()
                 elif kind == "push":
                     if self._push_handler is not None:
                         self._push_queue.put((envelope["m"], payload))
@@ -315,16 +326,61 @@ class RpcClient:
                             self.address, reason)
             self._closed.set()
             with self._pending_lock:
-                for slot in self._pending.values():
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for slot in pending:
+                cb = slot.get("cb")
+                if cb is not None:
+                    try:
+                        cb({"e": "connection lost", "_lost": True}, b"")
+                    except Exception:
+                        logger.exception("%s async callback failed",
+                                         self._name)
+                else:
                     slot["env"] = {"e": "connection lost", "_lost": True}
                     slot["payload"] = b""
                     slot["event"].set()
-                self._pending.clear()
             if self.on_close is not None:
                 try:
                     self.on_close()
                 except Exception:
                     logger.exception("%s on_close callback failed", self._name)
+
+    def call_async(self, method: str, data: Any = None,
+                   callback: Optional[Callable[[dict, bytes], None]] = None):
+        """Pipelined request: send without waiting for the response.
+
+        With `callback`, it is invoked as callback(envelope, payload) on the
+        reader thread when the response (or connection loss: envelope has
+        `_lost`) arrives — keep it quick and never block on RPCs over this
+        client. Without, the response is dropped (fire-and-forget). This is
+        the submission fast path: N tasks cost N sends, not N round trips.
+        """
+        if self._closed.is_set():
+            raise ConnectionLost(
+                f"{self._name}: connection to {self.address} is closed")
+        msg_id = next(self._msg_counter)
+        if callback is not None:
+            with self._pending_lock:
+                self._pending[msg_id] = {"cb": callback}
+            if self._closed.is_set():
+                # Connection died between the check above and the slot
+                # insert: the reader's drain may have missed this slot, so
+                # deliver the loss ourselves (pop decides the winner).
+                with self._pending_lock:
+                    slot = self._pending.pop(msg_id, None)
+                if slot is not None:
+                    callback({"e": "connection lost", "_lost": True}, b"")
+                return
+        payload = serialization.dumps(data)
+        try:
+            _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method},
+                      payload, self._send_lock)
+        except OSError as e:
+            self._closed.set()
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise ConnectionLost(str(e))
 
     def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
         if self._closed.is_set():
@@ -414,6 +470,16 @@ class ReconnectingClient:
         except ConnectionLost:
             client = self._reconnect()
             return client.call(method, data, timeout=timeout)
+
+    def call_async(self, method: str, data: Any = None, callback=None):
+        """Pipelined send (see RpcClient.call_async); re-dials once."""
+        if self._terminal:
+            raise ConnectionLost(f"{self._name}: client closed")
+        try:
+            return self._client.call_async(method, data, callback)
+        except ConnectionLost:
+            client = self._reconnect()
+            return client.call_async(method, data, callback)
 
     def close(self):
         self._terminal = True

@@ -61,6 +61,9 @@ class ActorClient:
         self.actor_id = actor_id
         self.address = address
         self.seq = 0
+        # Held across seq assignment + send so the wire order matches seq
+        # order even with concurrent submitters.
+        self.lock = threading.Lock()
         self.client = RpcClient(
             address, name=f"actor-{actor_id.hex()[:8]}",
             push_handler=runtime._on_raylet_push,
@@ -111,6 +114,10 @@ class CoreRuntime:
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
         self._free_buffer: List[ObjectID] = []
         self._free_timer: Optional[threading.Timer] = None
+        self._bg_executor = None  # lazy ThreadPoolExecutor for resubmits
+        from ray_tpu.core.direct_task import DirectTaskTransport
+
+        self._direct = DirectTaskTransport(self)
         # Actor-call inline results ride the direct push channel and are
         # NOT in the cluster object directory; when such a ref is passed as
         # a task argument it must be published first (lazily — most actor
@@ -123,6 +130,12 @@ class CoreRuntime:
         self._ref_counts: Dict[bytes, int] = defaultdict(int)
         self._dep_pins: Dict[bytes, int] = defaultdict(int)
         self._deferred_free: set = set()
+        # Borrower protocol (reference reference_count.h:61,494-500):
+        # objects this process OWNS (it may free them on last drop) vs
+        # objects it merely BORROWS (deserialized refs — last drop removes
+        # this process from the GCS borrower set instead of freeing).
+        self._owned_puts: set = set()
+        self._borrowed: set = set()
         # Event-driven object availability: the raylet pushes
         # object_ready/object_unavailable instead of this process polling.
         # oid -> [Event, refcount]; refcounted so concurrent getters of the
@@ -205,8 +218,8 @@ class CoreRuntime:
             # as a task dependency before the result arrived. Runs after
             # event.set() so _ensure_dep_visible's is_set() check plus the
             # locked set-pop below give exactly-once publication.
-            if rec.spec is not None and rec.spec.actor_id is not None and \
-                    rec.results:
+            if rec.spec is not None and rec.results and \
+                    (rec.spec.actor_id is not None or rec.spec.direct):
                 with self._lock:
                     pending = [r for r in rec.results
                                if r["object_id"].binary()
@@ -221,8 +234,15 @@ class CoreRuntime:
             # A raylet returned a queued task it can never run (the cluster
             # grew): resubmit through the normal routing path.
             spec = data["spec"]
-            threading.Thread(target=self._resubmit_respilled, args=(spec,),
-                             daemon=True).start()
+            from ray_tpu.core.direct_task import LEASE_SPEC_NAME
+
+            if spec.name == LEASE_SPEC_NAME:
+                self._direct.on_lease_respill(spec)
+            else:
+                threading.Thread(target=self._resubmit_respilled,
+                                 args=(spec,), daemon=True).start()
+        elif method == "lease_granted":
+            self._direct.on_lease_granted(data)
         elif method in ("object_ready", "object_unavailable"):
             entry = self._object_events.get(data["object_id"].binary())
             if entry is not None:
@@ -292,6 +312,8 @@ class CoreRuntime:
         return oid
 
     def put_with_id(self, oid: ObjectID, value: Any):
+        with self._lock:
+            self._owned_puts.add(oid.binary())
         parts = serialization.serialize(value)
         size = serialization.serialized_size(parts)
         if size <= GLOBAL_CONFIG.object_inline_max_bytes:
@@ -331,23 +353,32 @@ class CoreRuntime:
         return fn_id
 
     def serialize_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
-                       ) -> Tuple[List[Tuple[str, Any]], List[str]]:
-        """Inline small args; promote large ones to the store; pass refs through."""
-        from ray_tpu.object_ref import ObjectRef
+                       ) -> Tuple[List[Tuple[str, Any]], List[str],
+                                  List[ObjectID]]:
+        """Inline small args; promote large ones to the store; pass refs
+        through. Refs nested inside argument values are captured during
+        pickling: the spec carries them (`nested_refs`) so the owner pins
+        them until the executing worker has registered its borrow."""
+        from ray_tpu.object_ref import ObjectRef, _NestedRefCapture
 
         out: List[Tuple[str, Any]] = []
+        nested: List[ObjectID] = []
         flat = list(args) + list(kwargs.values())
         for a in flat:
             if isinstance(a, ObjectRef):
                 self._ensure_dep_visible(a.object_id)
                 out.append(("r", a.object_id))
             else:
-                blob = serialization.serialize_to_bytes(a)
+                with _NestedRefCapture() as captured:
+                    blob = serialization.serialize_to_bytes(a)
+                nested.extend(captured)
                 if len(blob) > GLOBAL_CONFIG.object_inline_max_bytes:
                     out.append(("r", self.put(a)))
                 else:
                     out.append(("v", blob))
-        return out, list(kwargs.keys())
+        for oid in nested:
+            self._ensure_dep_visible(oid)
+        return out, list(kwargs.keys()), nested
 
     def _ensure_dep_visible(self, oid: ObjectID):
         """Make an actor-call result usable as a task dependency: publish
@@ -362,8 +393,9 @@ class CoreRuntime:
             self._published_deps.add(key)
             task_key = self._object_to_task.get(key)
             rec = self._tasks.get(task_key) if task_key is not None else None
-            if rec is None or rec.spec is None or rec.spec.actor_id is None:
-                return  # puts/task returns: already directory-visible
+            if rec is None or rec.spec is None or \
+                    (rec.spec.actor_id is None and not rec.spec.direct):
+                return  # puts/raylet task returns: already directory-visible
             self._publish_when_done.add(key)
         # Race arbitration with the result handler (which publishes pending
         # keys AFTER rec.event.set()): if the event is set here, the
@@ -399,13 +431,97 @@ class CoreRuntime:
             for oid in spec.return_ids():
                 self._object_to_task[oid.binary()] = spec.task_id.binary()
         self._pin_deps(spec)
-        self._submit_spec(spec)
+        if GLOBAL_CONFIG.direct_task_enabled and self._direct.eligible(spec):
+            self._direct.submit(spec)
+        else:
+            self._submit_spec_async(spec)
         return spec.return_ids()
 
-    def _submit_spec(self, spec: TaskSpec):
-        target = self.raylet
-        target_addr = self.raylet.address
-        spilled = False  # first spillback hop must accept, not bounce
+    def _submit_spec_async(self, spec: TaskSpec):
+        """Pipelined submission: send the spec and return immediately; the
+        queued/spillback response is handled on the RPC reader thread.
+        Mirrors the reference's async task submission (CoreWorker submits
+        without blocking the caller, `direct_task_transport.h`): N
+        `.remote()` calls cost N sends, not N round trips."""
+        def cb(env, payload):
+            if env.get("_lost"):
+                # Local raylet died with the submit in flight: the process
+                # cannot make progress; fail the record so gets raise.
+                self._async_submit_error(
+                    spec, RaySystemError("lost connection to raylet"))
+                return
+            if env.get("e"):
+                self._async_submit_error(spec, RaySystemError(
+                    f"submit_task failed remotely: {env['e']}"))
+                return
+            try:
+                resp = serialization.loads(payload) if payload else {}
+            except Exception as e:  # noqa: BLE001
+                self._async_submit_error(spec, RaySystemError(
+                    f"bad submit response: {e}"))
+                return
+            status = resp.get("status")
+            if status == "queued":
+                rec = self._tasks.get(spec.task_id.binary())
+                if rec is not None:
+                    rec.submitted_addr = self.raylet.address
+            elif status == "spillback":
+                # Routing continues with blocking hops — off the reader
+                # thread (dialing the spill target must not stall response
+                # dispatch for every other in-flight call).
+                self._bg_submit(self._continue_spillback, spec,
+                                resp["address"])
+            else:
+                self._async_submit_error(spec, RaySystemError(
+                    f"unexpected submit status {resp}"))
+
+        try:
+            self.raylet.call_async(
+                "submit_task", {"spec": spec, "grant_or_reject": False}, cb)
+        except ConnectionLost:
+            raise RaySystemError("lost connection to raylet")
+
+    def _bg_submit(self, fn, *args):
+        """Run fn(*args) on the shared background executor (lazy)."""
+        with self._lock:
+            if self._bg_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._bg_executor = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="rt-bg")
+            ex = self._bg_executor
+        ex.submit(fn, *args)
+
+    def _continue_spillback(self, spec: TaskSpec, address: str):
+        if self._closed:
+            return
+        rec = self._tasks.get(spec.task_id.binary())
+        if rec is None or rec.event.is_set():
+            return
+        try:
+            self._submit_spec(spec, start_addr=address, spilled=True)
+        except Exception as e:  # noqa: BLE001
+            self._async_submit_error(spec, RaySystemError(
+                f"spillback resubmit failed: {e}"))
+
+    def _async_submit_error(self, spec: TaskSpec, err: Exception):
+        rec = self._tasks.get(spec.task_id.binary())
+        if rec is None or rec.event.is_set():
+            return
+        self._unpin_deps(spec)
+        self._fail_task_record(rec, spec,
+                               serialization.serialize_exception(err))
+
+    def _submit_spec(self, spec: TaskSpec, start_addr: Optional[str] = None,
+                     spilled: bool = False):
+        spec.direct = False  # classic path: the raylet registers results
+        if start_addr is None or start_addr == self.raylet.address:
+            target = self.raylet
+            target_addr = self.raylet.address
+            spilled = False  # first spillback hop must accept, not bounce
+        else:
+            target_addr = start_addr
+            target = self._raylet_for(start_addr)
         for _hop in range(8):
             try:
                 resp = target.call("submit_task",
@@ -465,6 +581,9 @@ class CoreRuntime:
         owner's lease tracking resubmits on node failure."""
         if self._closed:
             return
+        # Lease requests queued at the dead raylet die with it: re-route
+        # them too (tasks below; leases here).
+        self._direct.on_raylet_lost(address)
         with self._lock:
             pending = [rec for rec in self._tasks.values()
                        if rec.submitted_addr == address
@@ -580,34 +699,51 @@ class CoreRuntime:
             for oid in spec.return_ids():
                 self._object_to_task[oid.binary()] = spec.task_id.binary()
         self._pin_deps(spec)
-        last_err: Optional[Exception] = None
-        for _attempt in range(retry_on_restart + 1):
-            try:
-                client = self._actor_client(spec.actor_id)
+        self._submit_actor_attempt(spec, rec, retry_on_restart + 1)
+        return spec.return_ids()
+
+    def _submit_actor_attempt(self, spec: TaskSpec, rec: _TaskRecord,
+                              attempts_left: int, last_err=None):
+        """One pipelined send attempt; transport failures retry on the
+        background executor (the restarted actor publishes a new address),
+        terminal failures resolve the record to the death error."""
+        if rec.event.is_set():
+            return  # already resolved (e.g. actor-death path failed it)
+        if attempts_left <= 0:
+            self._unpin_deps(spec)
+            self._fail_task_record(rec, spec, serialization.serialize_exception(
+                ActorDiedError(spec.actor_id,
+                               f"actor call failed: {last_err}")))
+            return
+
+        def retry(err):
+            with self._lock:
+                self._actor_clients.pop(spec.actor_id.binary(), None)
+                self._actor_states.pop(spec.actor_id.binary(), None)
+            time.sleep(0.1)
+            self._submit_actor_attempt(spec, rec, attempts_left - 1, err)
+
+        def cb(env, payload):
+            if env.get("_lost") or env.get("e"):
+                # Off the reader thread: the retry re-resolves the actor
+                # address (blocking) and may sleep.
+                self._bg_submit(retry, env.get("e") or "connection lost")
+
+        try:
+            client = self._actor_client(spec.actor_id)
+            with client.lock:
                 spec.seq_no = client.seq
                 client.seq += 1
-                client.client.call("actor_call", {"spec": spec})
-                return spec.return_ids()
-            except (ConnectionLost, TimeoutError, RaySystemError) as e:
-                last_err = e
-                with self._lock:
-                    self._actor_clients.pop(spec.actor_id.binary(), None)
-                    self._actor_states.pop(spec.actor_id.binary(), None)
-                time.sleep(0.1)
-            except Exception as e:  # noqa: BLE001 — actor terminally DEAD
-                # (or its creation failed). Submitting to a dead actor must
-                # not raise at the call site: the reference returns refs
-                # that resolve to the death error on get.
-                self._unpin_deps(spec)
-                self._fail_task_record(
-                    rec, spec, serialization.serialize_exception(e))
-                return spec.return_ids()
-        # Mark the pending record failed so gets on its refs raise (and so
-        # remote dependents see the error instead of waiting forever).
-        self._unpin_deps(spec)
-        self._fail_task_record(rec, spec, serialization.serialize_exception(
-            ActorDiedError(spec.actor_id, f"actor call failed: {last_err}")))
-        return spec.return_ids()
+                client.client.call_async("actor_call", {"spec": spec}, cb)
+        except (ConnectionLost, TimeoutError, RaySystemError) as e:
+            retry(e)
+        except Exception as e:  # noqa: BLE001 — actor terminally DEAD
+            # (or its creation failed). Submitting to a dead actor must
+            # not raise at the call site: the reference returns refs
+            # that resolve to the death error on get.
+            self._unpin_deps(spec)
+            self._fail_task_record(
+                rec, spec, serialization.serialize_exception(e))
 
     def _on_actor_conn_lost(self, actor_id: ActorID):
         """Direct connection to the actor's worker dropped: fail every
@@ -852,8 +988,22 @@ class CoreRuntime:
         if rec is None or rec.spec is None:
             return
         if rec.spec.actor_id is not None:
-            raise TypeError("cancel() cannot cancel actor tasks")
-        if rec.event.is_set():
+            # Actor tasks: queued calls drop; running async calls get
+            # CancelledError at the next await; running sync calls are
+            # uninterruptible (reference actor-cancel semantics —
+            # force-kill would destroy actor state).
+            if force:
+                raise ValueError(
+                    "force=True cannot cancel actor tasks (it would kill "
+                    "the actor); use ray_tpu.kill for that")
+            try:
+                client = self._actor_client(rec.spec.actor_id)
+                client.client.call_async("cancel_actor_task",
+                                         {"task_id": rec.spec.task_id})
+            except Exception:  # noqa: BLE001 — actor dead: ref resolves
+                pass           # to ActorDiedError anyway
+            return
+        if rec.spec.direct and self._direct.cancel(rec.spec.task_id, force):
             return
         addr = rec.submitted_addr
         client = self.raylet if addr in (None, self.raylet.address) \
@@ -933,6 +1083,35 @@ class CoreRuntime:
         with self._lock:
             self._ref_counts[oid.binary()] += 1
 
+    def is_owner(self, oid: ObjectID) -> bool:
+        key = oid.binary()
+        return key in self._owned_puts or key in self._object_to_task
+
+    def on_refs_deserialized(self, oids: List[ObjectID]):
+        """This process deserialized refs it does not own: register as a
+        borrower with the directory, SYNCHRONOUSLY and in one batch — the
+        owner's submit-time pin (nested_refs) holds only until the task
+        completes, so the borrows must be on record before user code
+        runs."""
+        if self._closed:
+            return
+        fresh: List[ObjectID] = []
+        with self._lock:
+            for oid in oids:
+                key = oid.binary()
+                if self.is_owner(oid) or key in self._borrowed:
+                    continue
+                self._borrowed.add(key)
+                fresh.append(oid)
+        if not fresh:
+            return
+        try:
+            self.gcs.call("borrow_add",
+                          {"object_ids": fresh,
+                           "borrower_id": self.worker_id.hex()}, timeout=10)
+        except Exception:  # noqa: BLE001 — GCS hiccup: refs still usable,
+            pass           # at worst the objects outlive this borrower
+
     def deregister_ref(self, oid: ObjectID):
         if self._closed:
             return
@@ -946,27 +1125,49 @@ class CoreRuntime:
             # one record per completed task (see reference TaskManager's
             # completed-task eviction).
             self._object_cache.pop(key, None)
-            task_key = self._object_to_task.pop(key, None)
-            if task_key is not None:
-                rec = self._tasks.get(task_key)
-                if rec is not None and rec.event.is_set():
-                    returns = rec.spec.return_ids() if rec.spec is not None else []
-                    if not any(r.binary() in self._object_to_task for r in returns):
-                        self._tasks.pop(task_key, None)
-            if self._dep_pins.get(key, 0) > 0:
-                self._deferred_free.add(key)
-                return
+            if key in self._borrowed:
+                # Borrowers never free: they only remove themselves from
+                # the borrower set (the owner's pending-free fires when
+                # the set empties).
+                self._borrowed.discard(key)
+                borrow = True
+            else:
+                borrow = False
+                owned = key in self._owned_puts or key in self._object_to_task
+                self._owned_puts.discard(key)
+                task_key = self._object_to_task.pop(key, None)
+                if task_key is not None:
+                    rec = self._tasks.get(task_key)
+                    if rec is not None and rec.event.is_set():
+                        returns = rec.spec.return_ids() if rec.spec is not None else []
+                        if not any(r.binary() in self._object_to_task for r in returns):
+                            self._tasks.pop(task_key, None)
+                if not owned:
+                    # Not ours and not registered as a borrow (e.g. created
+                    # before tracking): never free somebody else's object.
+                    return
+                if self._dep_pins.get(key, 0) > 0:
+                    self._deferred_free.add(key)
+                    return
+        if borrow:
+            try:
+                self.gcs.call_async("borrow_remove",
+                                    {"object_id": oid,
+                                     "borrower_id": self.worker_id.hex()})
+            except Exception:  # noqa: BLE001
+                pass
+            return
         self.free_ref(oid)
 
     def _pin_deps(self, spec: TaskSpec):
         with self._lock:
-            for dep in spec.dependencies():
+            for dep in spec.dependencies() + list(spec.nested_refs):
                 self._dep_pins[dep.binary()] += 1
 
     def _unpin_deps(self, spec: TaskSpec):
         to_free = []
         with self._lock:
-            for dep in spec.dependencies():
+            for dep in spec.dependencies() + list(spec.nested_refs):
                 key = dep.binary()
                 self._dep_pins[key] -= 1
                 if self._dep_pins[key] <= 0:
@@ -1010,11 +1211,26 @@ class CoreRuntime:
 
     def shutdown(self):
         self._flush_free_buffer()
+        if self._borrowed:
+            # Graceful exit drops every borrow in one call so pending
+            # frees fire now instead of leaking until worker-death cleanup.
+            try:
+                self.gcs.call("borrower_gone",
+                              {"borrower_id": self.worker_id.hex()},
+                              timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
         try:
             self._metrics_pusher.stop()
         except Exception:  # noqa: BLE001
             pass
         self._closed = True
+        try:
+            self._direct.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._bg_executor is not None:
+            self._bg_executor.shutdown(wait=False)
         for c in self._actor_clients.values():
             c.client.close()
         for c in self._raylet_clients.values():

@@ -104,7 +104,10 @@ def deserialize(data: memoryview | bytes, zero_copy: bool = True) -> Any:
             b = b.toreadonly()
         buffers.append(b)
         pos += blen
-    return pickle.loads(bytes(payload), buffers=buffers)
+    from ray_tpu.object_ref import _BorrowScope
+
+    with _BorrowScope():
+        return pickle.loads(bytes(payload), buffers=buffers)
 
 
 def dumps(value: Any) -> bytes:

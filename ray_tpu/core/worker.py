@@ -46,12 +46,29 @@ class WorkerRuntime(CoreRuntime):
 
     def __init__(self):
         worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
-        self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        # Items: (spec, reply_conn) — reply_conn None for raylet-dispatched
+        # tasks (completion via task_done), set for direct-lease pushes
+        # (completion via task_result push on that connection).
+        self._task_queue: "queue.Queue" = queue.Queue()
         # Direct server must exist before registration (address is reported).
         self.direct_server = RpcServer(name="worker-direct")
         self.direct_server.register("actor_call", self._handle_actor_call)
+        self.direct_server.register("direct_call", self._handle_direct_call)
+        self.direct_server.register("cancel_direct", self._handle_cancel_direct)
+        self.direct_server.register("cancel_actor_task",
+                                    self._handle_cancel_actor_task)
         self.direct_server.register("ping", lambda conn, data: {"ok": True})
         self.direct_server.start()
+        self._cancelled_direct: set = set()
+        # task_id -> (future, caller conn, spec) for in-flight actor calls,
+        # so cancel_actor_task can cancel queued (and async running) work.
+        self._actor_calls: Dict[bytes, tuple] = {}
+        # Cancellation reply dedup: fut.cancel() on a coroutine future can
+        # return True while the body is mid-execution (run_coroutine_
+        # threadsafe futures never enter RUNNING), so the cancel handler
+        # and the coroutine's own error path may both try to reply.
+        self._replied: set = set()
+        self._reply_lock = threading.Lock()
         super().__init__(
             gcs_address=os.environ["RAY_TPU_GCS_ADDRESS"],
             raylet_address=os.environ["RAY_TPU_RAYLET_ADDRESS"],
@@ -83,7 +100,30 @@ class WorkerRuntime(CoreRuntime):
 
     def on_execute_task(self, spec: TaskSpec):
         # Called on the RpcClient reader thread: enqueue only.
-        self._task_queue.put(spec)
+        self._task_queue.put((spec, None))
+
+    def _handle_direct_call(self, conn: Connection, data: Dict[str, Any]):
+        """A lease holder pushes a normal task over the direct channel
+        (reference: PushTask on a leased worker, direct_task_transport).
+        Execution happens on the main task thread, FIFO with raylet work."""
+        self._task_queue.put((data["spec"], conn))
+        return {"accepted": True}
+
+    def _handle_cancel_direct(self, conn: Connection, data: Dict[str, Any]):
+        task_id = data["task_id"]
+        spec = self.executing_task
+        if spec is not None and spec.task_id == task_id:
+            self._cancelled_direct.add(task_id.binary())
+            self.on_cancel_exec(task_id)
+            return {}
+        # Only mark queued targets: a cancel racing past completion must
+        # not leak an entry that nothing will ever discard.
+        with self._task_queue.mutex:
+            queued = any(s.task_id == task_id
+                         for s, _conn in self._task_queue.queue)
+        if queued:
+            self._cancelled_direct.add(task_id.binary())
+        return {}
 
     def on_cancel_exec(self, task_id):
         """ray.cancel: record the target and poke the main thread; the
@@ -96,13 +136,16 @@ class WorkerRuntime(CoreRuntime):
     def main_loop(self):
         while not self._stopping.is_set():
             try:
-                spec = self._task_queue.get(timeout=1.0)
+                spec, reply_conn = self._task_queue.get(timeout=1.0)
             except queue.Empty:
                 if self.raylet.is_closed:
                     logger.info("raylet connection closed; worker exiting")
                     return
                 continue
-            self._execute(spec)
+            if reply_conn is None:
+                self._execute(spec)
+            else:
+                self._execute_direct(spec, reply_conn)
 
     # ----------------------------------------------------------- execution
 
@@ -138,7 +181,11 @@ class WorkerRuntime(CoreRuntime):
             return pos, dict(zip(spec.kwargs_keys, kwvals))
         return values, {}
 
-    def _execute(self, spec: TaskSpec):
+    def _run_task_body(self, spec: TaskSpec
+                       ) -> Tuple[List[Dict[str, Any]], Optional[bytes]]:
+        """Shared execution core for raylet-dispatched and direct tasks:
+        resolve args + function, run (awaiting coroutines), store results.
+        Returns (results, error_blob)."""
         self.executing_task = spec
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
@@ -164,12 +211,59 @@ class WorkerRuntime(CoreRuntime):
                 self._stopping.set()
         finally:
             self.executing_task = None
+        return results, error_blob
+
+    def _execute(self, spec: TaskSpec):
+        results, error_blob = self._run_task_body(spec)
         try:
-            self.raylet.call("task_done",
-                             {"task_id": spec.task_id, "results": results,
-                              "error": error_blob}, timeout=30)
+            # Pipelined: the worker is free for the next task the moment the
+            # report is on the wire; failures surface via the callback.
+            self.raylet.call_async(
+                "task_done",
+                {"task_id": spec.task_id, "results": results,
+                 "error": error_blob},
+                lambda env, _p: logger.error(
+                    "task_done for %s failed: %s", spec.name, env.get("e"))
+                if (env.get("e") or env.get("_lost")) else None)
         except Exception:
             logger.exception("failed to report task_done")
+
+    def _execute_direct(self, spec: TaskSpec, conn: Connection):
+        """Run a lease-pushed normal task; reply straight to the owner
+        (inline results) / seal large results into the node store. The
+        raylet never sees the task, so the worker reports its lifecycle
+        events (timeline/state API parity with raylet-dispatched tasks)."""
+        import time as _time
+
+        from ray_tpu.exceptions import TaskCancelledError
+
+        started = _time.time()
+        if spec.task_id.binary() in self._cancelled_direct:
+            self._cancelled_direct.discard(spec.task_id.binary())
+            self._reply_actor_result(
+                conn, spec, [],
+                serialization.serialize_exception(
+                    TaskCancelledError(spec.task_id), spec.name))
+            return
+        try:
+            results, error_blob = self._run_task_body(spec)
+        finally:
+            self._cancelled_direct.discard(spec.task_id.binary())
+        self._reply_actor_result(conn, spec, results, error_blob)
+        base = {
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", "")[:12],
+            "worker_id": self.worker_id.hex()[:12], "pid": os.getpid(),
+            "queued_at": spec.submitted_at,
+        }
+        try:
+            self.raylet.call_async("direct_task_event", {"events": [
+                dict(base, state="RUNNING", ts=started),
+                dict(base, state="FAILED" if error_blob is not None
+                     else "FINISHED", ts=_time.time()),
+            ]})
+        except Exception:  # noqa: BLE001 — observability only
+            pass
 
     def _pack_returns(self, spec: TaskSpec, out: Any) -> List[Any]:
         if spec.num_returns == 1:
@@ -221,12 +315,64 @@ class WorkerRuntime(CoreRuntime):
             self._actor_executor.submit(self._run_actor_method, conn, spec,
                                         method or (lambda: None))
             return {"accepted": True}
+        tid = spec.task_id.binary()
+        # Register BEFORE submitting: the method's finally-pop must find
+        # the entry even when a trivial body finishes before this handler
+        # resumes (a post-submit insert would leak the entry forever).
+        with self._reply_lock:
+            self._actor_calls[tid] = (None, conn, spec)
         if asyncio.iscoroutinefunction(getattr(method, "__func__", method)):
-            asyncio.run_coroutine_threadsafe(
+            fut = asyncio.run_coroutine_threadsafe(
                 self._run_actor_method_async(conn, spec, method), self._async_loop)
         else:
-            self._actor_executor.submit(self._run_actor_method, conn, spec, method)
+            fut = self._actor_executor.submit(
+                self._run_actor_method, conn, spec, method)
+        with self._reply_lock:
+            if tid in self._actor_calls:  # not yet completed
+                self._actor_calls[tid] = (fut, conn, spec)
         return {"accepted": True}
+
+    def _handle_cancel_actor_task(self, conn: Connection, data: Dict[str, Any]):
+        """ray.cancel on an actor task: queued calls are dropped (caller
+        gets TaskCancelledError); async running calls get CancelledError
+        at their next await; sync running calls are uninterruptible
+        (reference semantics: only queued/async actor tasks cancel)."""
+        tid = data["task_id"].binary()
+        with self._reply_lock:
+            rec = self._actor_calls.get(tid)
+        if rec is None or rec[0] is None:
+            return {"cancelled": False}
+        fut, caller_conn, spec = rec
+        cancelled = fut.cancel()
+        if cancelled:
+            # Queued (or async mid-run — see _replied) call: report the
+            # cancellation; the guard suppresses a duplicate reply from a
+            # coroutine that was actually executing.
+            with self._reply_lock:
+                self._actor_calls.pop(tid, None)
+            from ray_tpu.exceptions import TaskCancelledError
+
+            with self._reply_lock:
+                self._replied.add(tid)
+                if len(self._replied) > 4096:
+                    # Stale never-ran entries; ids never recur, and a
+                    # dropped in-flight entry only risks a duplicate push
+                    # the caller already ignores.
+                    self._replied.clear()
+                    self._replied.add(tid)
+            self._reply_actor_result(
+                caller_conn, spec, [],
+                serialization.serialize_exception(
+                    TaskCancelledError(spec.task_id), spec.name))
+        return {"cancelled": cancelled}
+
+    def _reply_actor_result_once(self, conn: Connection, spec: TaskSpec,
+                                 results, error_blob):
+        with self._reply_lock:
+            if spec.task_id.binary() in self._replied:
+                self._replied.discard(spec.task_id.binary())
+                return  # cancel handler already answered this task
+        self._reply_actor_result(conn, spec, results, error_blob)
 
     def _run_actor_method(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
@@ -242,7 +388,10 @@ class WorkerRuntime(CoreRuntime):
                        for oid, v in zip(spec.return_ids(), values)]
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
-        self._reply_actor_result(conn, spec, results, error_blob)
+        finally:
+            with self._reply_lock:
+                self._actor_calls.pop(spec.task_id.binary(), None)
+        self._reply_actor_result_once(conn, spec, results, error_blob)
 
     async def _run_actor_method_async(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
@@ -253,9 +402,19 @@ class WorkerRuntime(CoreRuntime):
             values = self._pack_returns(spec, out)
             results = [self._store_result(oid, v)
                        for oid, v in zip(spec.return_ids(), values)]
+        except asyncio.CancelledError:
+            # ray.cancel on a running async actor task: surface the typed
+            # cancellation, not a bare CancelledError.
+            from ray_tpu.exceptions import TaskCancelledError
+
+            error_blob = serialization.serialize_exception(
+                TaskCancelledError(spec.task_id), spec.name)
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
-        self._reply_actor_result(conn, spec, results, error_blob)
+        finally:
+            with self._reply_lock:
+                self._actor_calls.pop(spec.task_id.binary(), None)
+        self._reply_actor_result_once(conn, spec, results, error_blob)
 
     def _reply_actor_result(self, conn: Connection, spec: TaskSpec,
                             results, error_blob):

@@ -7,9 +7,50 @@ borrower), supports `future()`-style callbacks via the owning runtime.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ray_tpu.core.ids import ObjectID
+
+# Active during task-arg serialization: ObjectRefs pickled INSIDE argument
+# values (nested refs) are recorded here so the owner can pin them until
+# the executing worker registers its borrow (reference: "contained object
+# ids" collected by the serialization context, serialization.py).
+_capture = threading.local()
+
+
+class _NestedRefCapture:
+    def __enter__(self):
+        self._prev = getattr(_capture, "ids", None)
+        _capture.ids = []
+        return _capture.ids
+
+    def __exit__(self, *exc):
+        _capture.ids = self._prev
+
+
+# Active during value DEserialization: refs reconstructed inside one
+# pickle.loads register their borrows in a single batched GCS call at
+# scope exit instead of one blocking round trip per ref (a value holding
+# 1,000 refs would otherwise pay 1,000 RPCs before user code runs).
+_borrow_scope = threading.local()
+
+
+class _BorrowScope:
+    def __enter__(self):
+        self._outermost = getattr(_borrow_scope, "ids", None) is None
+        if self._outermost:
+            _borrow_scope.ids = []
+        return self
+
+    def __exit__(self, *exc):
+        if not self._outermost:
+            return
+        ids, _borrow_scope.ids = _borrow_scope.ids, None
+        if ids:
+            rt = _current_runtime()
+            if rt is not None:
+                rt.on_refs_deserialized(ids)
 
 
 class ObjectRef:
@@ -41,6 +82,9 @@ class ObjectRef:
         return f"ObjectRef({self.object_id.hex()})"
 
     def __reduce__(self):
+        ids = getattr(_capture, "ids", None)
+        if ids is not None:
+            ids.append(self.object_id)
         return (_reconstruct_ref, (self.object_id.binary(), self._owner_hint))
 
     def __del__(self):
@@ -92,4 +136,16 @@ def _current_runtime():
 
 
 def _reconstruct_ref(binary: bytes, owner_hint):
-    return ObjectRef(ObjectID(binary), owner_hint)
+    # Deserializing a ref makes this process a borrower: the object must
+    # survive the owner's free until this process drops it (reference
+    # reference_count.h borrower protocol). Inside a _BorrowScope the
+    # registration batches; bare reconstructions register one-by-one.
+    ref = ObjectRef(ObjectID(binary), owner_hint)
+    ids = getattr(_borrow_scope, "ids", None)
+    if ids is not None:
+        ids.append(ref.object_id)
+        return ref
+    rt = _current_runtime()
+    if rt is not None:
+        rt.on_refs_deserialized([ref.object_id])
+    return ref

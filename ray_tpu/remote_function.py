@@ -101,7 +101,7 @@ class RemoteFunction:
             default_cpus=1.0,
         )
         resources, strategy, pg_id, bundle_idx = _resolve_pg_strategy(opts, resources)
-        ser_args, kwargs_keys = runtime.serialize_args(args, kwargs)
+        ser_args, kwargs_keys, nested_refs = runtime.serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.for_task(runtime.job_id),
             job_id=runtime.job_id,
@@ -119,6 +119,7 @@ class RemoteFunction:
             placement_group_bundle_index=bundle_idx,
             owner_address=runtime.worker_id.hex(),
             runtime_env=opts.get("runtime_env"),
+            nested_refs=nested_refs,
         )
         return_ids = runtime.submit_task(spec)
         refs = [ObjectRef(oid) for oid in return_ids]

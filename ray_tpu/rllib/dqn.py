@@ -150,47 +150,61 @@ class DQNLearner(Learner):
         self.target_net = jax.tree.map(lambda x: x, self.params["net"])
         self._update_dqn = jax.jit(self._update_dqn_impl)
 
-    def compute_loss(self, params, batch):
-        # Satisfies the Learner interface; DQN's real path is _update_dqn
-        # (the target params must be an explicit jit argument).
-        raise NotImplementedError("use update_dqn")
-
-    def _update_dqn_impl(self, params, target_net, opt_state, batch):
+    def _td_loss(self, params, target_net, batch):
+        """One TD/Huber loss definition shared by compute_loss (Learner
+        interface) and update_dqn (priority-replay path)."""
         import jax
         import jax.numpy as jnp
         import optax
 
         cfg = self.config
-        gamma = cfg.gamma
-
-        def loss_fn(p):
-            q = self.module.q_values(p["net"], batch[sb.OBS])
-            q_taken = jnp.take_along_axis(
-                q, batch[sb.ACTIONS][..., None].astype(jnp.int32),
-                axis=-1)[..., 0]
-            q_next_target = self.module.q_values(target_net,
+        q = self.module.q_values(params["net"], batch[sb.OBS])
+        q_taken = jnp.take_along_axis(
+            q, batch[sb.ACTIONS][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        q_next_target = self.module.q_values(target_net, batch["next_obs"])
+        if cfg.double_q:
+            q_next_online = self.module.q_values(params["net"],
                                                  batch["next_obs"])
-            if cfg.double_q:
-                q_next_online = self.module.q_values(p["net"],
-                                                     batch["next_obs"])
-                best = jnp.argmax(q_next_online, axis=-1)
-                q_boot = jnp.take_along_axis(
-                    q_next_target, best[..., None], axis=-1)[..., 0]
-            else:
-                q_boot = jnp.max(q_next_target, axis=-1)
-            not_done = 1.0 - batch[sb.DONES].astype(jnp.float32)
-            targets = batch[sb.REWARDS] + gamma * not_done * q_boot
-            td = q_taken - jax.lax.stop_gradient(targets)
-            weights = batch.get("weights", jnp.ones_like(td))
-            loss = jnp.mean(weights * optax.huber_loss(td, delta=1.0))
-            return loss, (td, jnp.mean(q))
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_boot = jnp.take_along_axis(
+                q_next_target, best[..., None], axis=-1)[..., 0]
+        else:
+            q_boot = jnp.max(q_next_target, axis=-1)
+        not_done = 1.0 - batch[sb.DONES].astype(jnp.float32)
+        targets = batch[sb.REWARDS] + cfg.gamma * not_done * q_boot
+        td = q_taken - jax.lax.stop_gradient(targets)
+        weights = batch.get("weights", jnp.ones_like(td))
+        loss = jnp.mean(weights * optax.huber_loss(td, delta=1.0))
+        return loss, (td, jnp.mean(q))
+
+    def compute_loss(self, params, batch):
+        """Learner-interface loss (reference learner.py:645 keeps one
+        update path). The target params ride in the batch as
+        `_target_net` — an explicit jit argument, injected by update();
+        a closure over self.target_net would be baked in at trace time
+        and go stale after sync_target()."""
+        target_net = batch.get("_target_net", self.target_net)
+        clean = {k: v for k, v in batch.items() if k != "_target_net"}
+        loss, (td, q_mean) = self._td_loss(params, target_net, clean)
+        return loss, {"td_loss": loss, "q_mean": q_mean}
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        return super().update({**batch, "_target_net": self.target_net})
+
+    def _update_dqn_impl(self, params, target_net, opt_state, batch):
+        import jax
+        import optax
 
         (loss, (td, q_mean)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            lambda p: self._td_loss(p, target_net, batch),
+            has_aux=True)(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {"td_loss": loss, "q_mean": q_mean,
                    "grad_norm": optax.global_norm(grads)}
+        import jax.numpy as jnp
+
         return params, opt_state, metrics, jnp.abs(td)
 
     def update_dqn(self, batch: Dict[str, np.ndarray]):

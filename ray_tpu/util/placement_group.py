@@ -103,7 +103,16 @@ def remove_placement_group(pg: PlacementGroup):
 
 
 def get_placement_group(name: str) -> PlacementGroup:
-    raise NotImplementedError("named placement group lookup lands with the state API")
+    """Look up a live placement group by name (reference
+    `ray.util.get_placement_group`)."""
+    import ray_tpu
+
+    runtime = ray_tpu._require_runtime()
+    resp = runtime.gcs.call("get_named_placement_group", {"name": name})
+    if not resp.get("found"):
+        raise ValueError(f"Failed to look up placement group {name!r}. "
+                         "It was either not created or was removed.")
+    return PlacementGroup(resp["pg_id"], resp["bundles"], resp["strategy"])
 
 
 def placement_group_table(pg: Optional[PlacementGroup] = None):

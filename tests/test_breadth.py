@@ -381,3 +381,22 @@ def test_internal_kv(ray_start_regular):
     assert kv._internal_kv_del("k1") == 1
     assert kv._internal_kv_get("k1") is None
     assert kv._internal_kv_del("k", del_by_prefix=True) >= 1
+
+
+def test_internal_kv_mixed_key_types(ray_start_regular):
+    """str and bytes keys interoperate: the GCS normalizes both to bytes,
+    so prefix scans never hit a startswith type mismatch (ADVICE r3)."""
+    import ray_tpu
+    from ray_tpu.experimental import internal_kv as kv
+
+    gcs = ray_tpu._require_runtime().gcs
+    # rpdb-style str key straight through the raw GCS API:
+    gcs.call("kv_put", {"key": "__mix__:a", "value": b"1"})
+    kv._internal_kv_put(b"__mix__:b", b"2")
+    # str-prefix scan over a namespace holding both str- and bytes-born keys
+    keys = gcs.call("kv_keys", {"prefix": "__mix__:"})["keys"]
+    assert set(keys) == {b"__mix__:a", b"__mix__:b"}
+    # str key fetches the value written under the same str key
+    assert gcs.call("kv_get", {"key": "__mix__:a"})["value"] == b"1"
+    # bytes-prefix delete takes out both
+    assert kv._internal_kv_del(b"__mix__:", del_by_prefix=True) == 2
