@@ -13,6 +13,41 @@ from __future__ import annotations
 import os
 
 
+def enable_compilation_cache(cache_dir: str | None = None):
+    """Point jax at a persistent on-disk compilation cache so repeated
+    runs skip XLA recompiles (a GPT-2 step at bs=24/seq=1024 costs ~50 s
+    to compile cold on v5e; warm loads take ~1 s). Reference has no
+    equivalent — torch has no AOT compile step — but on TPU owning
+    compile time is part of owning the training loop. Safe to call
+    multiple times; env `RAY_TPU_JAX_CACHE_DIR` overrides, `0`/`off`
+    disables."""
+    env = os.environ.get("RAY_TPU_JAX_CACHE_DIR", "")
+    if env.lower() in ("0", "off", "none"):
+        return None
+    path = env or cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu", "jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache everything, including sub-second compiles: the cache is
+        # local disk and the win on TPU pods is cold-start latency.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: BLE001 — knob name varies across versions
+            pass
+        return path
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "failed to enable jax compilation cache at %s", path,
+            exc_info=True)
+        return None
+
+
 def apply_jax_platform_env():
     platform = os.environ.get("RAY_TPU_JAX_PLATFORM")
     if platform:

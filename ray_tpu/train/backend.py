@@ -85,11 +85,21 @@ def _mesh_builder_for(spec: Optional[MeshSpec]):
     return build
 
 
+def _enable_compile_cache():
+    from ray_tpu._jax_env import enable_compilation_cache
+
+    enable_compilation_cache()
+    return True
+
+
 class JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig):
         world = len(worker_group)
         if backend_config.force_platform:
             worker_group.execute(_set_platform, backend_config.force_platform)
+        # Persistent XLA compilation cache on every train worker: repeated
+        # fits (tune trials, restarts, bench re-runs) skip cold compiles.
+        worker_group.execute(_enable_compile_cache)
         distributed = backend_config.distributed
         if distributed is None:
             distributed = world > 1

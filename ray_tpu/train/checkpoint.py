@@ -39,10 +39,16 @@ class Checkpoint:
 
     @classmethod
     def from_uri(cls, uri: str) -> "Checkpoint":
+        """Materialize from a URI: file:// maps directly; cloud schemes
+        (gs://, s3://, memory://) download through the pluggable storage
+        backends (reference `air/checkpoint.py:65` from_uri)."""
         if uri.startswith("file://"):
             return cls.from_directory(uri[len("file://"):])
-        raise NotImplementedError(
-            f"Only file:// URIs are supported without cloud deps ({uri})")
+        from ray_tpu.train import storage
+
+        local = tempfile.mkdtemp(prefix="rtpu_ckpt_dl_")
+        storage.download_dir(uri, local)
+        return cls.from_directory(local)
 
     @classmethod
     def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
@@ -78,11 +84,19 @@ class Checkpoint:
         return restore_pytree(os.path.join(self._path, "pytree"), target)
 
     def to_uri(self, uri: str) -> str:
-        """Persist to a URI (file:// without cloud deps) and return it."""
-        if not uri.startswith("file://"):
-            raise NotImplementedError(
-                f"Only file:// URIs are supported without cloud deps ({uri})")
-        self.to_directory(uri[len("file://"):])
+        """Persist to a URI and return it; cloud schemes upload through
+        the storage backends (on TPU pods local disk dies with the VM —
+        durable checkpoints go through here)."""
+        if uri.startswith("file://"):
+            self.to_directory(uri[len("file://"):])
+            return uri
+        from ray_tpu.train import storage
+
+        if self._path is not None:
+            local = self._path
+        else:
+            local = self.to_directory()
+        storage.upload_dir(local, uri)
         return uri
 
     @property

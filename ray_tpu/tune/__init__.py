@@ -148,11 +148,23 @@ class Tuner:
         self._run_config = run_config or RunConfig()
         self._restored_trials = _trials
 
-    def _experiment_dir(self) -> str:
-        name = self._run_config.name or \
+    def _experiment_name(self) -> str:
+        return self._run_config.name or \
             f"{getattr(self._trainable, '__name__', 'trainable')}_{int(time.time())}"
+
+    def _experiment_dir(self) -> str:
+        name = self._experiment_name()
         base = self._run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results")
+        from ray_tpu.train import storage
+
+        if storage.is_cloud_uri(base):
+            # Cloud storage_path: work locally, sync to the bucket
+            # (reference tune/syncer.py; _sync_uri consumed by fit()).
+            self._sync_uri = f"{base.rstrip('/')}/{name}"
+            return os.path.join(os.path.expanduser("~"),
+                                ".cache", "ray_tpu", "tune_sync", name)
+        self._sync_uri = None
         return os.path.join(base, name)
 
     def fit(self) -> ResultGrid:
@@ -169,16 +181,18 @@ class Tuner:
             configs = BasicVariantGenerator(
                 self._param_space, tc.num_samples, tc.seed).generate()
             trials = [Trial(config=c) for c in configs]
+        experiment_dir = self._experiment_dir()
         controller = TuneController(
             self._trainable, trials,
             scheduler=tc.scheduler,
             max_concurrent=tc.max_concurrent_trials,
-            experiment_dir=self._experiment_dir(),
+            experiment_dir=experiment_dir,
             stop=self._run_config.stop,
             metric=tc.metric, mode=tc.mode,
             searcher=tc.search_alg,
             num_samples=tc.num_samples if tc.search_alg is not None else None,
             max_failures=tc.max_failures,
+            sync_uri=getattr(self, "_sync_uri", None),
         )
         controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
@@ -186,14 +200,43 @@ class Tuner:
     @classmethod
     def restore(cls, path: str, trainable: Callable,
                 tune_config: Optional[TuneConfig] = None) -> "Tuner":
-        trials = TuneController.load_trials(path)
-        run_config = RunConfig(name=os.path.basename(path.rstrip("/")),
-                               storage_path=os.path.dirname(path.rstrip("/")))
+        """Resume an interrupted experiment from its directory — or from
+        a bucket URI (the cloud copy written by experiment sync), which is
+        downloaded into the local working dir and re-synced on fit()."""
+        from ray_tpu.train import storage
+
+        name = os.path.basename(path.rstrip("/"))
+        if storage.is_cloud_uri(path):
+            local = os.path.join(os.path.expanduser("~"),
+                                 ".cache", "ray_tpu", "tune_sync", name)
+            storage.download_dir(path, local)
+            trials = TuneController.load_trials(local)
+            # Checkpoint paths were recorded on the machine that synced;
+            # remap them into the freshly-downloaded tree.
+            for t in trials:
+                cp = getattr(t, "checkpoint_path", None)
+                if cp:
+                    cand = os.path.join(local, t.trial_id,
+                                        os.path.basename(cp.rstrip("/")))
+                    if os.path.isdir(cand):
+                        t.checkpoint_path = cand
+            run_config = RunConfig(
+                name=name,
+                storage_path=path.rstrip("/")[: -len(name) - 1])
+        else:
+            trials = TuneController.load_trials(path)
+            run_config = RunConfig(name=name,
+                                   storage_path=os.path.dirname(
+                                       path.rstrip("/")))
         return cls(trainable, tune_config=tune_config, run_config=run_config,
                    _trials=trials)
 
     @staticmethod
     def can_restore(path: str) -> bool:
+        from ray_tpu.train import storage
+
+        if storage.is_cloud_uri(path):
+            return storage.uri_exists(f"{path.rstrip('/')}/tuner.pkl")
         return os.path.exists(os.path.join(path, "tuner.pkl"))
 
 

@@ -101,7 +101,9 @@ class TuneController:
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  searcher: Optional[Any] = None,
                  num_samples: Optional[int] = None,
-                 max_failures: int = 0):
+                 max_failures: int = 0,
+                 sync_uri: Optional[str] = None,
+                 sync_period_s: float = 5.0):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
@@ -127,6 +129,13 @@ class TuneController:
                 max_concurrent = 2
         self.max_concurrent = max_concurrent
         os.makedirs(experiment_dir, exist_ok=True)
+        # Cloud experiment sync (reference tune/syncer.py): the local
+        # experiment dir mirrors to a bucket URI, throttled, plus a final
+        # sync when the run ends — on TPU pods the local dir dies with
+        # the VM, the bucket copy is what Tuner.restore() reads.
+        self.sync_uri = sync_uri
+        self.sync_period_s = sync_period_s
+        self._last_sync = 0.0
         self._actors: Dict[str, Any] = {}          # trial_id -> actor handle
         self._inflight: Dict[Any, Trial] = {}      # next_result ref -> trial
 
@@ -158,7 +167,7 @@ class TuneController:
                     continue
                 self._handle_result(trial, res)
             self.save()
-        self.save()
+        self.save(final=True)
         return self.trials
 
     def _start_pending(self):
@@ -328,7 +337,7 @@ class TuneController:
 
     # ------------------------------------------------------ experiment state
 
-    def save(self):
+    def save(self, final: bool = False):
         state = {"trials": [t.state() for t in self.trials],
                  "metric": self.metric, "mode": self.mode}
         path = os.path.join(self.experiment_dir, "tuner.pkl")
@@ -336,6 +345,29 @@ class TuneController:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, path)
+        self._maybe_sync(final)
+
+    def _maybe_sync(self, final: bool):
+        if not self.sync_uri:
+            return
+        now = time.time()
+        if not final and now - self._last_sync < self.sync_period_s:
+            return
+        self._last_sync = now
+        from ray_tpu.train import storage
+
+        attempts = 3 if final else 1
+        for i in range(attempts):
+            try:
+                storage.upload_dir(self.experiment_dir, self.sync_uri)
+                return
+            except Exception:  # noqa: BLE001 — results are already safe
+                # in experiment_dir; a failed upload must not turn a
+                # completed run into a raise out of fit().
+                logger.warning("experiment sync to %s failed (attempt "
+                               "%d/%d)", self.sync_uri, i + 1, attempts,
+                               exc_info=True)
+                time.sleep(1.0 * (i + 1))
 
     @staticmethod
     def load_trials(experiment_dir: str) -> List[Trial]:
