@@ -14,6 +14,12 @@ from ray_tpu.autoscaler.autoscaler import (
     StandardAutoscaler,
     request_resources,
 )
+from ray_tpu.autoscaler.gcp import (
+    FakeTPUTransport,
+    GCETPUConfig,
+    GCETPUNodeProvider,
+)
 
 __all__ = ["AutoscalerConfig", "NodeProvider", "LocalNodeProvider",
-           "StandardAutoscaler", "request_resources"]
+           "StandardAutoscaler", "request_resources",
+           "GCETPUConfig", "GCETPUNodeProvider", "FakeTPUTransport"]

@@ -26,6 +26,10 @@ class AutoscalerConfig:
     # Worker node shape (the provider's node_config).
     node_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 2})
     idle_timeout_s: float = 30.0
+    # A just-launched node counts as busy for this long: boot + join +
+    # first dispatch take time (minutes for a real TPU VM), and judging
+    # it idle meanwhile livelocks launch->terminate->relaunch.
+    launch_grace_s: float = 60.0
     update_period_s: float = 1.0
     # Fraction of outstanding demand to satisfy per tick (1.0 = all at
     # once; reference upscaling_speed semantics).
@@ -91,8 +95,11 @@ class StandardAutoscaler:
         self._gcs = RpcClient(gcs_address, name="autoscaler->gcs")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # provider handle -> monotonic time it was last seen busy
-        self._last_busy: Dict[int, float] = {}
+        # node key -> monotonic time it was last seen busy. Keyed by the
+        # provider's stable handle.name when present — id() could be
+        # reused by a later handle and hand a fresh node a stale idle
+        # clock — and pruned against the live node set each update.
+        self._last_busy: Dict[Any, float] = {}
         self.num_launches = 0
         self.num_terminations = 0
 
@@ -167,8 +174,12 @@ class StandardAutoscaler:
 
         # 3. Scale-down: terminate managed nodes idle past the timeout.
         now = time.monotonic()
-        for handle in list(self.provider.non_terminated_nodes()):
-            hid = id(handle)
+        live = list(self.provider.non_terminated_nodes())
+        live_keys = {self._node_key(h) for h in live}
+        for stale in [k for k in self._last_busy if k not in live_keys]:
+            self._last_busy.pop(stale, None)  # provider dropped the node
+        for handle in live:
+            hid = self._node_key(handle)
             idle = self._node_is_idle(handle, view)
             if not idle:
                 self._last_busy[hid] = now
@@ -181,11 +192,20 @@ class StandardAutoscaler:
                 self._last_busy.pop(hid, None)
                 self.num_terminations += 1
 
+    @staticmethod
+    def _node_key(handle) -> Any:
+        return getattr(handle, "name", None) or id(handle)
+
     def _node_is_idle(self, handle, view) -> bool:
         node_hex = getattr(handle, "node_id", None)
+        if node_hex is not None and hasattr(node_hex, "hex"):
+            node_hex = node_hex.hex()
+        if node_hex is None and hasattr(self.provider, "resolve_node_id"):
+            # Cloud providers map VM -> ray node lazily (label lookup).
+            node_hex = self.provider.resolve_node_id(handle, view)
         if node_hex is None:
-            return False
-        entry = view.get(node_hex.hex())
+            return False  # not yet joined: never "idle" (still booting)
+        entry = view.get(node_hex)
         if entry is None or not entry.get("alive"):
             return True  # dead managed node: reap it
         return entry["available"] == entry["total"]
@@ -193,7 +213,10 @@ class StandardAutoscaler:
     def _launch(self):
         logger.info("autoscaler: launching worker node %s",
                     self.config.node_resources)
-        self.provider.create_node(dict(self.config.node_resources))
+        handle = self.provider.create_node(dict(self.config.node_resources))
+        # Launch grace: the idle clock starts after boot allowance.
+        self._last_busy[self._node_key(handle)] = (
+            time.monotonic() + self.config.launch_grace_s)
         self.num_launches += 1
 
 

@@ -1,0 +1,127 @@
+"""GCE TPU-VM node provider: REST bodies + fake-cloud autoscaler e2e.
+
+Reference: `python/ray/autoscaler/_private/gcp/node_provider.py` (request
+shape) and `_private/fake_multi_node/node_provider.py` (fake-cloud e2e
+pattern).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeTPUTransport,
+    GCETPUConfig,
+    GCETPUNodeProvider,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.gcp import CLUSTER_LABEL, TYPE_LABEL
+from ray_tpu.cluster_utils import Cluster
+
+
+def _config(**kw):
+    return GCETPUConfig(project="proj-1", zone="us-central2-b",
+                        cluster_name="rtpu", head_address="10.0.0.2:6379",
+                        accelerator_type="v5litepod-4", **kw)
+
+
+def test_create_node_request_body():
+    transport = FakeTPUTransport()
+    provider = GCETPUNodeProvider(_config(), transport=transport)
+    handle = provider.create_node({"CPU": 8, "TPU": 4})
+    assert handle.name.startswith("rtpu-worker-")
+
+    (call,) = transport.calls
+    assert call["method"] == "POST"
+    assert call["url"].startswith(
+        "https://tpu.googleapis.com/v2/projects/proj-1/locations/"
+        "us-central2-b/nodes?nodeId=rtpu-worker-")
+    body = call["body"]
+    assert body["acceleratorType"] == "v5litepod-4"
+    assert body["runtimeVersion"] == "tpu-ubuntu2204-base"
+    assert body["labels"][CLUSTER_LABEL] == "rtpu"
+    assert body["labels"][TYPE_LABEL] == "worker"
+    script = body["metadata"]["startup-script"]
+    assert "10.0.0.2:6379" in script        # workers join the head
+    assert handle.name in script            # and self-label for idle mapping
+    assert body["schedulingConfig"] == {"preemptible": False}
+
+
+def test_terminate_and_list_requests():
+    transport = FakeTPUTransport()
+    provider = GCETPUNodeProvider(_config(), transport=transport)
+    handle = provider.create_node({})
+    nodes = provider.non_terminated_nodes()
+    assert [n.name for n in nodes] == [handle.name]
+    provider.terminate_node(handle)
+    assert provider.non_terminated_nodes() == []
+
+    methods = [c["method"] for c in transport.calls]
+    assert methods == ["POST", "GET", "DELETE", "GET"]
+    del_call = transport.calls[2]
+    assert del_call["url"].endswith(f"/nodes/{handle.name}")
+    list_call = transport.calls[1]
+    assert f"filter=labels.{CLUSTER_LABEL}=rtpu" in list_call["url"]
+
+
+def test_provider_adopts_preexisting_nodes():
+    """A restarted autoscaler re-discovers VMs it didn't create this
+    process (reference: provider state is the cloud, not memory)."""
+    transport = FakeTPUTransport()
+    p1 = GCETPUNodeProvider(_config(), transport=transport)
+    handle = p1.create_node({})
+    p2 = GCETPUNodeProvider(_config(), transport=transport)
+    adopted = p2.non_terminated_nodes()
+    assert [n.name for n in adopted] == [handle.name]
+
+
+def test_node_resources_for_accelerator_type():
+    provider = GCETPUNodeProvider(_config(), transport=FakeTPUTransport())
+    assert provider.node_resources_for() == {"CPU": 32.0, "TPU": 4.0}
+
+
+def test_fake_cloud_autoscaler_end_to_end():
+    """Demand -> TPU-VM create calls -> fake VMs join as raylets -> work
+    runs -> idle -> TPU-VM delete calls."""
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    autoscaler = None
+    try:
+        cluster.connect()
+        transport = FakeTPUTransport(cluster=cluster, cpus_per_vm=2)
+        provider = GCETPUNodeProvider(_config(), transport=transport)
+        autoscaler = StandardAutoscaler(
+            cluster.gcs_address, provider,
+            AutoscalerConfig(min_workers=0, max_workers=2,
+                             node_resources={"CPU": 2},
+                             idle_timeout_s=3.0, launch_grace_s=15.0,
+                             update_period_s=0.5))
+        autoscaler.start()
+
+        @ray_tpu.remote(num_cpus=2)
+        def work(i):
+            time.sleep(0.3)
+            return i
+
+        out = ray_tpu.get([work.remote(i) for i in range(6)], timeout=120)
+        assert out == list(range(6))
+        assert autoscaler.num_launches >= 1
+        creates = [c for c in transport.calls if c["method"] == "POST"]
+        assert creates, "no TPU-VM create request issued"
+        assert all(c["body"]["acceleratorType"] == "v5litepod-4"
+                   for c in creates)
+
+        # Idle: VMs deleted through the API.
+        deadline = time.monotonic() + 60
+        while provider.non_terminated_nodes() and \
+                time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes(), "idle TPU VMs not reaped"
+        deletes = [c for c in transport.calls if c["method"] == "DELETE"]
+        assert len(deletes) >= 1
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        cluster.shutdown()
