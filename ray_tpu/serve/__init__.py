@@ -129,15 +129,30 @@ def _get_or_create_controller(create: bool = True):
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
         lifetime="detached", max_concurrency=64, num_cpus=0.1,
     ).remote()
+    # Crash recovery (reference controller.py:75): a checkpoint in the
+    # GCS KV means a previous controller died — rebuild its state and
+    # re-adopt surviving named replicas before reconciling.
+    ray_tpu.get(controller.restore.remote(), timeout=60.0)
     controller.reconcile_forever.remote()
     return controller
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 8000,
-          detached: bool = True) -> None:
-    """Start the Serve control plane (controller + HTTP proxy)."""
-    _get_or_create_controller()
-    _ensure_proxy(http_host, http_port)
+          detached: bool = True, proxy_location: str = "HeadOnly") -> None:
+    """Start the Serve control plane (controller + HTTP proxy).
+
+    proxy_location="EveryNode" puts a controller-managed, health-checked
+    proxy on every alive node (reference http_state.py:110); the default
+    keeps the single head proxy.
+    """
+    controller = _get_or_create_controller()
+    if proxy_location == "EveryNode":
+        import ray_tpu
+
+        ray_tpu.get(controller.set_proxy_config.remote(
+            http_host, http_port, True), timeout=60.0)
+    else:
+        _ensure_proxy(http_host, http_port)
 
 
 def _ensure_proxy(host: str, port: int) -> int:
@@ -288,7 +303,20 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 def status() -> Dict[str, Any]:
     import ray_tpu
 
-    controller = _get_or_create_controller(create=False)
+    try:
+        controller = _get_or_create_controller(create=False)
+    except Exception:  # noqa: BLE001 — no live controller
+        # Transparent crash recovery: recreate ONLY when a previous
+        # controller left a checkpoint — a status probe on a cluster that
+        # never ran Serve must stay a read, not spawn a control plane.
+        from ray_tpu.serve.controller import ServeController
+
+        runtime = ray_tpu._require_runtime()
+        ckpt = runtime.gcs.call(
+            "kv_get", {"key": ServeController.CKPT_KEY})["value"]
+        if not ckpt:
+            return {}
+        controller = _get_or_create_controller(create=True)
     return ray_tpu.get(controller.status.remote(), timeout=10.0)
 
 

@@ -63,12 +63,111 @@ class _DeploymentInfo:
 class ServeController:
     """Async actor; create with max_concurrency >> 1 (long-polls park)."""
 
+    CKPT_KEY = b"serve:controller_ckpt"
+
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._version = 0
         self._routing_table: Dict[str, Any] = {}
         self._shutdown = False
         self._change: Optional[asyncio.Condition] = None
+        # Per-node proxy management (reference http_state.py:110): set via
+        # set_proxy_config; reconcile keeps one proxy per alive node.
+        self._proxy_cfg: Optional[Dict[str, Any]] = None
+        self._proxies: Dict[str, Any] = {}   # node hex -> proxy handle
+
+    # ------------------------------------------------- checkpoint/recovery
+
+    def _kv(self):
+        import ray_tpu
+
+        return ray_tpu._require_runtime().gcs
+
+    def _checkpoint(self) -> None:
+        """Durable control-plane state in the GCS KV (reference
+        controller.py:75 + kv_store.py:24): enough to rebuild deployments
+        and re-adopt live named replicas after a controller crash. The
+        snapshot is built on the calling (loop) thread — cheap — but the
+        blocking KV round trip runs off-loop so deploys and long-polls
+        never stall behind a slow GCS."""
+        import pickle
+
+        import cloudpickle
+
+        state = {}
+        for name, info in self._deployments.items():
+            state[name] = {
+                "blob": cloudpickle.dumps(
+                    (info.user_cls, info.init_args, info.init_kwargs,
+                     info.config)),
+                "target": info.target,
+                "next_replica_seq": info.next_replica_seq,
+                "replica_ids": [r.replica_id for r in info.replicas],
+            }
+        payload = pickle.dumps(
+            {"deployments": state, "proxy_cfg": self._proxy_cfg})
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._write_ckpt(payload)
+            return
+        loop.run_in_executor(None, self._write_ckpt, payload)
+
+    def _write_ckpt(self, payload: bytes) -> None:
+        try:
+            self._kv().call("kv_put", {"key": self.CKPT_KEY,
+                                       "value": payload})
+        except Exception:  # noqa: BLE001 — best effort; next change retries
+            logger.warning("serve: controller checkpoint failed",
+                           exc_info=True)
+
+    async def restore(self) -> bool:
+        """Rebuild state from the KV checkpoint after a controller death:
+        re-adopt replicas that survived (they are detached-named actors),
+        let reconcile respawn the rest. Returns True if state was found."""
+        import pickle
+
+        import ray_tpu
+
+        try:
+            value = self._kv().call("kv_get",
+                                    {"key": self.CKPT_KEY})["value"]
+        except Exception:  # noqa: BLE001
+            return False
+        if not value:
+            return False
+        snap = pickle.loads(value)
+        import cloudpickle
+
+        for name, rec in snap.get("deployments", {}).items():
+            user_cls, init_args, init_kwargs, config = cloudpickle.loads(
+                rec["blob"])
+            info = _DeploymentInfo(user_cls, init_args, init_kwargs, config)
+            info.target = rec["target"]
+            info.next_replica_seq = rec["next_replica_seq"]
+            for replica_id in rec["replica_ids"]:
+                try:
+                    handle = ray_tpu.get_actor(
+                        f"SERVE_REPLICA::{replica_id}",
+                        namespace=SERVE_NAMESPACE)
+                except Exception:  # noqa: BLE001 — died with controller
+                    continue
+                rep = _ReplicaInfo(handle, replica_id)
+                rep.state = REPLICA_STARTING  # re-proven by reconcile ping
+                info.replicas.append(rep)
+            self._deployments[name] = info
+            logger.info("serve: restored deployment %s (re-adopted %d/%d "
+                        "replicas)", name, len(info.replicas),
+                        len(rec["replica_ids"]))
+        self._proxy_cfg = snap.get("proxy_cfg")
+        self._rebuild_routing_table()
+        return True
+
+    def _drop_checkpoint(self) -> None:
+        try:
+            self._kv().call("kv_del", {"key": self.CKPT_KEY})
+        except Exception:  # noqa: BLE001
+            pass
 
     # ---------------------------------------------------------------- API
     # All public methods are async so every mutation runs on the actor's
@@ -98,6 +197,7 @@ class ServeController:
         # Config-only updates (route_prefix, max_concurrent_queries) must
         # reach routers even when the replica set doesn't change.
         self._rebuild_routing_table()
+        self._checkpoint()
         logger.info("serve: deployed %s (target=%d)", name,
                     self._deployments[name].target)
 
@@ -107,6 +207,7 @@ class ServeController:
             for rep in info.replicas:
                 self._stop_replica(rep)
             self._rebuild_routing_table()
+            self._checkpoint()
 
     async def wait_ready(self, name: str, timeout_s: float = 60.0) -> bool:
         deadline = time.time() + timeout_s
@@ -164,18 +265,119 @@ class ServeController:
             for rep in info.replicas:
                 self._stop_replica(rep)
         self._deployments.clear()
+        for handle in self._proxies.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        self._proxies.clear()
+        self._drop_checkpoint()
         self._bump()
         del ray_tpu
 
     # ----------------------------------------------------------- reconcile
 
     async def reconcile_forever(self, period_s: float = 0.1) -> None:
+        proxy_tick = 0.0
         while not self._shutdown:
             try:
                 await self._reconcile_once()
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.exception("serve reconcile error")
+            if self._proxy_cfg is not None and \
+                    time.time() - proxy_tick >= 1.0:
+                proxy_tick = time.time()
+                try:
+                    await self._reconcile_proxies()
+                except Exception:  # noqa: BLE001
+                    logger.exception("serve proxy reconcile error")
             await asyncio.sleep(period_s)
+
+    # ------------------------------------------------------ proxy management
+
+    async def set_proxy_config(self, host: str, port: int,
+                               every_node: bool) -> None:
+        """Controller-managed HTTP proxies (reference http_state.py:110
+        HTTPProxyStateManager): one per alive node (every_node) or head
+        only, health-checked and replaced on death."""
+        self._proxy_cfg = {"host": host, "port": port,
+                           "every_node": every_node}
+        self._checkpoint()
+        await self._reconcile_proxies()
+
+    async def proxy_status(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        out = {}
+        for node_hex, handle in list(self._proxies.items()):
+            port = await loop.run_in_executor(
+                None, functools.partial(_try_proxy_port, handle))
+            out[node_hex] = {"alive": port is not None, "port": port}
+        del ray_tpu
+        return out
+
+    async def _reconcile_proxies(self) -> None:
+        import ray_tpu
+        from ray_tpu.serve.proxy import HTTPProxy
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        cfg = self._proxy_cfg
+        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        node_ip = {n["NodeID"]: n.get("NodeManagerAddress", "")
+                   for n in nodes}
+        if cfg["every_node"]:
+            want = {n["NodeID"] for n in nodes}
+        else:
+            want = {n["NodeID"] for n in nodes if n.get("IsHead")} or \
+                {nodes[0]["NodeID"]} if nodes else set()
+        loop = asyncio.get_running_loop()
+        # Health-check managed proxies; drop the dead and the unwanted.
+        for node_hex, handle in list(self._proxies.items()):
+            if node_hex not in want:
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._proxies.pop(node_hex, None)
+                continue
+            port = await loop.run_in_executor(
+                None, functools.partial(_try_proxy_port, handle))
+            if port is None:
+                logger.warning("serve: proxy on node %s died — replacing",
+                               node_hex[:12])
+                self._proxies.pop(node_hex, None)
+        # The configured port binds once PER HOST: on a real multi-host
+        # cluster every node's proxy listens on cfg["port"]; in the
+        # in-process sim (all "nodes" share one IP) only the first proxy
+        # on that IP gets it and the rest fall back to ephemeral ports.
+        ips_with_cfg_port = {node_ip.get(nh) for nh in self._proxies}
+        for node_hex in sorted(want - set(self._proxies),
+                               key=lambda nh: (node_ip.get(nh, ""), nh)):
+            try:
+                existing = ray_tpu.get_actor(
+                    f"SERVE_PROXY::{node_hex[:16]}",
+                    namespace=SERVE_NAMESPACE)
+                self._proxies[node_hex] = existing
+                ips_with_cfg_port.add(node_ip.get(node_hex))
+                continue
+            except Exception:  # noqa: BLE001 — create fresh
+                pass
+            ip = node_ip.get(node_hex)
+            port = cfg["port"] if ip not in ips_with_cfg_port else 0
+            ips_with_cfg_port.add(ip)
+            handle = ray_tpu.remote(HTTPProxy).options(
+                name=f"SERVE_PROXY::{node_hex[:16]}",
+                namespace=SERVE_NAMESPACE,
+                lifetime="detached", max_concurrency=256, num_cpus=0.01,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_hex),
+            ).remote(cfg["host"], port)
+            self._proxies[node_hex] = handle
+            logger.info("serve: started proxy on node %s (port %s)",
+                        node_hex[:12], port or "ephemeral")
 
     async def _reconcile_once(self) -> None:
         loop = asyncio.get_running_loop()
@@ -246,6 +448,7 @@ class ServeController:
 
         if changed:
             self._rebuild_routing_table()
+            self._checkpoint()  # replica set moved: keep recovery current
 
     def _autoscale_decision(self, info: _DeploymentInfo) -> int:
         cfg = info.config.autoscaling
@@ -331,6 +534,16 @@ class ServeController:
                 asyncio.get_running_loop().create_task(notify())
             except RuntimeError:
                 pass  # called outside the loop (sync method): next bump
+
+
+def _try_proxy_port(handle) -> Optional[int]:
+    """The proxy's bound port, or None when it is dead/unreachable."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get(handle.ready.remote(), timeout=5.0)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _try_ping(handle, timeout_s: float) -> str:
