@@ -11,9 +11,11 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # Default rules: FSDP shards embed dim; TP shards mlp/hidden + heads; SP
-# shards sequence; batch over dp+fsdp.
+# shards sequence; batch over (dcn_dp +) dp + fsdp — dcn_dp is the
+# cross-slice data-parallel axis of a multi-slice mesh (laid out
+# slowest-varying by MeshSpec.dcn_axes so its gradient psum rides DCN).
 DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", ("dcn_dp", "dp", "fsdp")),
     ("seq", "sp"),
     ("embed", "fsdp"),
     ("mlp", "tp"),

@@ -1,0 +1,110 @@
+"""Atari north-star: real ALE when available, synthetic native-shape proof
+otherwise.
+
+Reference: `rllib/tuned_examples/ppo/atari-ppo.yaml:1-35` (the
+reward-vs-timestep thresholds) and the release learning tests. `ale-py`
+is not installable in this environment (zero egress), so the real-ALE
+learning run is skip-gated; the identical pipeline — Atari connectors
+(grayscale+resize+framestack), CNN module, uint8 rollout transport — is
+proven on the synthetic Atari-shaped env at the NATIVE 210x160x3
+observation shape.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.tuned_examples import (
+    ATARI_PPO,
+    TUNED_EXAMPLES,
+    atari_available,
+    run_tuned,
+)
+
+
+def test_tuned_example_registry_matches_reference():
+    """The four Atari PPO north-stars exist with reference thresholds."""
+    assert set(ATARI_PPO) == {"breakout-ppo", "beamrider-ppo", "qbert-ppo",
+                              "spaceinvaders-ppo"}
+    bk = TUNED_EXAMPLES["breakout-ppo"]
+    cfg = bk.config_builder()
+    assert cfg.env == "ALE/Breakout-v5"
+    assert cfg.lr == 5e-5 and cfg.clip_param == 0.1
+    assert bk.stop_reward == 30.0
+
+
+@pytest.mark.skipif(not atari_available(),
+                    reason="ale-py/gymnasium[atari] not installed")
+def test_breakout_ppo_learns():
+    """Real-ALE learning run (only where ale-py exists): PPO reaches the
+    tuned-example threshold within a CI-scaled budget."""
+    result = run_tuned(TUNED_EXAMPLES["breakout-ppo"],
+                       max_timesteps=2_000_000)
+    assert result.curve, "no reward curve recorded"
+    assert result.best_reward >= 10.0, (
+        f"Breakout PPO made no progress: {result.curve[-5:]}")
+
+
+def test_atari_native_shape_pipeline(ray_start_shared):
+    """The full Atari preprocessing pipeline at the NATIVE 210x160x3 uint8
+    shape — grayscale+resize to 84x84, framestack 4, CNN module, actor
+    rollout workers — executes end-to-end with finite losses."""
+    from ray_tpu.rllib import PPO, PPOConfig
+    from ray_tpu.rllib.connectors import atari_connectors
+    from ray_tpu.rllib.env import VectorEnv
+
+    class SyntheticAtariEnv(VectorEnv):
+        """Atari-native observations (210x160x3 uint8), 4 actions."""
+
+        n_actions = 4
+
+        def __init__(self, n_envs: int, seed: int = 0):
+            self.n_envs = n_envs
+            self._rng = np.random.default_rng(seed)
+            self._t = np.zeros(n_envs, dtype=np.int32)
+
+        @property
+        def obs_shape(self):
+            return (210, 160, 3)
+
+        @property
+        def obs_dtype(self):
+            return np.uint8
+
+        def reset(self):
+            self._t[:] = 0
+            return self._obs()
+
+        def _obs(self):
+            return self._rng.integers(0, 255,
+                                      (self.n_envs, *self.obs_shape),
+                                      dtype=np.uint8)
+
+        def step(self, actions):
+            self._t += 1
+            rewards = (np.asarray(actions) == 1).astype(np.float32)
+            dones = self._t >= 32
+            infos = {}
+            if dones.any():
+                infos["final_obs"] = self._obs()
+                self._t[dones] = 0
+            return self._obs(), rewards, dones, infos
+
+    algo = PPO(PPOConfig(
+        env=lambda n_envs, seed: SyntheticAtariEnv(n_envs, seed),
+        connectors=atari_connectors(),
+        num_rollout_workers=1,
+        num_envs_per_worker=2,
+        rollout_fragment_length=16,
+        sgd_minibatch_size=32,
+        num_sgd_iter=2,
+        seed=0,
+    ))
+    try:
+        m = algo.train()
+        assert np.isfinite(m["total_loss"])
+        m2 = algo.train()
+        assert np.isfinite(m2["total_loss"])
+        # Reward signal flows (action-1 reward on the synthetic env).
+        assert m2.get("episode_reward_mean") is not None
+    finally:
+        algo.stop()
