@@ -300,7 +300,10 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                     "pid": st.get("node_id", "node"),
                     "tid": f"worker:{st.get('worker_id')}",
                     "args": {"state": ev["state"],
-                             "task_id": ev["task_id"]},
+                             "task_id": ev["task_id"],
+                             **{k: st[k] for k in
+                                ("trace_id", "span_id", "parent_span_id")
+                                if st.get(k) is not None}},
                 })
         with open(filename, "w") as f:
             _json.dump(trace, f)

@@ -115,6 +115,10 @@ class TaskSpec:
     # owner until the task completes, by which time the executing worker
     # has registered its borrow (reference reference_count.h borrowers).
     nested_refs: List["ObjectID"] = field(default_factory=list)
+    # Distributed trace context (reference tracing_helper.py:35-81
+    # _inject_tracing_into_function): {trace_id, span_id, parent_span_id}
+    # — children submitted during execution inherit trace_id and parent.
+    trace_ctx: Optional[Dict[str, str]] = None
     # Provenance for state API / timeline
     submitted_at: float = field(default_factory=time.time)
 

@@ -16,6 +16,7 @@ before put; `get` returns numpy views that jax can device_put cheaply.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -28,6 +29,8 @@ from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.exceptions import RaySystemError
+
+logger = logging.getLogger(__name__)
 
 
 # The store owns segment lifetimes (delete() unlinks; shutdown sweeps).
@@ -92,6 +95,10 @@ class _LocalObject:
     spilled_path: Optional[str] = None
     pin_count: int = 0
     last_access: float = field(default_factory=time.monotonic)
+    # Cloud spill in flight: bytes held until the background upload lands
+    # (restores read from here without a network round trip; keeps the
+    # store lock free of WAN latency).
+    pending_spill: Optional[bytes] = None
 
 
 class ObjectStoreFullError(RaySystemError):
@@ -225,9 +232,11 @@ class SharedMemoryStore:
                 except Exception:
                     pass
             if entry.spilled_path:
+                path, entry.spilled_path = entry.spilled_path, None
+                entry.pending_spill = None  # uploader sees the tombstone
                 try:
-                    os.unlink(entry.spilled_path)
-                except OSError:
+                    self._unlink_spilled(path)
+                except Exception:  # noqa: BLE001
                     pass
 
     def _ensure_capacity(self, size: int):
@@ -248,25 +257,111 @@ class SharedMemoryStore:
                 )
             self._spill(victim)
 
+    _URI_MARK = "uri:"
+
+    def _cloud_spill_backend(self):
+        """(backend, key_prefix) when spill_dir is a bucket URI — on TPU
+        pods local disk dies with the VM, so spilled objects can target
+        gs:///s3:// through the storage seam (reference
+        external_storage.py:445 ExternalStorageSmartOpenImpl)."""
+        from ray_tpu.train import storage
+
+        if not storage.is_cloud_uri(self._spill_dir):
+            return None
+        return storage.get_backend(self._spill_dir)
+
     def _spill(self, entry: _LocalObject):
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, f"{self._session}_{entry.object_id.hex()}")
-        with open(path, "wb") as f:
-            f.write(entry.shm.buf[: entry.size])
+        # NOTE: never bind entry.shm.buf slices to a local — a live
+        # exported view makes shm.close() raise BufferError.
+        cloud = self._cloud_spill_backend()
+        if cloud is not None:
+            # Only the memcpy happens under the store lock; the WAN upload
+            # runs on a background thread (a multi-MB put over the network
+            # under self._lock would stall every store operation on the
+            # node). Until it lands, restores serve from pending_spill.
+            backend, prefix = cloud
+            key = (f"{prefix.rstrip('/')}/" if prefix else "") + \
+                f"{self._session}_{entry.object_id.hex()}"
+            entry.pending_spill = bytes(entry.shm.buf[: entry.size])
+            entry.spilled_path = self._URI_MARK + key
+            threading.Thread(target=self._upload_spill,
+                             args=(entry, backend, key),
+                             name="spill-upload", daemon=True).start()
+        else:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(
+                self._spill_dir, f"{self._session}_{entry.object_id.hex()}")
+            with open(path, "wb") as f:
+                f.write(entry.shm.buf[: entry.size])
+            entry.spilled_path = path
         entry.shm.close()
         entry.shm.unlink()
         entry.shm = None
-        entry.spilled_path = path
         self._used -= entry.size
+
+    def _upload_spill(self, entry: _LocalObject, backend, key: str):
+        mark = self._URI_MARK + key
+        with self._lock:
+            payload = entry.pending_spill
+            if payload is None or entry.spilled_path != mark:
+                return  # restored or deleted before the upload started
+        try:
+            backend.put(key, payload)
+        except Exception:  # noqa: BLE001 — bytes stay in pending_spill;
+            logger.warning("cloud spill upload of %s failed; keeping "
+                           "bytes in memory", entry.object_id,
+                           exc_info=True)
+            return
+        with self._lock:
+            if entry.spilled_path == mark:
+                entry.pending_spill = None
+                return
+        # Deleted (or restored) while the put was in flight: don't leak
+        # the bucket object.
+        try:
+            backend.delete(key)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _unlink_spilled(self, spilled_path: str):
+        if spilled_path.startswith(self._URI_MARK):
+            cloud = self._cloud_spill_backend()
+            if cloud is not None:
+                cloud[0].delete(spilled_path[len(self._URI_MARK):])
+            return
+        os.unlink(spilled_path)
 
     def _restore(self, entry: _LocalObject) -> memoryview:
         self._ensure_capacity(entry.size)
         shm = shared_memory.SharedMemory(
             name=_segment_name(self._session, entry.object_id), create=True, size=max(entry.size, 1)
         )
-        with open(entry.spilled_path, "rb") as f:
-            f.readinto(shm.buf[: entry.size])
-        os.unlink(entry.spilled_path)
+        try:
+            if entry.pending_spill is not None:
+                # Upload still in flight (or failed): the bytes are here.
+                shm.buf[: entry.size] = entry.pending_spill
+            elif entry.spilled_path.startswith(self._URI_MARK):
+                backend, _ = self._cloud_spill_backend()
+                data = backend.get(entry.spilled_path[len(self._URI_MARK):])
+                shm.buf[: entry.size] = data
+            else:
+                with open(entry.spilled_path, "rb") as f:
+                    f.readinto(shm.buf[: entry.size])
+        except BaseException:
+            # A transient fetch failure must not leak the named segment —
+            # the next read retries _restore, and a stale segment would
+            # make its SharedMemory(create=True) fail forever.
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        try:
+            self._unlink_spilled(entry.spilled_path)
+        except Exception:  # noqa: BLE001 — bytes already restored; a
+            pass           # failed cloud delete only leaks bucket bytes
+        entry.pending_spill = None
         entry.spilled_path = None
         entry.shm = shm
         self._used += entry.size

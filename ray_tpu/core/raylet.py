@@ -887,6 +887,7 @@ class Raylet:
                 "worker_id": worker.worker_id.hex()[:12] if worker else None,
                 "pid": worker.pid if worker else None,
                 "queued_at": spec.submitted_at,
+                **(spec.trace_ctx or {}),
             })
 
     # --------------------------------------------- worker-facing handlers

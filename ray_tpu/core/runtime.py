@@ -147,6 +147,16 @@ class CoreRuntime:
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
+        # Span propagation (reference tracing_helper.py:35-81): the trace
+        # context of the currently-executing task; child submissions
+        # inherit it. A ContextVar, not threading.local: async actor
+        # methods interleave on ONE event-loop thread, and each asyncio
+        # task needs its own copy (a thread-local would let concurrent
+        # async calls clobber each other's trace).
+        import contextvars
+
+        self._trace_cv = contextvars.ContextVar(
+            f"rtpu_trace_{id(self)}", default=None)
         # Metrics flush: user Counters/Gauges/Histograms in this process
         # surface at the GCS (rendered by /metrics on the dashboard).
         from ray_tpu.util.metrics import MetricsPusher
@@ -423,7 +433,24 @@ class CoreRuntime:
                 logger.warning("failed to publish actor result %s",
                                r["object_id"])
 
+    def child_trace_ctx(self) -> Dict[str, str]:
+        """A fresh span for a task being submitted from this context: same
+        trace as the currently-executing task (or a new root trace), with
+        the current span as parent."""
+        current = self._trace_cv.get()
+        span_id = os.urandom(8).hex()
+        if current:
+            return {"trace_id": current["trace_id"], "span_id": span_id,
+                    "parent_span_id": current["span_id"]}
+        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
+                "parent_span_id": None}
+
+    def set_trace_ctx(self, ctx: Optional[Dict[str, str]]):
+        self._trace_cv.set(ctx)
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        if spec.trace_ctx is None:
+            spec.trace_ctx = self.child_trace_ctx()
         spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)
         rec = _TaskRecord(spec=spec)
         with self._lock:
@@ -693,6 +720,8 @@ class CoreRuntime:
 
     def submit_actor_task(self, spec: TaskSpec, retry_on_restart: int = 1
                           ) -> List[ObjectID]:
+        if spec.trace_ctx is None:
+            spec.trace_ctx = self.child_trace_ctx()
         rec = _TaskRecord(spec=spec)
         with self._lock:
             self._tasks[spec.task_id.binary()] = rec

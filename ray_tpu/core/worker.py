@@ -187,6 +187,8 @@ class WorkerRuntime(CoreRuntime):
         resolve args + function, run (awaiting coroutines), store results.
         Returns (results, error_blob)."""
         self.executing_task = spec
+        # Children submitted by the body join this task's trace.
+        self.set_trace_ctx(spec.trace_ctx)
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
         try:
@@ -211,6 +213,7 @@ class WorkerRuntime(CoreRuntime):
                 self._stopping.set()
         finally:
             self.executing_task = None
+            self.set_trace_ctx(None)
         return results, error_blob
 
     def _execute(self, spec: TaskSpec):
@@ -255,6 +258,7 @@ class WorkerRuntime(CoreRuntime):
             "node_id": os.environ.get("RAY_TPU_NODE_ID", "")[:12],
             "worker_id": self.worker_id.hex()[:12], "pid": os.getpid(),
             "queued_at": spec.submitted_at,
+            **(spec.trace_ctx or {}),
         }
         try:
             self.raylet.call_async("direct_task_event", {"events": [
@@ -377,6 +381,7 @@ class WorkerRuntime(CoreRuntime):
     def _run_actor_method(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
+        self.set_trace_ctx(spec.trace_ctx)
         try:
             if spec.method_name == "__ray_terminate__":
                 self._graceful_exit(conn, spec)
@@ -389,6 +394,7 @@ class WorkerRuntime(CoreRuntime):
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
         finally:
+            self.set_trace_ctx(None)
             with self._reply_lock:
                 self._actor_calls.pop(spec.task_id.binary(), None)
         self._reply_actor_result_once(conn, spec, results, error_blob)
@@ -396,6 +402,7 @@ class WorkerRuntime(CoreRuntime):
     async def _run_actor_method_async(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
+        self.set_trace_ctx(spec.trace_ctx)
         try:
             args, kwargs = self._resolve_args(spec)
             out = await method(*args, **kwargs)
@@ -412,6 +419,7 @@ class WorkerRuntime(CoreRuntime):
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
         finally:
+            self.set_trace_ctx(None)
             with self._reply_lock:
                 self._actor_calls.pop(spec.task_id.binary(), None)
         self._reply_actor_result_once(conn, spec, results, error_blob)

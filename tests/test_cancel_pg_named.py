@@ -136,3 +136,38 @@ def test_dqn_learner_interface_update():
     loss, metrics = learner.compute_loss(
         learner.params, {**batch, "_target_net": learner.target_net})
     assert float(loss) >= 0 and "q_mean" in metrics
+
+
+def test_trace_spans_propagate_through_nested_tasks(ray2):
+    """Span propagation (reference tracing_helper.py:35-81): a task
+    submitted from inside another task shares its trace_id and records
+    the parent's span as parent_span_id in the task events."""
+
+    @ray_tpu.remote
+    def child():
+        return "c"
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote(), timeout=60)
+
+    assert ray_tpu.get(parent.remote(), timeout=120) == "c"
+
+    deadline = time.monotonic() + 20
+    by_name = {}
+    while time.monotonic() < deadline:
+        events = ray_tpu.timeline()
+        by_name = {}
+        for ev in events:
+            if ev.get("state") in ("RUNNING", "FINISHED") and \
+                    ev.get("trace_id"):
+                short = ev["name"].rsplit(".", 1)[-1]
+                by_name.setdefault(short, ev)
+        if "parent" in by_name and "child" in by_name:
+            break
+        time.sleep(0.3)
+    assert "parent" in by_name and "child" in by_name, sorted(by_name)
+    p, c = by_name["parent"], by_name["child"]
+    assert c["trace_id"] == p["trace_id"], (p, c)
+    assert c["parent_span_id"] == p["span_id"], (p, c)
+    assert p.get("parent_span_id") is None  # driver-rooted trace
