@@ -586,9 +586,14 @@ class GcsServer:
         oids: List[ObjectID] = data["object_ids"]
         by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
         with self._lock:
+            freed: List[ObjectID] = []
             for oid in oids:
                 entry = self.objects.get(oid)
                 if entry is None:
+                    # Never registered in the directory (e.g. an unpublished
+                    # inline actor result) — it can still HOLD container
+                    # borrows on inner objects; release them.
+                    freed.append(oid)
                     continue
                 if entry.get("borrowers"):
                     entry["pending_free"] = True
@@ -596,6 +601,8 @@ class GcsServer:
                 self.objects.pop(oid, None)
                 for node_id in entry["nodes"]:
                     by_node[node_id].append(oid)
+                freed.append(oid)
+            self._cascade_container_borrows_locked(freed, by_node)
         self._delete_on_nodes(by_node)
         return {}
 
@@ -625,7 +632,8 @@ class GcsServer:
         return {}
 
     def _remove_borrow_locked(self, oid: ObjectID, borrower: str,
-                              by_node: Dict[NodeID, List[ObjectID]]):
+                              by_node: Dict[NodeID, List[ObjectID]],
+                              freed: Optional[List[ObjectID]] = None):
         entry = self.objects.get(oid)
         if entry is None:
             return
@@ -636,6 +644,28 @@ class GcsServer:
             self.objects.pop(oid, None)
             for node_id in entry["nodes"]:
                 by_node[node_id].append(oid)
+            if freed is not None:
+                freed.append(oid)
+
+    def _cascade_container_borrows_locked(self, freed: List[ObjectID],
+                                          by_node: Dict[NodeID, List[ObjectID]]):
+        """Containers (puts / task returns holding serialized ObjectRefs)
+        register their inner ids as borrows under the synthetic borrower
+        ``obj:<container-hex>`` (reference: contained-object-id tracking,
+        `reference_count.h` AddNestedObjectIds). When a container's entry is
+        freed, drop those borrows here — which may free inner containers in
+        turn (worklist, not recursion; the store lock is held throughout)."""
+        work = list(freed)
+        while work:
+            container = work.pop()
+            borrower = "obj:" + container.hex()
+            held = self.borrower_index.pop(borrower, None)
+            if not held:
+                continue
+            inner_freed: List[ObjectID] = []
+            for inner in held:
+                self._remove_borrow_locked(inner, borrower, by_node, inner_freed)
+            work.extend(inner_freed)
 
     def handle_borrow_remove(self, conn: Connection, data: Dict[str, Any]):
         oid: ObjectID = data["object_id"]
@@ -647,7 +677,9 @@ class GcsServer:
                 held.discard(oid)
                 if not held:
                     self.borrower_index.pop(borrower, None)
-            self._remove_borrow_locked(oid, borrower, by_node)
+            freed: List[ObjectID] = []
+            self._remove_borrow_locked(oid, borrower, by_node, freed)
+            self._cascade_container_borrows_locked(freed, by_node)
         self._delete_on_nodes(by_node)
         return {}
 
@@ -662,8 +694,10 @@ class GcsServer:
         by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
         with self._lock:
             held = self.borrower_index.pop(borrower, set())
+            freed: List[ObjectID] = []
             for oid in held:
-                self._remove_borrow_locked(oid, borrower, by_node)
+                self._remove_borrow_locked(oid, borrower, by_node, freed)
+            self._cascade_container_borrows_locked(freed, by_node)
         self._delete_on_nodes(by_node)
         return {"dropped": len(held)}
 

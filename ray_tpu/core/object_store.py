@@ -86,6 +86,13 @@ def _segment_name(session_suffix: str, object_id: ObjectID) -> str:
     return f"rtpu_{session_suffix}_{object_id.hex()}"
 
 
+def _swallow(fn, *args):
+    try:
+        fn(*args)
+    except Exception:  # noqa: BLE001 — background cleanup only
+        pass
+
+
 @dataclass
 class _LocalObject:
     object_id: ObjectID
@@ -99,6 +106,10 @@ class _LocalObject:
     # (restores read from here without a network round trip; keeps the
     # store lock free of WAN latency).
     pending_spill: Optional[bytes] = None
+    # Cloud restore in flight: set by the thread that owns the WAN download
+    # (performed OFF-lock, mirroring the spill side); other readers wait on
+    # it instead of stacking duplicate downloads.
+    restoring: Optional[threading.Event] = None
 
 
 class ObjectStoreFullError(RaySystemError):
@@ -184,17 +195,54 @@ class SharedMemoryStore:
     # -- reads ---------------------------------------------------------------
 
     def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
-        with self._lock:
-            entry = self._objects.get(object_id)
-            if entry is None or not entry.sealed:
-                return None
-            entry.last_access = time.monotonic()
-            self._objects.move_to_end(object_id)
-            if entry.shm is not None:
-                return entry.shm.buf[: entry.size]
-            if entry.spilled_path is not None:
-                return self._restore(entry)
-            return None
+        while True:
+            wait_ev = None
+            fetch_key = None
+            with self._lock:
+                entry = self._objects.get(object_id)
+                if entry is None or not entry.sealed:
+                    return None
+                entry.last_access = time.monotonic()
+                self._objects.move_to_end(object_id)
+                if entry.shm is not None:
+                    return entry.shm.buf[: entry.size]
+                if entry.spilled_path is None:
+                    return None
+                needs_wan = (entry.pending_spill is None
+                             and entry.spilled_path.startswith(self._URI_MARK))
+                if not needs_wan:
+                    return self._restore(entry)
+                # Cloud restore: the download must NOT run under the store
+                # lock (it would stall every store op on the node for the
+                # WAN round trip — the spill side moves uploads off-lock for
+                # the same reason). First reader claims the fetch; others
+                # park on the event and re-check.
+                if entry.restoring is not None:
+                    wait_ev = entry.restoring
+                else:
+                    entry.restoring = threading.Event()
+                    fetch_key = entry.spilled_path[len(self._URI_MARK):]
+            if wait_ev is not None:
+                wait_ev.wait(timeout=60)
+                continue
+            data = None
+            try:
+                backend, _ = self._cloud_spill_backend()
+                data = backend.get(fetch_key)
+            finally:
+                with self._lock:
+                    ev, entry.restoring = entry.restoring, None
+                    if ev is not None:
+                        ev.set()
+                    if data is not None:
+                        cur = self._objects.get(object_id)
+                        if (cur is entry and entry.spilled_path ==
+                                self._URI_MARK + fetch_key):
+                            # Stage the bytes so _restore's fast path (and
+                            # any parked readers) use them; a concurrent
+                            # delete already unlinked the bucket object —
+                            # then the bytes are simply dropped.
+                            entry.pending_spill = data
 
     def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
         buf = self.get_buffer(object_id)
@@ -327,7 +375,12 @@ class SharedMemoryStore:
         if spilled_path.startswith(self._URI_MARK):
             cloud = self._cloud_spill_backend()
             if cloud is not None:
-                cloud[0].delete(spilled_path[len(self._URI_MARK):])
+                # Callers hold the store lock; a WAN delete must not stall
+                # the node's store ops (same rationale as _upload_spill).
+                key = spilled_path[len(self._URI_MARK):]
+                threading.Thread(
+                    target=lambda: _swallow(cloud[0].delete, key),
+                    name="spill-delete", daemon=True).start()
             return
         os.unlink(spilled_path)
 
@@ -338,12 +391,10 @@ class SharedMemoryStore:
         )
         try:
             if entry.pending_spill is not None:
-                # Upload still in flight (or failed): the bytes are here.
+                # Cloud bytes: upload still in flight, upload failed, or
+                # staged by get_buffer's off-lock WAN download (the only
+                # route here for uri: paths).
                 shm.buf[: entry.size] = entry.pending_spill
-            elif entry.spilled_path.startswith(self._URI_MARK):
-                backend, _ = self._cloud_spill_backend()
-                data = backend.get(entry.spilled_path[len(self._URI_MARK):])
-                shm.buf[: entry.size] = data
             else:
                 with open(entry.spilled_path, "rb") as f:
                     f.readinto(shm.buf[: entry.size])

@@ -379,7 +379,12 @@ class RpcClient:
         except OSError as e:
             self._closed.set()
             with self._pending_lock:
-                self._pending.pop(msg_id, None)
+                slot = self._pending.pop(msg_id, None)
+            if callback is not None and slot is None:
+                # The reader's drain already delivered the loss to the
+                # callback; raising here would make ReconnectingClient
+                # resend with the same callback and fire it twice.
+                return
             raise ConnectionLost(str(e))
 
     def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:

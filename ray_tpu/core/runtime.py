@@ -322,9 +322,14 @@ class CoreRuntime:
         return oid
 
     def put_with_id(self, oid: ObjectID, value: Any):
+        from ray_tpu.object_ref import _NestedRefCapture
+
         with self._lock:
             self._owned_puts.add(oid.binary())
-        parts = serialization.serialize(value)
+        with _NestedRefCapture() as captured:
+            parts = serialization.serialize(value)
+        if captured:
+            self._register_container_refs(oid, captured)
         size = serialization.serialized_size(parts)
         if size <= GLOBAL_CONFIG.object_inline_max_bytes:
             blob = b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
@@ -337,6 +342,33 @@ class CoreRuntime:
             self.raylet.call("object_sealed",
                              {"object_id": oid, "size": size,
                               "owner": self.worker_id.hex()})
+
+    def _register_container_refs(self, container: ObjectID, captured):
+        """A put/return value embeds ObjectRefs: register the inner ids as
+        borrows held by the CONTAINER itself (synthetic borrower
+        ``obj:<hex>``, released by the GCS when the container's entry is
+        freed — see GcsServer._cascade_container_borrows_locked), so the
+        inner objects survive the producer dropping its own refs before any
+        consumer deserializes the container. Registered synchronously while
+        the producer's refs are still live, so the handoff cannot race the
+        inner objects' free (reference: contained-object-id capture in
+        `_private/serialization.py` / `reference_count.h`)."""
+        seen, inner = set(), []
+        for n in captured:
+            if n.binary() in seen or n == container:
+                continue
+            seen.add(n.binary())
+            inner.append(n)
+            self._ensure_dep_visible(n)
+        if not inner:
+            return
+        try:
+            self.gcs.call("borrow_add",
+                          {"object_ids": inner,
+                           "borrower_id": "obj:" + container.hex()},
+                          timeout=10)
+        except Exception:  # noqa: BLE001 — worst case: inner objects leak
+            pass           # until job end, never a premature free
 
     def _write_segment(self, oid: ObjectID, parts, size: int):
         from multiprocessing import shared_memory

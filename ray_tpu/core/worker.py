@@ -68,6 +68,9 @@ class WorkerRuntime(CoreRuntime):
         # threadsafe futures never enter RUNNING), so the cancel handler
         # and the coroutine's own error path may both try to reply.
         self._replied: set = set()
+        # Cancels that arrived while their call was in the submit window
+        # (registered in _actor_calls but future not yet created).
+        self._cancel_requested: set = set()
         self._reply_lock = threading.Lock()
         super().__init__(
             gcs_address=os.environ["RAY_TPU_GCS_ADDRESS"],
@@ -282,7 +285,16 @@ class WorkerRuntime(CoreRuntime):
         return vals
 
     def _store_result(self, oid: ObjectID, value: Any) -> Dict[str, Any]:
-        parts = serialization.serialize(value)
+        from ray_tpu.object_ref import _NestedRefCapture
+
+        with _NestedRefCapture() as captured:
+            parts = serialization.serialize(value)
+        if captured:
+            # Return value embeds ObjectRefs: pin them to the result
+            # container's lifetime BEFORE replying — this worker's own
+            # borrows drop as soon as its locals go out of scope, which can
+            # be before the caller deserializes the result.
+            self._register_container_refs(oid, captured)
         size = serialization.serialized_size(parts)
         if size <= GLOBAL_CONFIG.object_inline_max_bytes:
             blob = b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
@@ -334,29 +346,25 @@ class WorkerRuntime(CoreRuntime):
         with self._reply_lock:
             if tid in self._actor_calls:  # not yet completed
                 self._actor_calls[tid] = (fut, conn, spec)
+            pending_cancel = tid in self._cancel_requested
+            self._cancel_requested.discard(tid)
+        if pending_cancel:
+            # A cancel arrived in the submit window (between registration
+            # and future creation): complete it now instead of dropping it.
+            self._try_cancel_actor_call(tid, fut, conn, spec)
         return {"accepted": True}
 
-    def _handle_cancel_actor_task(self, conn: Connection, data: Dict[str, Any]):
-        """ray.cancel on an actor task: queued calls are dropped (caller
-        gets TaskCancelledError); async running calls get CancelledError
-        at their next await; sync running calls are uninterruptible
-        (reference semantics: only queued/async actor tasks cancel)."""
-        tid = data["task_id"].binary()
-        with self._reply_lock:
-            rec = self._actor_calls.get(tid)
-        if rec is None or rec[0] is None:
-            return {"cancelled": False}
-        fut, caller_conn, spec = rec
+    def _try_cancel_actor_call(self, tid: bytes, fut, caller_conn: Connection,
+                               spec: TaskSpec) -> bool:
+        """Cancel a queued (or async mid-run — see _replied) call and report
+        the cancellation; the _replied guard suppresses a duplicate reply
+        from a coroutine that was actually executing."""
         cancelled = fut.cancel()
         if cancelled:
-            # Queued (or async mid-run — see _replied) call: report the
-            # cancellation; the guard suppresses a duplicate reply from a
-            # coroutine that was actually executing.
-            with self._reply_lock:
-                self._actor_calls.pop(tid, None)
             from ray_tpu.exceptions import TaskCancelledError
 
             with self._reply_lock:
+                self._actor_calls.pop(tid, None)
                 self._replied.add(tid)
                 if len(self._replied) > 4096:
                     # Stale never-ran entries; ids never recur, and a
@@ -368,7 +376,28 @@ class WorkerRuntime(CoreRuntime):
                 caller_conn, spec, [],
                 serialization.serialize_exception(
                     TaskCancelledError(spec.task_id), spec.name))
-        return {"cancelled": cancelled}
+        return cancelled
+
+    def _handle_cancel_actor_task(self, conn: Connection, data: Dict[str, Any]):
+        """ray.cancel on an actor task: queued calls are dropped (caller
+        gets TaskCancelledError); async running calls get CancelledError
+        at their next await; sync running calls are uninterruptible
+        (reference semantics: only queued/async actor tasks cancel)."""
+        tid = data["task_id"].binary()
+        with self._reply_lock:
+            rec = self._actor_calls.get(tid)
+            if rec is not None and rec[0] is None:
+                # Submit window: the call is registered but its future
+                # doesn't exist yet. Mark it; the post-submit
+                # re-registration in _handle_actor_call completes the
+                # cancellation instead of silently no-opping.
+                self._cancel_requested.add(tid)
+                return {"cancelled": True}
+        if rec is None:
+            return {"cancelled": False}
+        fut, caller_conn, spec = rec
+        return {"cancelled":
+                self._try_cancel_actor_call(tid, fut, caller_conn, spec)}
 
     def _reply_actor_result_once(self, conn: Connection, spec: TaskSpec,
                                  results, error_blob):

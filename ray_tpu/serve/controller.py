@@ -20,6 +20,7 @@ import asyncio
 import functools
 import logging
 import math
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -75,6 +76,13 @@ class ServeController:
         # set_proxy_config; reconcile keeps one proxy per alive node.
         self._proxy_cfg: Optional[Dict[str, Any]] = None
         self._proxies: Dict[str, Any] = {}   # node hex -> proxy handle
+        # Checkpoint ordering: writes run off-loop, so two rapid snapshots
+        # (deploy then delete) could land out of order and persist stale
+        # state. A monotonic sequence taken on the loop thread is checked
+        # under _ckpt_lock so an older payload never overwrites a newer one.
+        self._ckpt_seq = 0
+        self._ckpt_written = 0
+        self._ckpt_lock = threading.Lock()
 
     # ------------------------------------------------- checkpoint/recovery
 
@@ -106,17 +114,23 @@ class ServeController:
             }
         payload = pickle.dumps(
             {"deployments": state, "proxy_cfg": self._proxy_cfg})
+        self._ckpt_seq += 1
+        seq = self._ckpt_seq
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
-            self._write_ckpt(payload)
+            self._write_ckpt(payload, seq)
             return
-        loop.run_in_executor(None, self._write_ckpt, payload)
+        loop.run_in_executor(None, self._write_ckpt, payload, seq)
 
-    def _write_ckpt(self, payload: bytes) -> None:
+    def _write_ckpt(self, payload: bytes, seq: int) -> None:
         try:
-            self._kv().call("kv_put", {"key": self.CKPT_KEY,
-                                       "value": payload})
+            with self._ckpt_lock:
+                if seq <= self._ckpt_written:
+                    return  # a newer snapshot already persisted
+                self._kv().call("kv_put", {"key": self.CKPT_KEY,
+                                           "value": payload})
+                self._ckpt_written = seq
         except Exception:  # noqa: BLE001 — best effort; next change retries
             logger.warning("serve: controller checkpoint failed",
                            exc_info=True)
@@ -164,8 +178,14 @@ class ServeController:
         return True
 
     def _drop_checkpoint(self) -> None:
+        # Under _ckpt_lock, and advancing the sequence past every queued
+        # writer: a stale _write_ckpt landing after the delete would
+        # resurrect torn-down deployments on the next controller restart.
+        self._ckpt_seq += 1
         try:
-            self._kv().call("kv_del", {"key": self.CKPT_KEY})
+            with self._ckpt_lock:
+                self._ckpt_written = self._ckpt_seq
+                self._kv().call("kv_del", {"key": self.CKPT_KEY})
         except Exception:  # noqa: BLE001
             pass
 
