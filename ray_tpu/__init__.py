@@ -79,6 +79,16 @@ def init(
             # Submitted-job entrypoints (and any child process of a cluster)
             # inherit the cluster address from the environment.
             address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address == "auto":
+            # Connect to the cluster `python -m ray_tpu start --head` left
+            # running on this machine (reference: /tmp/ray/ray_current_cluster).
+            from ray_tpu.scripts.cluster_cli import read_cluster_address
+
+            address = read_cluster_address()
+            if address is None:
+                raise RaySystemError(
+                    'init(address="auto"): no running cluster found — start '
+                    "one with `python -m ray_tpu start --head`")
         GLOBAL_CONFIG.initialize(_system_config)
         from ray_tpu.core.node import Node
 

@@ -50,9 +50,11 @@ class GCETPUConfig:
     preemptible: bool = False
     # Shell run by the VM at boot; {head_address} is substituted. The
     # default boots a worker node against the head's GCS.
+    # --host auto: the worker's raylet must advertise an address the head
+    # can dial, not loopback.
     startup_script: str = (
         "#!/bin/bash\n"
-        "python -m ray_tpu start --address={head_address} "
+        "python -m ray_tpu start --address={head_address} --host auto "
         "--labels tpu-vm-name={node_name}\n")
     extra_labels: Dict[str, str] = field(default_factory=dict)
 
@@ -193,6 +195,93 @@ class _MetadataAuthTransport:
         with urllib.request.urlopen(req, timeout=30) as resp:
             raw = resp.read()
         return json.loads(raw) if raw else {}
+
+
+class SubprocessFakeTPUTransport:
+    """Fake TPU API that EXECUTES each VM's startup script verbatim in a
+    subprocess (bash), so the join path a real TPU VM would take —
+    `python -m ray_tpu start --address=...` daemonizing a worker node —
+    is exercised end-to-end, not just recorded. DELETE terminates the
+    daemon the script started (a real API call deletes the VM).
+
+    Requires RAY_TPU_TMPDIR to point at this fake cluster's directory so
+    daemon records are discoverable and isolated per test.
+    """
+
+    def __init__(self, env: Optional[Dict[str, str]] = None,
+                 startup_timeout_s: float = 60.0):
+        import os as _os
+
+        self.env = dict(_os.environ)
+        self.env.update(env or {})
+        self.startup_timeout_s = startup_timeout_s
+        self.calls: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        # name -> {"body", "created", "pid", "node_id"}
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+
+    def _daemon_records(self) -> Dict[int, Dict[str, Any]]:
+        from ray_tpu.scripts.cluster_cli import read_daemon_records
+
+        return read_daemon_records(self.env.get("RAY_TPU_TMPDIR"))
+
+    def __call__(self, method: str, url: str, body: Optional[dict]) -> dict:
+        import os as _os
+        import subprocess
+        import tempfile
+
+        with self._lock:
+            self.calls.append({"method": method, "url": url, "body": body})
+        if method == "POST":
+            name = url.rsplit("nodeId=", 1)[-1]
+            script = body["metadata"]["startup-script"]
+            before = set(self._daemon_records())
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".sh", delete=False) as f:
+                f.write(script)
+                path = f.name
+            try:
+                proc = subprocess.run(
+                    ["bash", path], env=self.env, capture_output=True,
+                    text=True, timeout=self.startup_timeout_s)
+            finally:
+                _os.unlink(path)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"startup script failed (rc={proc.returncode}):\n"
+                    f"{proc.stdout}\n{proc.stderr}")
+            new = {pid: rec for pid, rec in self._daemon_records().items()
+                   if pid not in before and rec.get("role") == "worker"}
+            if len(new) != 1:
+                raise RuntimeError(
+                    f"startup script left {len(new)} new worker daemons "
+                    f"(expected 1): {new}")
+            pid, rec = next(iter(new.items()))
+            with self._lock:
+                self.nodes[name] = {"body": body, "created": time.time(),
+                                    "pid": pid, "node_id": rec["node_id"]}
+            return {"name": name}
+        if method == "DELETE":
+            import signal as _signal
+
+            name = url.rsplit("/", 1)[-1]
+            with self._lock:
+                rec = self.nodes.pop(name, None)
+            if rec is not None:
+                try:
+                    _os.kill(rec["pid"], _signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            return {}
+        if method == "GET":
+            out = []
+            with self._lock:
+                for name, rec in self.nodes.items():
+                    out.append(
+                        {"name": f"projects/p/locations/z/nodes/{name}",
+                         "state": "READY", "ray_node_id": rec["node_id"]})
+            return {"nodes": out}
+        raise ValueError(f"unexpected method {method}")
 
 
 class FakeTPUTransport:

@@ -51,13 +51,16 @@ class Node:
         object_store_memory: int = 0,
         session_dir: Optional[str] = None,
         labels: Optional[Dict[str, str]] = None,
+        gcs_host: str = "127.0.0.1",
+        gcs_port: int = 0,
+        host: str = "127.0.0.1",
     ):
         self.head = head
         self.session_dir = session_dir or default_session_dir()
         self.gcs: Optional[GcsServer] = None
         self.dashboard = None
         if head:
-            self.gcs = GcsServer()
+            self.gcs = GcsServer(host=gcs_host, port=gcs_port)
             self.gcs.start()
             self.gcs_address = self.gcs.address
             from ray_tpu.core.config import GLOBAL_CONFIG
@@ -84,11 +87,15 @@ class Node:
             total[TPU] = tpus
         if resources:
             total.update({k: float(v) for k, v in resources.items()})
-        total[f"node:{'127.0.0.1'}"] = 1.0
+        # Per-node affinity resource (reference: `node:<ip>` custom
+        # resource); uses the advertised host so it stays unique across
+        # machines.
+        total[f"node:{host}"] = 1.0
         self.raylet = Raylet(
             gcs_address=self.gcs_address,
             resources=total,
             session_dir=self.session_dir,
+            host=host,
             is_head=head,
             labels=labels,
             object_store_memory=object_store_memory,

@@ -5,6 +5,8 @@ Equivalent of the reference CLI surface (`ray status`, `ray list ...`,
 against a running cluster, addressed by --address (or RAY_TPU_ADDRESS).
 
 Commands:
+    start --head | --address=X     start a node daemon (see cluster_cli)
+    stop                           stop this machine's node daemons
     status                         cluster resources + node/actor summary
     list nodes|actors|jobs|tasks   entity tables
     summary tasks|actors           aggregated counts
@@ -38,9 +40,13 @@ def _dump(obj):
 
 
 def main(argv=None):
+    from ray_tpu.scripts import cluster_cli
+
     ap = argparse.ArgumentParser(prog="ray_tpu")
     ap.add_argument("--address", help="GCS address host:port")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    cluster_cli.add_start_parser(sub)
+    cluster_cli.add_stop_parser(sub)
     sub.add_parser("status")
     p_list = sub.add_parser("list")
     p_list.add_argument("what", choices=["nodes", "actors", "jobs", "tasks",
@@ -64,6 +70,12 @@ def main(argv=None):
                        help="breakpoint index to attach (default: newest)")
     p_dbg.add_argument("--list", action="store_true", dest="list_only")
     args = ap.parse_args(argv)
+
+    # Cluster lifecycle commands manage daemons; they never connect a driver.
+    if args.cmd == "start":
+        raise SystemExit(cluster_cli.cmd_start(args, args.address))
+    if args.cmd == "stop":
+        raise SystemExit(cluster_cli.cmd_stop(args))
 
     ray_tpu, owns_runtime = _connect(args.address)
     from ray_tpu import state

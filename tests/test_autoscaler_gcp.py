@@ -5,6 +5,9 @@ shape) and `_private/fake_multi_node/node_provider.py` (fake-cloud e2e
 pattern).
 """
 
+import os
+import subprocess
+import sys
 import time
 
 import pytest
@@ -17,13 +20,17 @@ from ray_tpu.autoscaler import (
     GCETPUNodeProvider,
     StandardAutoscaler,
 )
-from ray_tpu.autoscaler.gcp import CLUSTER_LABEL, TYPE_LABEL
+from ray_tpu.autoscaler.gcp import (
+    CLUSTER_LABEL,
+    TYPE_LABEL,
+    SubprocessFakeTPUTransport,
+)
 from ray_tpu.cluster_utils import Cluster
 
 
-def _config(**kw):
+def _config(head_address="10.0.0.2:6379", **kw):
     return GCETPUConfig(project="proj-1", zone="us-central2-b",
-                        cluster_name="rtpu", head_address="10.0.0.2:6379",
+                        cluster_name="rtpu", head_address=head_address,
                         accelerator_type="v5litepod-4", **kw)
 
 
@@ -80,6 +87,68 @@ def test_provider_adopts_preexisting_nodes():
 def test_node_resources_for_accelerator_type():
     provider = GCETPUNodeProvider(_config(), transport=FakeTPUTransport())
     assert provider.node_resources_for() == {"CPU": 32.0, "TPU": 4.0}
+
+
+def test_startup_script_joins_real_node(tmp_path):
+    """The provider's startup script — the exact command a real TPU VM
+    boots with — is EXECUTED in a subprocess and must daemonize a worker
+    that joins the head's GCS (this is the command-exists regression
+    guard: a typo'd CLI would fail here, not in production)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {"RAY_TPU_TMPDIR": str(tmp_path),
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    head = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "1"],
+        env={**os.environ, **env}, cwd="/tmp", capture_output=True,
+        text=True, timeout=90)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started at ")[1].split()[0]
+    try:
+        transport = SubprocessFakeTPUTransport(env=env)
+        provider = GCETPUNodeProvider(_config(head_address=address),
+                                      transport=transport)
+        handle = provider.create_node(provider.node_resources_for())
+        nodes = provider.non_terminated_nodes()
+        assert [n.name for n in nodes] == [handle.name]
+
+        # The joined node is visible to the GCS with the startup script's
+        # self-label, and resolve_node_id maps VM -> ray node through it.
+        probe = (
+            "import json, time, ray_tpu\n"
+            f"ray_tpu.init(address={address!r})\n"
+            "for _ in range(120):\n"
+            "    alive = [n for n in ray_tpu.nodes() if n['Alive']]\n"
+            "    if len(alive) == 2: break\n"
+            "    time.sleep(0.25)\n"
+            "print(json.dumps([\n"
+            "    {'id': n['NodeID'], 'labels': n.get('Labels', {})}\n"
+            "    for n in alive]))\n")
+        out = subprocess.run(
+            [sys.executable, "-c", probe], env={**os.environ, **env},
+            cwd="/tmp", capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        import json as _json
+
+        entries = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert len(entries) == 2, entries
+        view = {e["id"]: {"labels": e["labels"]} for e in entries}
+        # A fresh handle (no cached node_id) must resolve through the
+        # tpu-vm-name label the startup script registered — the real API
+        # returns no ray_node_id, so the label is the only mapping.
+        from ray_tpu.autoscaler.gcp import TPUNodeHandle
+
+        fresh = TPUNodeHandle(name=handle.name)
+        assert provider.resolve_node_id(fresh, view) is not None
+        assert provider.resolve_node_id(handle, view) is not None
+
+        provider.terminate_node(handle)
+        assert provider.non_terminated_nodes() == []
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "stop", "--force"],
+            env={**os.environ, **env}, cwd="/tmp", capture_output=True,
+            timeout=30)
 
 
 def test_fake_cloud_autoscaler_end_to_end():

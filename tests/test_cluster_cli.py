@@ -1,0 +1,153 @@
+"""Cluster bring-up from real OS processes via `python -m ray_tpu start`.
+
+Reference: `ray start/stop` (`python/ray/scripts/scripts.py:535,1231`) and
+the services layer that runs gcs/raylet as driver-independent processes
+(`python/ray/_private/services.py:1280,1353`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(tmpdir):
+    env = dict(os.environ)
+    env["RAY_TPU_TMPDIR"] = str(tmpdir)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _cli(args, env, timeout=90):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args], env=env, cwd="/tmp",
+        capture_output=True, text=True, timeout=timeout)
+
+
+def _stop_all(env):
+    try:
+        _cli(["stop", "--force"], env, timeout=30)
+    except Exception:
+        pass
+
+
+@pytest.fixture
+def cluster_env(tmp_path):
+    env = _env(tmp_path)
+    yield env
+    _stop_all(env)
+
+
+def test_two_node_cluster_from_cli_processes(cluster_env):
+    """Head + worker as separate daemonized OS processes; a driver
+    connects, runs work on both, disconnects, reconnects; `stop` tears
+    everything down."""
+    env = cluster_env
+    head = _cli(["start", "--head", "--num-cpus", "1",
+                 "--resources", '{"head_marker": 1}'], env)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started at ")[1].split()[0]
+
+    worker = _cli(["start", "--address", address, "--num-cpus", "1",
+                   "--resources", '{"worker_marker": 1}',
+                   "--labels", "kind=worker-vm"], env)
+    assert worker.returncode == 0, worker.stderr
+
+    # The daemons are real detached processes with records on disk.
+    base = str(env["RAY_TPU_TMPDIR"])
+    recs = []
+    for name in os.listdir(os.path.join(base, "daemons")):
+        with open(os.path.join(base, "daemons", name)) as f:
+            recs.append(json.load(f))
+    assert sorted(r["role"] for r in recs) == ["head", "worker"]
+    for r in recs:
+        os.kill(r["pid"], 0)  # alive
+
+    driver = r"""
+import time
+import ray_tpu
+
+ray_tpu.init(address="auto")
+deadline = time.time() + 30
+while time.time() < deadline:
+    alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+    if len(alive) == 2:
+        break
+    time.sleep(0.25)
+assert len(alive) == 2, alive
+assert any(n.get("Labels", {}).get("kind") == "worker-vm" for n in alive)
+
+@ray_tpu.remote(resources={"worker_marker": 0.1})
+def on_worker():
+    import os
+    return os.getpid()
+
+@ray_tpu.remote(resources={"head_marker": 0.1})
+def on_head():
+    import os
+    return os.getpid()
+
+wpid = ray_tpu.get(on_worker.remote(), timeout=60)
+hpid = ray_tpu.get(on_head.remote(), timeout=60)
+assert wpid != hpid
+print("DRIVER_OK", wpid, hpid)
+"""
+    for attempt in range(2):  # run twice: disconnect must not hurt the cluster
+        out = subprocess.run([sys.executable, "-c", driver], env=env,
+                             cwd="/tmp", capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "DRIVER_OK" in out.stdout
+
+    stop = _cli(["stop"], env)
+    assert stop.returncode == 0, stop.stderr
+    assert "stopped 2" in stop.stdout
+    for r in recs:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(r["pid"], 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+        else:
+            pytest.fail(f"daemon {r['pid']} still alive after stop")
+    assert not os.path.exists(os.path.join(base, "ray_current_cluster.json"))
+
+
+def test_head_survives_driver_sigkill(cluster_env):
+    """Driver crash (SIGKILL) must not take the cluster down — the head is
+    a separate process, unlike an in-process `ray_tpu.init()` node."""
+    env = cluster_env
+    head = _cli(["start", "--head", "--num-cpus", "1"], env)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started at ")[1].split()[0]
+
+    crasher = (
+        "import ray_tpu, os, time\n"
+        f"ray_tpu.init(address={address!r})\n"
+        "print('CONNECTED', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = subprocess.Popen([sys.executable, "-c", crasher], env=env,
+                            cwd="/tmp", stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "CONNECTED"
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+
+    check = (
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={address!r})\n"
+        "@ray_tpu.remote\n"
+        "def f(): return 41 + 1\n"
+        "assert ray_tpu.get(f.remote(), timeout=60) == 42\n"
+        "print('STILL_UP')\n")
+    out = subprocess.run([sys.executable, "-c", check], env=env, cwd="/tmp",
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "STILL_UP" in out.stdout
