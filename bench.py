@@ -342,6 +342,13 @@ def bench_core(quick: bool) -> dict:
         del back, ref
     out["put_gbps"] = arr.nbytes / put_s / 1e9
     out["get_gbps"] = arr.nbytes / get_s / 1e9
+    # Diagnostic: put bandwidth is memcpy/page-fault-bound; the MT native
+    # copy only engages when a C compiler was available to build fastcopy.
+    from ray_tpu._native import get_lib
+
+    native = get_lib() is not None
+    out["fastcopy_native"] = native
+    out["put_copy_threads"] = (os.cpu_count() or 1) if native else 1
     return out
 
 

@@ -141,9 +141,12 @@ class CoreRuntime:
         # oid -> [Event, refcount]; refcounted so concurrent getters of the
         # same object share wakeups and the entry outlives the first getter.
         self._object_events: Dict[bytes, list] = {}
-        # Any-completion signal for wait(): set on every task result and
-        # object event so waiters wake immediately instead of sleeping.
-        self._completion_event = threading.Event()
+        # Event-driven wait(): each active wait() registers a
+        # (deque, Event) watcher; completions append the finished task key
+        # (None = non-task object progress) and set the event, so waiters
+        # re-check only the refs that just completed instead of rescanning
+        # every pending ref per wake (which made wait on 1k refs O(n^2)).
+        self._wait_watchers: List[tuple] = []
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
@@ -239,7 +242,7 @@ class CoreRuntime:
                             r["object_id"].binary())
                 if pending:
                     self._publish_inline_results(pending)
-            self._completion_event.set()
+            self._notify_waiters(task_id.binary())
         elif method == "task_respill":
             # A raylet returned a queued task it can never run (the cluster
             # grew): resubmit through the normal routing path.
@@ -257,7 +260,7 @@ class CoreRuntime:
             entry = self._object_events.get(data["object_id"].binary())
             if entry is not None:
                 entry[0].set()
-            self._completion_event.set()
+            self._notify_waiters(None)
         elif method == "cancel_exec":
             self.on_cancel_exec(data["task_id"])
         elif method == "execute_task":
@@ -1039,7 +1042,17 @@ class CoreRuntime:
                                "size": len(blob)}, timeout=10)
             except Exception:  # noqa: BLE001
                 pass
-        self._completion_event.set()
+        self._notify_waiters(spec.task_id.binary())
+
+    def _notify_waiters(self, task_key: Optional[bytes]):
+        """Wake active wait() calls with the completed task's key (None:
+        non-task object progress — waiters rescan their store/GCS-backed
+        refs)."""
+        with self._lock:
+            watchers = list(self._wait_watchers)
+        for dq, ev in watchers:
+            dq.append(task_key)
+            ev.set()
 
     def cancel(self, oid: ObjectID, force: bool = False):
         """Cancel the task producing `oid` (reference ray.cancel): queued
@@ -1088,34 +1101,76 @@ class CoreRuntime:
 
     def wait(self, object_ids: List[ObjectID], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectID], List[ObjectID]]:
+        from collections import deque as _deque
+
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: List[ObjectID] = []
-        pending = list(object_ids)
-        while True:
-            # Clear-then-scan: a completion landing during the scan re-sets
-            # the event, so the next wait() returns immediately.
-            self._completion_event.clear()
-            still = []
-            for oid in pending:
+        # Register the watcher BEFORE the initial scan so a completion
+        # landing mid-scan is never missed (it lands in the deque and is
+        # drained on the first wake).
+        notif = (_deque(), threading.Event())
+        dq, ev = notif
+        with self._lock:
+            self._wait_watchers.append(notif)
+        ready_keys: set = set()
+        n_ready = 0
+        try:
+            # One full scan, then purely event-driven: completed task keys
+            # map back to their pending refs, so each completion costs O(1)
+            # instead of a rescan of every pending ref.
+            by_task: Dict[bytes, List[ObjectID]] = {}
+            others: List[ObjectID] = []
+            for oid in object_ids:
                 if self._is_ready(oid):
-                    ready.append(oid)
+                    ready_keys.add(oid.binary())
+                    n_ready += 1
+                    continue
+                tk = self._object_to_task.get(oid.binary())
+                if tk is not None:
+                    by_task.setdefault(tk, []).append(oid)
                 else:
-                    still.append(oid)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            # Task results and object events set _completion_event (pushed
-            # over the raylet channel) — wake instantly on progress; the
-            # 100 ms cap covers store-only transitions with no push.
-            wait_t = 0.1 if deadline is None \
-                else min(0.1, max(0.0, deadline - time.monotonic()))
-            self._completion_event.wait(wait_t)
+                    others.append(oid)
+            last_others_scan = time.monotonic()
+            while n_ready < num_returns and (by_task or others):
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                wait_t = 0.1 if deadline is None \
+                    else min(0.1, max(0.0, deadline - time.monotonic()))
+                ev.wait(wait_t)
+                ev.clear()
+                rescan_others = False
+                while dq:
+                    tk = dq.popleft()
+                    if tk is None:
+                        rescan_others = True
+                        continue
+                    for oid in by_task.pop(tk, ()):
+                        if self._is_ready(oid):
+                            ready_keys.add(oid.binary())
+                            n_ready += 1
+                        else:  # record pruned mid-wait: fall back to polling
+                            others.append(oid)
+                # Store/GCS-backed refs (no local task record) have no push
+                # channel here: poll at 100 ms, same as the old scan cadence.
+                if others and (rescan_others or
+                               time.monotonic() - last_others_scan >= 0.1):
+                    last_others_scan = time.monotonic()
+                    still = []
+                    for oid in others:
+                        if self._is_ready(oid):
+                            ready_keys.add(oid.binary())
+                            n_ready += 1
+                        else:
+                            still.append(oid)
+                    others = still
+        finally:
+            with self._lock:
+                try:
+                    self._wait_watchers.remove(notif)
+                except ValueError:
+                    pass
         # Preserve input order; cap ready at num_returns (overflow stays
         # in the pending list, matching the reference wait() contract).
-        ready_set = {r.binary() for r in ready}
-        ordered_ready = [o for o in object_ids if o.binary() in ready_set]
+        ordered_ready = [o for o in object_ids if o.binary() in ready_keys]
         capped = ordered_ready[:num_returns]
         capped_set = {o.binary() for o in capped}
         return capped, [o for o in object_ids if o.binary() not in capped_set]

@@ -80,6 +80,18 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _pid_is_ray_daemon(pid: int) -> bool:
+    """True only when `pid` is alive AND still our node daemon — a stale
+    record surviving a SIGKILLed daemon must never get a recycled PID
+    (some unrelated process) signalled."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read()
+    except OSError:
+        return _pid_alive(pid)  # no /proc (non-Linux): fall back to liveness
+    return b"ray_tpu" in cmdline
+
+
 def resolve_bind_host(host: str) -> str:
     """`auto` (and the unroutable-as-advertised 0.0.0.0) resolve to this
     machine's primary interface IP, so the bound address is the same one
@@ -87,20 +99,9 @@ def resolve_bind_host(host: str) -> str:
     throughout (NodeInfo.address, the cluster file, lease replies)."""
     if host not in ("auto", "0.0.0.0"):
         return host
-    import socket
+    from ray_tpu.util.net import primary_ip
 
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        try:
-            s.connect(("8.8.8.8", 80))  # no packet sent; routing lookup only
-            return s.getsockname()[0]
-        finally:
-            s.close()
-    except OSError:
-        try:
-            return socket.gethostbyname(socket.gethostname())
-        except OSError:
-            return "127.0.0.1"
+    return primary_ip()
 
 
 def _daemon_record_path(pid: int) -> str:
@@ -155,13 +156,15 @@ def cmd_start(args, global_address: Optional[str]) -> int:
               file=sys.stderr)
         return 2
     if args.head:
-        # Refuse to hijack a live cluster's file: a second head would
-        # silently redirect every init(address="auto") driver.
-        existing = read_cluster_address()
-        if existing is not None and any(
-                rec.get("role") == "head" and _pid_alive(pid)
-                for pid, rec in read_daemon_records().items()):
-            print(f"error: a cluster is already running at {existing} "
+        # Refuse to hijack a live cluster: a second head would silently
+        # redirect every init(address="auto") driver. The live head daemon
+        # RECORD is the signal — the cluster file alone can be pruned or
+        # corrupt while the head still runs.
+        live = [rec for pid, rec in read_daemon_records().items()
+                if rec.get("role") == "head" and _pid_is_ray_daemon(pid)]
+        if live:
+            addr = read_cluster_address() or live[0].get("gcs_address")
+            print(f"error: a cluster is already running at {addr} "
                   "(run `python -m ray_tpu stop` first)", file=sys.stderr)
             return 1
     if args.block:
@@ -297,6 +300,8 @@ def _stop_group(records: List[Dict[str, Any]], force: bool,
     waiting: List[int] = []
     stopped = 0
     for rec in records:
+        if not _pid_is_ray_daemon(rec["pid"]):
+            continue  # stale record: dead daemon or recycled PID
         try:
             os.kill(rec["pid"], sig)
             stopped += 1

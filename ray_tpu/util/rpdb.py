@@ -102,14 +102,9 @@ def _node_ip() -> str:
         host = addr.rsplit(":", 1)[0]
         if host not in ("", "0.0.0.0"):
             return host
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))  # no traffic sent; just picks a route
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except Exception:  # noqa: BLE001
-        return "127.0.0.1"
+    from ray_tpu.util.net import primary_ip
+
+    return primary_ip()
 
 
 def _advertise(entry: Dict[str, Any]) -> Optional[str]:
