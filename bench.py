@@ -348,7 +348,9 @@ def bench_core(quick: bool) -> dict:
 
     native = get_lib() is not None
     out["fastcopy_native"] = native
-    out["put_copy_threads"] = (os.cpu_count() or 1) if native else 1
+    from ray_tpu._native import _copy_threads
+
+    out["put_copy_threads"] = _copy_threads(arr.nbytes) if native else 1
     return out
 
 
@@ -422,6 +424,56 @@ def bench_impala(quick: bool) -> dict:
         }
     finally:
         algo.stop()
+
+
+def bench_learner_dp(quick: bool) -> dict:
+    """PPO learner SPS single-device vs dp=2 sharded (LearnerGroup
+    num_learners). Only one real chip is attached, so both run in a
+    subprocess on a 2-virtual-device CPU mesh — the comparison measures
+    the sharded-update machinery, not chip FLOPs."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import json, time
+import numpy as np
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.ppo import PPOConfig, PPOLearner
+from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+
+rows, iters = %d, %d
+rng = np.random.default_rng(0)
+batch = {
+    sb.OBS: rng.standard_normal((rows, 8)).astype(np.float32),
+    sb.ACTIONS: rng.integers(0, 4, rows).astype(np.int32),
+    sb.LOGP: np.log(np.full(rows, 0.25, np.float32)),
+    sb.ADVANTAGES: rng.standard_normal(rows).astype(np.float32),
+    sb.VF_PREDS: rng.standard_normal(rows).astype(np.float32),
+    sb.VALUE_TARGETS: rng.standard_normal(rows).astype(np.float32),
+}
+out = {}
+for nd in (1, 2):
+    module = DiscretePolicyModule(SpecDict(8, 4), hidden=(64, 64))
+    learner = PPOLearner(module, PPOConfig(), seed=0, num_devices=nd)
+    learner.update(batch)  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        learner.update(batch)
+    out[f"rllib_learner_sps_dp{nd}"] = rows * iters / (time.perf_counter() - t0)
+print(json.dumps(out))
+""" % ((4096, 20) if quick else (16384, 50))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2").strip()
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr[-500:])
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 # --------------------------------------------------------------------------- #
@@ -581,6 +633,10 @@ def main(out=None):
             extra.update(bench_impala(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["impala_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_learner_dp(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["learner_dp_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_serve:
         try:
             extra.update(bench_serve(args.quick))

@@ -130,6 +130,7 @@ class DQNConfig:
     hidden: tuple = (64, 64)
     seed: int = 0
     learner_mode: str = "local"
+    num_learners: int = 1
     learner_resources: Optional[Dict[str, float]] = None
     num_cpus_per_worker: float = 0.4
     rollout_platform: Optional[str] = "cpu"
@@ -143,12 +144,22 @@ class DQNLearner(Learner):
     """TD learner with a target network; `update_dqn` returns |TD| per
     sample so the prioritized buffer can reweight what it replays."""
 
-    def __init__(self, module: QModule, config, seed: int = 0):
+    batch_update_methods = ("update", "update_many", "update_dqn")
+
+    def __init__(self, module: QModule, config, seed: int = 0, **kw):
         import jax
 
-        super().__init__(module, config, seed=seed)
+        super().__init__(module, config, seed=seed, **kw)
         self.target_net = jax.tree.map(lambda x: x, self.params["net"])
-        self._update_dqn = jax.jit(self._update_dqn_impl)
+        if self.num_devices > 1:
+            rep = self._rep_sharding
+            self.target_net = jax.device_put(self.target_net, rep)
+            self._update_dqn = jax.jit(
+                self._update_dqn_impl,
+                in_shardings=(rep, rep, rep, self._batch_sharding),
+                out_shardings=(rep, rep, rep, self._batch_sharding))
+        else:
+            self._update_dqn = jax.jit(self._update_dqn_impl)
 
     def _td_loss(self, params, target_net, batch):
         """One TD/Huber loss definition shared by compute_loss (Learner
@@ -190,6 +201,12 @@ class DQNLearner(Learner):
         return loss, {"td_loss": loss, "q_mean": q_mean}
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self.num_devices > 1:
+            # The base sharded jit shards every batch leaf over dp; the
+            # target net must stay replicated, so route through the
+            # dedicated update whose jit takes it as its own argument.
+            metrics, _ = self.update_dqn(batch)
+            return metrics
         return super().update({**batch, "_target_net": self.target_net})
 
     def _update_dqn_impl(self, params, target_net, opt_state, batch):
@@ -208,9 +225,23 @@ class DQNLearner(Learner):
         return params, opt_state, metrics, jnp.abs(td)
 
     def update_dqn(self, batch: Dict[str, np.ndarray]):
+        orig_n = len(next(iter(batch.values())))
+        prepared = self._prepare_batch(batch, axis=0)
+        if prepared is None:
+            return {}, np.zeros(orig_n, np.float32)
         self.params, self.opt_state, metrics, td_abs = self._update_dqn(
-            self.params, self.target_net, self.opt_state, batch)
-        return {k: float(v) for k, v in metrics.items()}, np.asarray(td_abs)
+            self.params, self.target_net, self.opt_state, prepared)
+        from ray_tpu.rllib.learner import host_local_numpy
+
+        td_abs = host_local_numpy(td_abs)
+        if len(td_abs) < orig_n:
+            # dp trim dropped tail rows; keep their replay priority at the
+            # batch mean rather than zeroing them out.
+            pad = np.full(orig_n - len(td_abs),
+                          float(td_abs.mean()) if len(td_abs) else 1.0,
+                          np.float32)
+            td_abs = np.concatenate([td_abs, pad])
+        return {k: float(v) for k, v in metrics.items()}, td_abs
 
     def sync_target(self):
         import jax
@@ -254,9 +285,10 @@ class DQN:
             module=module)
         self.module = module
         self.learner_group = LearnerGroup(
-            lambda: DQNLearner(module, config, seed=config.seed),
+            lambda **kw: DQNLearner(module, config, seed=config.seed, **kw),
             mode=config.learner_mode,
-            resources=config.learner_resources)
+            resources=config.learner_resources,
+            num_learners=config.num_learners)
         if config.prioritized_replay:
             self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
                 config.buffer_capacity, alpha=config.prioritized_alpha,

@@ -114,6 +114,7 @@ class IMPALAConfig:
     hidden: tuple = (64, 64)
     seed: int = 0
     learner_mode: str = "local"        # local | remote
+    num_learners: int = 1              # dp-sharded update (see LearnerGroup)
     learner_resources: Optional[Dict[str, float]] = None
     num_cpus_per_worker: float = 0.4
     rollout_platform: Optional[str] = "cpu"
@@ -147,6 +148,10 @@ class IMPALAConfig:
 
 
 class IMPALALearner(Learner):
+    # Batches are time-major [T, n_envs, ...]: dp shards envs so the
+    # V-trace scan over T never crosses devices.
+    dp_axis = 1
+
     def compute_loss(self, params, batch):
         import jax
         import jax.numpy as jnp
@@ -221,9 +226,10 @@ class IMPALA:
         module = build_module_from_env_spec(self.workers.env_spec(),
                                             hidden=config.hidden)
         self.learner_group = LearnerGroup(
-            lambda: IMPALALearner(module, config, seed=config.seed),
+            lambda **kw: IMPALALearner(module, config, seed=config.seed, **kw),
             mode=config.learner_mode,
-            resources=config.learner_resources)
+            resources=config.learner_resources,
+            num_learners=config.num_learners)
         self.workers.sync_weights(self.learner_group.get_weights())
 
         self.iteration = 0
@@ -376,7 +382,7 @@ class IMPALA:
             sample_s += time.perf_counter() - t0
 
             t1 = time.perf_counter()
-            metrics = self.learner_group.update(batch)
+            metrics = self.learner_group.update(batch) or metrics
             learn_s += time.perf_counter() - t1
             self._updates += 1
             self._timesteps += frames_per_batch

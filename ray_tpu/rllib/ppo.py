@@ -45,6 +45,7 @@ class PPOConfig:
     hidden: tuple = (64, 64)
     seed: int = 0
     learner_mode: str = "local"        # local | remote
+    num_learners: int = 1              # dp-sharded update (see LearnerGroup)
     learner_resources: Optional[Dict[str, float]] = None
     num_cpus_per_worker: float = 0.4
     # Pin sampler processes to a jax platform ("cpu" keeps the chip free
@@ -133,9 +134,10 @@ class PPO:
         module = build_module_from_env_spec(self.workers.env_spec(),
                                             hidden=config.hidden)
         self.learner_group = LearnerGroup(
-            lambda: PPOLearner(module, config, seed=config.seed),
+            lambda **kw: PPOLearner(module, config, seed=config.seed, **kw),
             mode=config.learner_mode,
-            resources=config.learner_resources)
+            resources=config.learner_resources,
+            num_learners=config.num_learners)
         self.workers.sync_weights(self.learner_group.get_weights())
         self.iteration = 0
         self._timesteps = 0
@@ -165,12 +167,17 @@ class PPO:
                 # Whole epoch in one device dispatch (scan over
                 # minibatches) — the per-minibatch Python loop costs one
                 # host->chip round trip per step.
-                metrics = self.learner_group.update_many(stacked)
-                sgd_steps += len(next(iter(stacked.values())))
+                m = self.learner_group.update_many(stacked)
+                if m:
+                    metrics = m
+                    sgd_steps += len(next(iter(stacked.values())))
             if remainder and sb.batch_size(remainder) >= 2:
-                # The ragged tail trains too (one ordinary update).
-                metrics = self.learner_group.update(remainder)
-                sgd_steps += 1
+                # The ragged tail trains too (one ordinary update; may be
+                # a no-op {} if dp trimming leaves nothing).
+                m = self.learner_group.update(remainder)
+                if m:
+                    metrics = m
+                    sgd_steps += 1
             if not sgd_steps:
                 break
             if metrics.get("kl", 0.0) > cfg.kl_target:
