@@ -350,7 +350,9 @@ def bench_core(quick: bool) -> dict:
     out["fastcopy_native"] = native
     from ray_tpu._native import _copy_threads
 
-    out["put_copy_threads"] = _copy_threads(arr.nbytes) if native else 1
+    # Both the native MT copy and the ctypes-memmove fallback use this
+    # thread count; without either, the numpy path is single-threaded.
+    out["put_copy_threads"] = _copy_threads(arr.nbytes)
     return out
 
 

@@ -140,6 +140,16 @@ def gather_copy(dst: memoryview, parts: List[Buffer]) -> int:
             return lib.rtpu_gather_copy(cdst, srcs, lens, n)
         if total == 0:
             return 0
+    # No compiler on this host: ctypes.memmove still releases the GIL, so
+    # large copies split across threads parallelize page faulting and
+    # memcpy bandwidth just like the native MT path. Raw-pointer writes
+    # demand the same capacity guard the native path applies; an
+    # undersized dst falls through to numpy's bounds-checked copy.
+    total = sum(p.nbytes if isinstance(p, memoryview) else len(p)
+                for p in parts)
+    if (total >= _MT_THRESHOLD and _copy_threads(total) > 1
+            and memoryview(dst).nbytes >= total):
+        return _memmove_gather_mt(dst, parts, total)
     # Fallback: numpy byte views (fast path vs raw memoryview assignment).
     out = np.frombuffer(dst, dtype=np.uint8)
     pos = 0
@@ -150,6 +160,38 @@ def gather_copy(dst: memoryview, parts: List[Buffer]) -> int:
         out[pos: pos + len(src)] = src
         pos += len(src)
     return pos
+
+
+def _memmove_gather_mt(dst: memoryview, parts: List[Buffer],
+                       total: int) -> int:
+    """Compiler-free multithreaded gather: one ctypes.memmove (GIL
+    released) per [thread x part] sub-range."""
+    import concurrent.futures
+
+    d_addr, d_len, d_hold = _addr_len(dst)
+    spans = []  # (dst_offset, src_addr, nbytes) per part
+    pos = 0
+    keep = []
+    for p in parts:
+        addr, ln, hold = _addr_len(p)
+        keep.append(hold)
+        if ln:
+            spans.append((pos, addr, ln))
+        pos += ln
+    nthreads = _copy_threads(total)
+    chunk = (total + nthreads - 1) // nthreads
+    chunk = (chunk + 4095) & ~4095  # page-align slice bounds
+
+    def run(begin: int, end: int):
+        for off, s_addr, ln in spans:
+            lo, hi = max(begin, off), min(end, off + ln)
+            if lo < hi:
+                ctypes.memmove(d_addr + lo, s_addr + (lo - off), hi - lo)
+
+    with concurrent.futures.ThreadPoolExecutor(nthreads) as ex:
+        list(ex.map(lambda i: run(i * chunk, min((i + 1) * chunk, total)),
+                    range((total + chunk - 1) // chunk)))
+    return total
 
 
 def copy_at(dst: memoryview, offset: int, src: Buffer) -> None:

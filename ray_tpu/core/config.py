@@ -52,6 +52,8 @@ _flag("worker_idle_timeout_ms", int, 60000, "Idle worker reap timeout")
 _flag("max_pending_lease_requests", int, 10, "In-flight lease requests per scheduling key")
 _flag("object_inline_max_bytes", int, 100 * 1024, "Objects at or below this size travel inline through the control plane")
 _flag("object_store_memory_bytes", int, 0, "Shared-memory store capacity; 0 = auto (30% of system RAM)")
+_flag("segment_pool_max_bytes", int, 256 * 1024 * 1024,
+      "Warm shm segments recycled across puts (0 disables); see SegmentPool")
 _flag("object_spill_threshold", float, 0.8, "Store fullness fraction that triggers spilling")
 _flag("object_spill_dir", str, "", "Directory for spilled objects; empty = <session>/spill")
 _flag("task_max_retries", int, 3, "Default retries for normal tasks")

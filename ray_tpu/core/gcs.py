@@ -584,6 +584,11 @@ class GcsServer:
         MARKED pending-free; the actual free runs when the last borrower
         leaves (handle_borrow_remove)."""
         oids: List[ObjectID] = data["object_ids"]
+        # Owner-side segment recycling: for these ids the owner's raylet
+        # keeps the shm file (close mapping only) so the owner can rename
+        # it into its SegmentPool; other nodes unlink their copies.
+        defer = {o.binary() for o in data.get("defer_unlink", ())}
+        defer_node = data.get("defer_node")
         by_node: Dict[NodeID, List[ObjectID]] = defaultdict(list)
         with self._lock:
             freed: List[ObjectID] = []
@@ -603,13 +608,19 @@ class GcsServer:
                     by_node[node_id].append(oid)
                 freed.append(oid)
             self._cascade_container_borrows_locked(freed, by_node)
-        self._delete_on_nodes(by_node)
-        return {}
+        self._delete_on_nodes(by_node, defer, defer_node)
+        return {"freed": freed}
 
-    def _delete_on_nodes(self, by_node: Dict[NodeID, List[ObjectID]]):
+    def _delete_on_nodes(self, by_node: Dict[NodeID, List[ObjectID]],
+                         defer: Optional[set] = None,
+                         defer_node: Optional[NodeID] = None):
         for node_id, node_oids in by_node.items():
+            msg: Dict[str, Any] = {"object_ids": node_oids}
+            if defer and node_id == defer_node:
+                msg["skip_unlink"] = [o for o in node_oids
+                                      if o.binary() in defer]
             try:
-                self._raylet(node_id).call("delete_objects", {"object_ids": node_oids}, timeout=5)
+                self._raylet(node_id).call("delete_objects", msg, timeout=5)
             except Exception:
                 pass
 

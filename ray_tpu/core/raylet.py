@@ -1478,8 +1478,9 @@ class Raylet:
         return {}
 
     def handle_delete_objects(self, conn: Connection, data: Dict[str, Any]):
+        skip = {o.binary() for o in data.get("skip_unlink", ())}
         for oid in data["object_ids"]:
-            self.store.delete(oid)
+            self.store.delete(oid, skip_unlink=oid.binary() in skip)
         return {}
 
     def handle_contains_object(self, conn: Connection, data: Dict[str, Any]):

@@ -93,6 +93,10 @@ class CoreRuntime:
                                 push_handler=self._on_raylet_push)
         self.store = ObjectStoreClient(session_suffix)
         self.session_suffix = session_suffix
+        from ray_tpu.core.object_store import SegmentPool
+
+        self._segment_pool = SegmentPool(
+            session_suffix, GLOBAL_CONFIG.segment_pool_max_bytes)
         if job_id is None:
             resp = self.gcs.call("register_job",
                                  {"pid": os.getpid(), "namespace": namespace,
@@ -341,7 +345,7 @@ class CoreRuntime:
                            "owner": self.worker_id.hex()})
             self._object_cache[oid.binary()] = value
         else:
-            self._write_segment(oid, parts, size)
+            self._write_segment(oid, parts, size, reusable=True)
             self.raylet.call("object_sealed",
                              {"object_id": oid, "size": size,
                               "owner": self.worker_id.hex()})
@@ -373,11 +377,28 @@ class CoreRuntime:
         except Exception:  # noqa: BLE001 — worst case: inner objects leak
             pass           # until job end, never a premature free
 
-    def _write_segment(self, oid: ObjectID, parts, size: int):
+    def _write_segment(self, oid: ObjectID, parts, size: int,
+                       reusable: bool = False):
+        """reusable: this process owns the object (a put, not a task
+        return written on the owner's behalf) and may recycle the warm
+        segment through its SegmentPool when the last reference drops."""
         from multiprocessing import shared_memory
 
         from ray_tpu._native import gather_copy
 
+        shm = None
+        if reusable:
+            shm = self._segment_pool.acquire(oid, size)
+        if shm is not None:
+            # Warm pooled segment: pages pre-faulted at reclaim time, the
+            # copy runs at memcpy speed (cold tmpfs writes fault+zero
+            # every page and run 3-5x slower).
+            try:
+                gather_copy(shm.buf[:size], parts)
+            finally:
+                shm.close()
+            self._segment_pool.track(oid, size)
+            return
         shm = shared_memory.SharedMemory(
             name=_segment_name(self.session_suffix, oid), create=True, size=max(size, 1))
         try:
@@ -386,6 +407,8 @@ class CoreRuntime:
             shm.close()
             from ray_tpu.core.object_store import _untrack
             _untrack(shm)
+        if reusable:
+            self._segment_pool.track(oid, size)
 
     # ------------------------------------------------------ task submission
 
@@ -1304,7 +1327,11 @@ class CoreRuntime:
             return
         with self._lock:
             self._free_buffer.append(oid)
-            flush = len(self._free_buffer) >= 100
+            # Pool-tracked puts flush now: their segments only become
+            # reusable once the directory confirms the free, and a warm
+            # segment idling in the batch buffer is a wasted recycle.
+            flush = (len(self._free_buffer) >= 100
+                     or self._segment_pool.is_tracked(oid))
             if not flush and self._free_timer is None:
                 self._free_timer = threading.Timer(1.0, self._flush_free_buffer)
                 self._free_timer.daemon = True
@@ -1320,13 +1347,43 @@ class CoreRuntime:
             if not self._free_buffer:
                 return
             batch, self._free_buffer = self._free_buffer, []
+        pool = self._segment_pool
+        msg: Dict[str, Any] = {"object_ids": batch}
+        tracked = [o for o in batch if pool.is_tracked(o)]
+        if tracked:
+            msg["defer_unlink"] = tracked
+            msg["defer_node"] = self.node_id
         try:
-            self.gcs.call("free_objects", {"object_ids": batch}, timeout=5)
+            resp = self.gcs.call("free_objects", msg, timeout=5)
         except Exception:
-            pass
+            for oid in tracked:
+                pool.forget(oid)
+            return
+        if not tracked:
+            return
+        freed = {o.binary() for o in (resp or {}).get("freed", ())}
+        for oid in tracked:
+            if oid.binary() in freed:
+                ok = pool.reclaim(
+                    oid,
+                    can_reuse=lambda o=oid: self.store.release_if_unused(o))
+                if not ok:
+                    # The raylet skipped the unlink on our behalf; if the
+                    # segment didn't make it into the pool (exports still
+                    # live, pool full), remove the orphaned file now.
+                    try:
+                        os.unlink("/dev/shm/" + _segment_name(
+                            self.session_suffix, oid))
+                    except OSError:
+                        pass
+            else:
+                # Deferred (still borrowed): the eventual free unlinks it
+                # on the raylet as usual; nothing to recycle.
+                pool.forget(oid)
 
     def shutdown(self):
         self._flush_free_buffer()
+        self._segment_pool.close()
         if self._borrowed:
             # Graceful exit drops every borrow in one call so pending
             # frees fire now instead of leaking until worker-death cleanup.

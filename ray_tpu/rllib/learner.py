@@ -416,18 +416,32 @@ class _ShardedLearnerGroup:
         import ray_tpu
 
         axis = self.dp_axis + (1 if method == "update_many" else 0)
+        orig_rows = min(np.asarray(v).shape[axis] for v in batch.values())
         slices = self._split(batch, self.n, axis)
         if any(v.shape[axis] == 0 for v in slices[0].values()):
-            return {}
+            if method in ("update", "update_many"):
+                return {}  # clean no-op, like the local drop-last path
+            # Methods returning (metrics, per-row aux) cannot no-op
+            # without breaking their callers' unpacking — misconfig.
+            raise ValueError(
+                f"batch of {orig_rows} rows is too small to split across "
+                f"{self.n} learners for {method}; raise train_batch_size "
+                f"or lower num_learners")
         refs = [w.update_slice.remote(method, s)
                 for w, s in zip(self.workers, slices)]
         results = ray_tpu.get(refs)
         if isinstance(results[0], tuple):
             # (metrics, per-row aux) shape — e.g. DQN's |TD| priorities:
             # metrics are replicated, the aux rows concatenate back in
-            # rank order (slices were contiguous).
+            # rank order (slices were contiguous). Drop-last trimming may
+            # have shed tail rows; re-pad so callers indexing with the
+            # ORIGINAL batch's indices (replay priority updates) line up.
             metrics = results[0][0]
             aux = np.concatenate([np.asarray(r[1]) for r in results])
+            if len(aux) < orig_rows:
+                fill = float(aux.mean()) if len(aux) else 1.0
+                aux = np.concatenate(
+                    [aux, np.full(orig_rows - len(aux), fill, aux.dtype)])
             return metrics, aux
         return results[0]
 
