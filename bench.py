@@ -510,21 +510,30 @@ def bench_serve(quick: bool) -> dict:
 
         port = serve.http_port()
 
-        def one_echo(i: int):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}/Echo",
-                data=_json.dumps(i).encode(),
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.read()
-
         n_http_echo = 100 if quick else 500
-        one_echo(0)
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(16) as pool:
-            list(pool.map(one_echo, range(n_http_echo)))
-        out["serve_echo_http_rps"] = n_http_echo / (
-            time.perf_counter() - t0)
+        # Async client (keep-alive, one thread): measures the serving
+        # stack, not a thread-per-request client's own overhead.
+        import asyncio as _asyncio
+
+        async def echo_load(n: int) -> float:
+            import aiohttp
+
+            url = f"http://127.0.0.1:{port}/Echo"
+            sem = _asyncio.Semaphore(16)
+            async with aiohttp.ClientSession() as session:
+
+                async def one(i):
+                    async with sem:
+                        async with session.post(url, json=i) as resp:
+                            await resp.read()
+
+                await one(0)  # warm the route + connection pool
+                t0 = time.perf_counter()
+                await _asyncio.gather(*(one(i) for i in range(n)))
+                return time.perf_counter() - t0
+
+        out["serve_echo_http_rps"] = n_http_echo / _asyncio.run(
+            echo_load(n_http_echo))
     finally:
         serve.delete("Echo")
 

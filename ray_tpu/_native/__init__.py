@@ -162,12 +162,26 @@ def gather_copy(dst: memoryview, parts: List[Buffer]) -> int:
     return pos
 
 
+_mm_pool = None
+
+
+def _memmove_pool():
+    global _mm_pool
+    if _mm_pool is None:
+        import concurrent.futures
+
+        with _lock:
+            if _mm_pool is None:
+                _mm_pool = concurrent.futures.ThreadPoolExecutor(
+                    min(16, os.cpu_count() or 1),
+                    thread_name_prefix="fastcopy-mm")
+    return _mm_pool
+
+
 def _memmove_gather_mt(dst: memoryview, parts: List[Buffer],
                        total: int) -> int:
     """Compiler-free multithreaded gather: one ctypes.memmove (GIL
     released) per [thread x part] sub-range."""
-    import concurrent.futures
-
     d_addr, d_len, d_hold = _addr_len(dst)
     spans = []  # (dst_offset, src_addr, nbytes) per part
     pos = 0
@@ -188,9 +202,9 @@ def _memmove_gather_mt(dst: memoryview, parts: List[Buffer],
             if lo < hi:
                 ctypes.memmove(d_addr + lo, s_addr + (lo - off), hi - lo)
 
-    with concurrent.futures.ThreadPoolExecutor(nthreads) as ex:
-        list(ex.map(lambda i: run(i * chunk, min((i + 1) * chunk, total)),
-                    range((total + chunk - 1) // chunk)))
+    list(_memmove_pool().map(
+        lambda i: run(i * chunk, min((i + 1) * chunk, total)),
+        range((total + chunk - 1) // chunk)))
     return total
 
 

@@ -151,6 +151,12 @@ class CoreRuntime:
         # re-check only the refs that just completed instead of rescanning
         # every pending ref per wake (which made wait on 1k refs O(n^2)).
         self._wait_watchers: List[tuple] = []
+        # get_future(): task key -> [resolve callbacks]; drained on task
+        # completion into the lazily-created resolver pool (async callers
+        # — the Serve proxy — await values without parking a thread per
+        # in-flight request).
+        self._future_waiters: Dict[bytes, List[Any]] = {}
+        self._future_pool = None
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
@@ -1073,9 +1079,60 @@ class CoreRuntime:
         refs)."""
         with self._lock:
             watchers = list(self._wait_watchers)
+            resolvers = (self._future_waiters.pop(task_key, ())
+                         if task_key is not None else ())
         for dq, ev in watchers:
             dq.append(task_key)
             ev.set()
+        for resolve in resolvers:
+            self._resolver_pool().submit(resolve)
+
+    def _resolver_pool(self):
+        if self._future_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                if self._future_pool is None:
+                    self._future_pool = ThreadPoolExecutor(
+                        2, thread_name_prefix="ref-future")
+        return self._future_pool
+
+    def get_future(self, oid: ObjectID):
+        """concurrent.futures.Future resolving to the object's value.
+
+        Async servers (`asyncio.wrap_future`) await completions without a
+        blocked thread per request: the future's resolve (a local fetch +
+        deserialize — the object is ready by then) runs on a small shared
+        pool fed by task-completion events. Refs with no local task record
+        fall back to a pooled blocking get.
+        """
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def resolve():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(self._get_one(oid, None))
+            except BaseException as e:  # noqa: BLE001 — delivered to awaiter
+                fut.set_exception(e)
+
+        task_key = self._object_to_task.get(oid.binary())
+        rec = self._tasks.get(task_key) if task_key is not None else None
+        if rec is None or rec.event.is_set():
+            self._resolver_pool().submit(resolve)
+            return fut
+        with self._lock:
+            self._future_waiters.setdefault(task_key, []).append(resolve)
+        if rec.event.is_set():
+            # Completion landed between the check and the registration;
+            # the notifier may have already drained — drain idempotently.
+            with self._lock:
+                resolvers = self._future_waiters.pop(task_key, ())
+            for r in resolvers:
+                self._resolver_pool().submit(r)
+        return fut
 
     def cancel(self, oid: ObjectID, force: bool = False):
         """Cancel the task producing `oid` (reference ray.cancel): queued
@@ -1384,6 +1441,8 @@ class CoreRuntime:
     def shutdown(self):
         self._flush_free_buffer()
         self._segment_pool.close()
+        if self._future_pool is not None:
+            self._future_pool.shutdown(wait=False)
         if self._borrowed:
             # Graceful exit drops every borrow in one call so pending
             # frees fire now instead of leaking until worker-death cleanup.

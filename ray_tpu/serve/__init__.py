@@ -107,6 +107,35 @@ def deployment(_target=None, *, name: Optional[str] = None,
     return wrap(_target) if _target is not None else wrap
 
 
+def ingress(app):
+    """Expose an ASGI application as a deployment's HTTP interface.
+
+    Reference `serve.ingress` (`python/ray/serve/api.py`): FastAPI /
+    Starlette / any ASGI3 callable. HTTP requests routed to the
+    deployment are translated to ASGI scope events on the replica
+    (`replica.py:_handle_asgi`); streamed bodies relay back through the
+    proxy's stream protocol.
+
+    ``app`` may be the ASGI callable itself, a zero-arg factory
+    returning one (for apps that don't pickle), or a one-arg factory
+    receiving the deployment instance (routes needing deployment state)::
+
+        @serve.deployment
+        @serve.ingress(fastapi_app)
+        class Api: ...
+    """
+
+    def wrap(cls):
+        if not isinstance(cls, type):
+            raise TypeError(
+                "serve.ingress decorates the deployment class; apply it "
+                "under @serve.deployment")
+        cls.__serve_asgi_app__ = app
+        return cls
+
+    return wrap
+
+
 # --------------------------------------------------------------------------- #
 # Cluster-facing operations
 # --------------------------------------------------------------------------- #
@@ -382,6 +411,6 @@ def deploy_config(config, *, timeout_s: float = 60.0):
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "batch", "build", "delete", "deploy_config",
-    "deployment", "get_deployment_handle", "http_port", "run", "shutdown",
-    "start", "status",
+    "deployment", "get_deployment_handle", "http_port", "ingress", "run",
+    "shutdown", "start", "status",
 ]

@@ -514,7 +514,8 @@ class ServeController:
         opts["namespace"] = SERVE_NAMESPACE
         actor_cls = ray_tpu.remote(Replica)
         handle = actor_cls.options(**opts).remote(
-            name, info.user_cls, info.init_args, info.init_kwargs)
+            name, info.user_cls, info.init_args, info.init_kwargs,
+            replica_id)
         logger.info("serve: starting replica %s", replica_id)
         return _ReplicaInfo(handle, replica_id)
 

@@ -47,6 +47,7 @@ class HTTPProxy:
 
         controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                        namespace=SERVE_NAMESPACE)
+        self._runtime = ray_tpu._global_runtime
         self._router = Router(controller)
         # First table fetch is blocking — keep it off the event loop.
         await asyncio.get_running_loop().run_in_executor(
@@ -72,32 +73,135 @@ class HTTPProxy:
         if deployment is None:
             return web.json_response(
                 {"error": f"no deployment for path {path!r}"}, status=404)
-        if request.can_read_body:
-            raw = await request.read()
-            try:
-                payload = json.loads(raw) if raw else None
-            except json.JSONDecodeError:
-                payload = raw.decode("utf-8", "replace")
-        else:
-            payload = dict(request.query) or None
+        entry = self._table_entry(deployment)
+        prefix = (entry or {}).get("route_prefix", "/") or "/"
+        body = await request.read() if request.can_read_body else b""
+        http_req = {
+            "method": request.method,
+            # ASGI path is relative to the deployment's mount point
+            # (root_path), matching how the reference mounts FastAPI apps
+            # under their route_prefix.
+            "path": self._strip_prefix(path, prefix),
+            "root_path": prefix.rstrip("/"),
+            "query_string": request.query_string.encode("latin-1"),
+            "headers": [(k.encode("latin-1"), v.encode("latin-1"))
+                        for k, v in request.headers.items()],
+            "client": (request.remote or "127.0.0.1", 0),
+            "body": body,
+        }
         loop = asyncio.get_running_loop()
         try:
-            result = await loop.run_in_executor(
-                None, self._dispatch, deployment, payload)
+            # Fast path: non-blocking assign (no executor hop). Blocking
+            # admission control falls back to a thread; either way the
+            # result is awaited via the runtime's future registry (no
+            # thread parked per in-flight request).
+            import functools
+
+            ref = self._router.try_assign(deployment, "handle_http",
+                                          (http_req,), {})
+            if ref is None:
+                ref = await loop.run_in_executor(
+                    None, functools.partial(
+                        self._router.assign, deployment, "handle_http",
+                        (http_req,), {}, timeout_s=30.0))
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(self._runtime.get_future(ref)),
+                timeout=60.0)
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": "request timed out after 60s"}, status=500)
         except Exception as e:  # noqa: BLE001 — user code error → 500
             return web.json_response(
                 {"error": f"{type(e).__name__}: {e}"}, status=500)
+        return await self._respond(request, deployment, result)
+
+    @staticmethod
+    def _strip_prefix(path: str, prefix: str) -> str:
+        if prefix != "/" and path.startswith(prefix.rstrip("/")):
+            rest = path[len(prefix.rstrip("/")):]
+            return rest or "/"
+        return path
+
+    def _table_entry(self, deployment: str) -> Optional[dict]:
+        with self._router._lock:
+            return self._router._table.get(deployment)
+
+    async def _respond(self, request, deployment: str, result):
+        from aiohttp import web
+
+        if isinstance(result, dict) and result.get("__serve_http__"):
+            headers = {k: v for k, v in result.get("headers") or []}
+            sid = result.get("stream")
+            if sid is None:
+                return web.Response(status=result["status"], headers=headers,
+                                    body=result.get("body") or b"")
+            # Streamed ASGI body: first chunk(s) already in hand, relay
+            # the rest from the replica's stream queue. Chunked framing
+            # owns the length — the app's content-length (e.g. a
+            # FileResponse) would make aiohttp reject chunked mode.
+            headers.pop("content-length", None)
+            headers.pop("transfer-encoding", None)
+            resp = web.StreamResponse(status=result["status"],
+                                      headers=headers)
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            await resp.write(result.get("body") or b"")
+            ok = await self._relay_stream(deployment, sid, resp.write)
+            if not ok:
+                # Truncated (generator error / replica gone): abort the
+                # connection so the client can't mistake a partial body
+                # for a complete 200.
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            await resp.write_eof()
+            return resp
+        if isinstance(result, dict) and result.get("__serve_stream__"):
+            # Plain deployment returned a generator: stream items as
+            # chunked text/bytes.
+            resp = web.StreamResponse(status=200)
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+
+            async def write(item):
+                if isinstance(item, (bytes, bytearray, memoryview)):
+                    await resp.write(bytes(item))
+                elif isinstance(item, str):
+                    await resp.write(item.encode())
+                else:
+                    await resp.write((json.dumps(item) + "\n").encode())
+
+            ok = await self._relay_stream(deployment,
+                                          result["__serve_stream__"], write)
+            if not ok:
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            await resp.write_eof()
+            return resp
         if isinstance(result, (dict, list, int, float, bool)) \
                 or result is None:
             return web.json_response({"result": result})
         return web.Response(text=str(result))
 
-    def _dispatch(self, deployment: str, payload):
-        import ray_tpu
-
-        ref = self._router.assign(deployment, "__call__", (payload,), {},
-                                  timeout_s=30.0)
-        return ray_tpu.get(ref, timeout=60.0)
+    async def _relay_stream(self, deployment: str, sid: str, write) -> bool:
+        """Drain a replica-side stream (stream_next pulls) into `write`.
+        Returns False on truncation (stream error / replica gone)."""
+        handle = self._router.replica_for_stream(deployment, sid)
+        if handle is None:
+            logger.warning("stream %s: replica left the table", sid)
+            return False
+        while True:
+            ref = handle.stream_next.remote(sid)
+            batch = await asyncio.wrap_future(
+                self._runtime.get_future(ref))
+            for item in batch.get("items") or []:
+                await write(item)
+            if batch.get("error"):
+                logger.warning("stream %s failed: %s", sid, batch["error"])
+                return False
+            if batch.get("done"):
+                return True
 
     def _match(self, path: str) -> Optional[str]:
         with self._router._lock:

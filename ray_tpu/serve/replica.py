@@ -24,14 +24,46 @@ class Replica:
     (stats/ping/prepare_shutdown) never starve behind user requests."""
 
     def __init__(self, deployment_name: str, user_cls, init_args,
-                 init_kwargs):
+                 init_kwargs, replica_id: str = ""):
         self._deployment = deployment_name
+        self._replica_id = replica_id
         self._user = user_cls(*init_args, **(init_kwargs or {}))
+        self._asgi_app = self._resolve_asgi_app(user_cls)
         self._ongoing = 0
         self._processed = 0
         self._errored = 0
         self._started_at = time.time()
         self._draining = False
+        # Streamed responses in flight: id -> [queue, pump_task, last_use]
+        # (events: ("chunk", item) | ("end", None) | ("error", str)).
+        # Reaped after STREAM_IDLE_S without a pull — an HTTP client that
+        # disconnects mid-stream would otherwise leak the queue and a
+        # pump coroutine forever.
+        self._streams: Dict[str, list] = {}
+        self._stream_seq = 0
+
+    STREAM_IDLE_S = 120.0
+
+    def _resolve_asgi_app(self, user_cls):
+        """serve.ingress attachment: the ASGI callable itself, a zero-arg
+        factory (apps that don't pickle), or a one-arg factory receiving
+        the deployment instance (routes that need deployment state)."""
+        app = getattr(user_cls, "__serve_asgi_app__", None)
+        if app is None:
+            return None
+        params = []
+        try:
+            params = [p for p in inspect.signature(app).parameters.values()
+                      if p.default is p.empty
+                      and p.kind in (p.POSITIONAL_ONLY,
+                                     p.POSITIONAL_OR_KEYWORD)]
+        except (TypeError, ValueError):
+            pass
+        if len(params) >= 2:
+            return app       # ASGI callable: (scope, receive, send)
+        if len(params) == 1:
+            return app(self._user)
+        return app()
 
     async def handle_request(self, method_name: str, args, kwargs) -> Any:
         if self._draining:
@@ -54,6 +86,11 @@ class Replica:
                                             **(kwargs or {})))
                 if inspect.iscoroutine(out):
                     out = await out
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                # Streamed result: pump items through a queue the caller
+                # drains with stream_next (reference streaming generators,
+                # `handle.options(stream=True)`).
+                return {"__serve_stream__": self._pump_generator(out)}
             self._processed += 1
             return out
         except Exception:
@@ -61,6 +98,184 @@ class Replica:
             raise
         finally:
             self._ongoing -= 1
+
+    def _pump_generator(self, gen) -> str:
+        queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+        loop = asyncio.get_running_loop()
+
+        async def pump():
+            try:
+                if inspect.isasyncgen(gen):
+                    async for item in gen:
+                        await queue.put(("chunk", item))
+                else:
+                    sentinel = object()
+                    while True:
+                        item = await loop.run_in_executor(
+                            None, next, gen, sentinel)
+                        if item is sentinel:
+                            break
+                        await queue.put(("chunk", item))
+                await queue.put(("end", None))
+            except Exception as e:  # noqa: BLE001 — delivered to consumer
+                await queue.put(("error", f"{type(e).__name__}: {e}"))
+
+        task = asyncio.ensure_future(pump())
+        return self._register_stream(queue, task)
+
+    # ------------------------------------------------------------- HTTP
+
+    async def handle_http(self, request: Dict[str, Any]) -> Any:
+        """One HTTP request, translated by the proxy to a plain dict
+        (method/path/query_string/headers/body). ASGI deployments
+        (serve.ingress) get a full ASGI scope; plain deployments get the
+        decoded JSON payload, preserving the simple wire format."""
+        if self._asgi_app is not None:
+            return await self._handle_asgi(request)
+        body = request.get("body") or b""
+        if body:
+            import json
+
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                payload = body.decode("utf-8", "replace")
+        else:
+            from urllib.parse import parse_qsl
+
+            qs = dict(parse_qsl(
+                (request.get("query_string") or b"").decode("latin-1")))
+            payload = qs or None
+        return await self.handle_request("__call__", (payload,), {})
+
+    async def _handle_asgi(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Run the ASGI app; buffered responses return whole, streamed
+        ones (more_body chunks) hand back a stream id the proxy drains
+        via stream_next (reference `http_proxy.py:355` pipes ASGI sends
+        straight to the socket; here they cross an actor boundary)."""
+        self._ongoing += 1
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request["method"],
+            "scheme": "http",
+            "path": request["path"],
+            "raw_path": request["path"].encode("latin-1"),
+            "query_string": request.get("query_string") or b"",
+            "root_path": request.get("root_path") or "",
+            "headers": [(k, v) for k, v in request.get("headers") or []],
+            "client": tuple(request.get("client") or ("127.0.0.1", 0)),
+            "server": ("127.0.0.1", 0),
+        }
+        body = request.get("body") or b""
+        # Bounded: an abandoned stream must not buffer the app's whole
+        # remaining body; the app's send() backpressures instead and the
+        # idle reaper cancels the pump.
+        queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+        state = {"status": 200, "headers": [], "started": False}
+
+        body_sent = {"done": False}
+
+        async def receive():
+            if not body_sent["done"]:
+                body_sent["done"] = True
+                return {"type": "http.request", "body": body,
+                        "more_body": False}
+            return {"type": "http.disconnect"}
+
+        async def send(event):
+            if event["type"] == "http.response.start":
+                state["status"] = event["status"]
+                state["headers"] = [
+                    (bytes(k).decode("latin-1"), bytes(v).decode("latin-1"))
+                    for k, v in event.get("headers") or []]
+                state["started"] = True
+            elif event["type"] == "http.response.body":
+                chunk = event.get("body") or b""
+                if chunk:
+                    await queue.put(("chunk", chunk))
+                if not event.get("more_body"):
+                    await queue.put(("end", None))
+
+        async def run_app():
+            try:
+                await self._asgi_app(scope, receive, send)
+                await queue.put(("end", None))
+            except Exception as e:  # noqa: BLE001 — app error -> 500
+                await queue.put(("error", f"{type(e).__name__}: {e}"))
+            finally:
+                self._ongoing -= 1
+                self._processed += 1
+
+        task = asyncio.ensure_future(run_app())
+        # Drain eagerly: if the app finishes (or errors) before streaming
+        # past one chunk, answer in one shot; otherwise register a stream.
+        chunks = []
+        while True:
+            kind, item = await queue.get()
+            if kind == "chunk":
+                chunks.append(item)
+                if not task.done():
+                    # App still producing: stream the rest.
+                    sid = self._register_stream(queue, task)
+                    return {"__serve_http__": True, "status": state["status"],
+                            "headers": state["headers"],
+                            "body": b"".join(chunks), "stream": sid}
+            elif kind == "end":
+                return {"__serve_http__": True, "status": state["status"],
+                        "headers": state["headers"],
+                        "body": b"".join(chunks)}
+            else:  # error
+                self._errored += 1
+                return {"__serve_http__": True, "status": 500,
+                        "headers": [("content-type", "text/plain")],
+                        "body": item.encode()}
+
+    def _register_stream(self, queue: asyncio.Queue, task) -> str:
+        self._reap_idle_streams()
+        self._stream_seq += 1
+        sid = f"{self._replica_id}:{self._stream_seq}"
+        self._streams[sid] = [queue, task, time.monotonic()]
+        return sid
+
+    def _reap_idle_streams(self):
+        now = time.monotonic()
+        for sid, (queue, task, last) in list(self._streams.items()):
+            if now - last > self.STREAM_IDLE_S:
+                self._streams.pop(sid, None)
+                if task is not None and not task.done():
+                    task.cancel()
+
+    async def stream_next(self, sid: str, max_items: int = 64,
+                          timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Pull the next batch of items from a registered stream."""
+        rec = self._streams.get(sid)
+        if rec is None:
+            return {"items": [], "done": True,
+                    "error": "unknown stream (expired or replica restart)"}
+        queue = rec[0]
+        rec[2] = time.monotonic()
+        items, done, error = [], False, None
+        try:
+            kind, item = await asyncio.wait_for(queue.get(), timeout_s)
+        except asyncio.TimeoutError:
+            return {"items": [], "done": False}
+        while True:
+            if kind == "chunk":
+                items.append(item)
+            elif kind == "end":
+                done = True
+            else:
+                done, error = True, item
+            if done or len(items) >= max_items or queue.empty():
+                break
+            kind, item = queue.get_nowait()
+        if done:
+            self._streams.pop(sid, None)
+        else:
+            rec[2] = time.monotonic()
+        return {"items": items, "done": done, "error": error}
 
     def stats(self) -> Dict[str, Any]:
         return {

@@ -96,10 +96,50 @@ class Router:
                             f"{timeout_s}s")
                     wait_t = min(wait_t, remaining)
                 self._lock.wait(timeout=wait_t)
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+        return self._submit(handle, replica_id, method_name, args, kwargs)
+
+    def try_assign(self, deployment: str, method_name: str, args, kwargs):
+        """Non-blocking assign: submit iff a replica has headroom right
+        now, else None (caller falls back to the blocking path). Lets an
+        event loop dispatch without an executor hop in the common
+        unsaturated case."""
+        if not self._started:
+            return None
+        with self._lock:
+            entry = self._table.get(deployment)
+            if not entry or not entry["replicas"]:
+                return None
+            choice = self._pick(entry)
+            if choice is None:
+                return None
+            replica_id, handle = choice
+            self._inflight[replica_id] = \
+                self._inflight.get(replica_id, 0) + 1
+        return self._submit(handle, replica_id, method_name, args, kwargs)
+
+    def _submit(self, handle, replica_id: str, method_name: str, args,
+                kwargs):
+        if method_name == "handle_http":
+            # Replica-level entry point (HTTP translation layer), not a
+            # method of the user callable.
+            ref = handle.handle_http.remote(*args)
+        else:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
         with self._lock:
             self._outstanding[ref] = replica_id
         return ref
+
+    def replica_for_stream(self, deployment: str, sid: str):
+        """Resolve the replica actor handle a stream id points back to
+        (stream ids are '<replica_id>:<seq>'); None once the replica has
+        left the routing table."""
+        replica_id = sid.rsplit(":", 1)[0]
+        with self._lock:
+            entry = self._table.get(deployment)
+            for rid, handle in (entry or {}).get("replicas", ()):
+                if rid == replica_id:
+                    return handle
+        return None
 
     def _pick(self, entry: dict) -> Optional[Tuple[str, object]]:
         limit = entry["max_concurrent_queries"]

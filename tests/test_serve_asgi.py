@@ -1,0 +1,192 @@
+"""Serve ASGI ingress + streaming responses.
+
+Reference behavior: `serve.ingress(fastapi_app)` routes HTTP through the
+app (`python/ray/serve/api.py`), proxies speak ASGI to replicas
+(`serve/_private/http_proxy.py:355`), and streaming responses /
+generator deployments stream chunks to the client.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture()
+def serve_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _post(port, path, payload=None, method="POST"):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+async def _mini_asgi(scope, receive, send):
+    """Hand-rolled ASGI3 app: routes, echo, custom status/headers,
+    streaming — what FastAPI would emit, without the dependency."""
+    assert scope["type"] == "http"
+    path = scope["path"]
+    body = b""
+    while True:
+        event = await receive()
+        if event["type"] != "http.request":
+            break
+        body += event.get("body") or b""
+        if not event.get("more_body"):
+            break
+    if path == "/hello":
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-app", b"mini")]})
+        await send({"type": "http.response.body",
+                    "body": json.dumps({"hello": "world"}).encode()})
+    elif path == "/echo":
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"content-type", b"application/json")]})
+        await send({"type": "http.response.body",
+                    "body": json.dumps(
+                        {"echo": json.loads(body or b"null"),
+                         "method": scope["method"],
+                         "query": scope["query_string"].decode()}).encode()})
+    elif path == "/stream":
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"text/plain")]})
+        for i in range(5):
+            await send({"type": "http.response.body",
+                        "body": f"part{i};".encode(), "more_body": True})
+        await send({"type": "http.response.body", "body": b"done"})
+    else:
+        await send({"type": "http.response.start", "status": 404,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"nope"})
+
+
+def test_asgi_ingress_routes(serve_cluster):
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    @serve.ingress(_mini_asgi)
+    class Api:
+        pass
+
+    serve.run(Api.bind())
+    port = serve.http_port()
+    status, headers, body = _post(port, "/Api/hello", method="GET")
+    assert status == 200
+    assert headers.get("x-app") == "mini"
+    assert json.loads(body) == {"hello": "world"}
+
+    status, _, body = _post(port, "/Api/echo?k=v", {"n": 42})
+    assert status == 201
+    out = json.loads(body)
+    assert out["echo"] == {"n": 42}
+    assert out["method"] == "POST"
+    assert out["query"] == "k=v"
+
+    status404 = None
+    try:
+        _post(port, "/Api/missing", method="GET")
+    except urllib.error.HTTPError as e:
+        status404 = e.code
+    assert status404 == 404
+
+
+def test_asgi_streaming_response(serve_cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    @serve.ingress(_mini_asgi)
+    class Api:
+        pass
+
+    serve.run(Api.bind())
+    port = serve.http_port()
+    _, _, body = _post(port, "/Api/stream", method="GET")
+    assert body == b"part0;part1;part2;part3;part4;done"
+
+
+def test_asgi_factory_with_instance_state(serve_cluster):
+    from ray_tpu import serve
+
+    def make_app(instance):
+        async def app(scope, receive, send):
+            await receive()
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"text/plain")]})
+            await send({"type": "http.response.body",
+                        "body": str(instance.counter).encode()})
+        return app
+
+    @serve.deployment
+    @serve.ingress(make_app)
+    class Stateful:
+        def __init__(self):
+            self.counter = 17
+
+    serve.run(Stateful.bind())
+    port = serve.http_port()
+    _, _, body = _post(port, "/Stateful/", method="GET")
+    assert body == b"17"
+
+
+def test_generator_deployment_streams_over_http(serve_cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, payload):
+            for i in range(int(payload["n"])):
+                yield f"tok{i} "
+
+    serve.run(Tokens.bind())
+    port = serve.http_port()
+    _, _, body = _post(port, "/Tokens", {"n": 4})
+    assert body == b"tok0 tok1 tok2 tok3 "
+
+
+def test_handle_streaming_iterator(serve_cluster):
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Gen:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * i
+
+        async def agen(self, n):
+            for i in range(n):
+                yield i + 100
+
+    handle = serve.run(Gen.bind())
+    items = list(handle.options(stream=True).remote(5))
+    assert items == [0, 1, 4, 9, 16]
+    items = list(handle.options(stream=True).method("agen").remote(3))
+    assert items == [100, 101, 102]
+    # Non-streaming handle still returns a plain ref for normal methods.
+    assert not isinstance(handle.remote, type(None))
+
+
+def test_handle_stream_on_non_generator(serve_cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Plain:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Plain.bind())
+    assert list(handle.options(stream=True).remote(5)) == [6]
