@@ -74,7 +74,8 @@ _flag("direct_lease_idle_s", float, 2.0,
 _flag("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for pubsub subscribers")
 _flag("event_stats", bool, False, "Record per-handler event loop stats")
 _flag("task_events_max_buffer", int, 100000, "Max task events retained by the GCS task manager")
-_flag("memory_usage_threshold", float, 0.95, "Node memory fraction that triggers the OOM killer")
+_flag("memory_usage_threshold", float, 0.95,
+      "Node memory fraction above which the OOM killer sheds workers")
 _flag("memory_monitor_refresh_ms", int, 0, "Memory monitor period; 0 disables")
 _flag("gcs_storage", str, "memory", "GCS table storage backend: memory | file")
 _flag("gcs_storage_path", str, "", "Persistence path for the file storage backend")

@@ -113,6 +113,10 @@ class WorkerHandle:
     # Resources held for this worker's lifetime (actor workers hold their
     # creation-task resources until death, like the reference's leases).
     held_resources: Dict[str, float] = field(default_factory=dict)
+    # When the current task was dispatched (memory_monitor kills newest
+    # first) and, if the OOM killer chose this worker, why.
+    task_started: float = 0.0
+    oom_kill_reason: Optional[str] = None
 
 
 class WorkerPool:
@@ -399,9 +403,18 @@ class Raylet:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
+        if GLOBAL_CONFIG.memory_monitor_refresh_ms > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self, GLOBAL_CONFIG.memory_monitor_refresh_ms,
+                GLOBAL_CONFIG.memory_usage_threshold)
+            self.memory_monitor.start()
 
     def stop(self):
         self._stopped.set()
+        if getattr(self, "memory_monitor", None) is not None:
+            self.memory_monitor.stop()
         self._dispatch_event.set()
         self.pool.kill_all()
         self.server.stop()
@@ -864,6 +877,7 @@ class Raylet:
     def _dispatch_to(self, worker: WorkerHandle, qt: QueuedTask):
         spec = qt.spec
         worker.current_task = spec
+        worker.task_started = time.monotonic()
         with self._lock:
             self._running[spec.task_id.binary()] = (spec, worker)
         self._record_task_event(spec, "RUNNING", worker)
@@ -1071,11 +1085,19 @@ class Raylet:
             if handle.is_actor or spec.actor_creation:
                 pass  # reported below via actor_died
             elif submitter is not None and submitter.alive:
-                from ray_tpu.exceptions import WorkerCrashedError
+                from ray_tpu.exceptions import (
+                    OutOfMemoryError,
+                    WorkerCrashedError,
+                )
 
-                err = serialization.serialize_exception(
-                    WorkerCrashedError(f"Worker died while running {spec.name}: {reason}"),
-                    spec.name)
+                if handle.oom_kill_reason:
+                    exc: WorkerCrashedError = OutOfMemoryError(
+                        f"Task {spec.name} was killed by the memory "
+                        f"monitor: {handle.oom_kill_reason}")
+                else:
+                    exc = WorkerCrashedError(
+                        f"Worker died while running {spec.name}: {reason}")
+                err = serialization.serialize_exception(exc, spec.name)
                 try:
                     submitter.push("task_result",
                                    {"task_id": spec.task_id, "results": [],

@@ -97,12 +97,12 @@ class HTTPProxy:
             # thread parked per in-flight request).
             import functools
 
-            ref = self._router.try_assign(deployment, "handle_http",
+            ref = self._router.try_assign(deployment, "__serve_http__",
                                           (http_req,), {})
             if ref is None:
                 ref = await loop.run_in_executor(
                     None, functools.partial(
-                        self._router.assign, deployment, "handle_http",
+                        self._router.assign, deployment, "__serve_http__",
                         (http_req,), {}, timeout_s=30.0))
             result = await asyncio.wait_for(
                 asyncio.wrap_future(self._runtime.get_future(ref)),
@@ -130,7 +130,11 @@ class HTTPProxy:
         from aiohttp import web
 
         if isinstance(result, dict) and result.get("__serve_http__"):
-            headers = {k: v for k, v in result.get("headers") or []}
+            from multidict import CIMultiDict
+
+            # Multidict: repeated headers (Set-Cookie) must all survive.
+            headers = CIMultiDict(
+                (k, v) for k, v in result.get("headers") or [])
             sid = result.get("stream")
             if sid is None:
                 return web.Response(status=result["status"], headers=headers,
@@ -139,8 +143,8 @@ class HTTPProxy:
             # the rest from the replica's stream queue. Chunked framing
             # owns the length — the app's content-length (e.g. a
             # FileResponse) would make aiohttp reject chunked mode.
-            headers.pop("content-length", None)
-            headers.pop("transfer-encoding", None)
+            headers.popall("Content-Length", None)
+            headers.popall("Transfer-Encoding", None)
             resp = web.StreamResponse(status=result["status"],
                                       headers=headers)
             resp.enable_chunked_encoding()

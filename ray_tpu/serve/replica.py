@@ -250,6 +250,7 @@ class Replica:
     async def stream_next(self, sid: str, max_items: int = 64,
                           timeout_s: float = 30.0) -> Dict[str, Any]:
         """Pull the next batch of items from a registered stream."""
+        self._reap_idle_streams()
         rec = self._streams.get(sid)
         if rec is None:
             return {"items": [], "done": True,
@@ -287,6 +288,10 @@ class Replica:
         }
 
     def ping(self) -> str:
+        # The controller health-checks periodically: piggyback the idle
+        # stream sweep so abandoned streams are reaped even when no new
+        # streaming request ever reaches this replica.
+        self._reap_idle_streams()
         return "pong"
 
     async def prepare_shutdown(self, timeout_s: float = 5.0) -> int:

@@ -70,13 +70,10 @@ class Router:
         with self._lock:
             while True:
                 entry = self._table.get(deployment)
-                if entry and entry["replicas"]:
-                    choice = self._pick(entry)
-                    if choice is not None:
-                        replica_id, handle = choice
-                        self._inflight[replica_id] = \
-                            self._inflight.get(replica_id, 0) + 1
-                        break
+                choice = self._reserve_locked(entry)
+                if choice is not None:
+                    replica_id, handle = choice
+                    break
                 # A name absent from the table is (after a short grace for
                 # an in-progress deploy) an error, not backpressure — don't
                 # park forever on a typo.
@@ -106,22 +103,29 @@ class Router:
         if not self._started:
             return None
         with self._lock:
-            entry = self._table.get(deployment)
-            if not entry or not entry["replicas"]:
-                return None
-            choice = self._pick(entry)
-            if choice is None:
-                return None
-            replica_id, handle = choice
-            self._inflight[replica_id] = \
-                self._inflight.get(replica_id, 0) + 1
+            choice = self._reserve_locked(self._table.get(deployment))
+        if choice is None:
+            return None
+        replica_id, handle = choice
         return self._submit(handle, replica_id, method_name, args, kwargs)
+
+    def _reserve_locked(self, entry):
+        """Pick a replica with headroom and count the in-flight slot —
+        the single admission-accounting point for both assign paths."""
+        if not entry or not entry["replicas"]:
+            return None
+        choice = self._pick(entry)
+        if choice is None:
+            return None
+        replica_id, _ = choice
+        self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
+        return choice
 
     def _submit(self, handle, replica_id: str, method_name: str, args,
                 kwargs):
-        if method_name == "handle_http":
-            # Replica-level entry point (HTTP translation layer), not a
-            # method of the user callable.
+        if method_name == "__serve_http__":
+            # Reserved sentinel for the replica-level HTTP entry point
+            # (dunder so it can't shadow a user deployment method).
             ref = handle.handle_http.remote(*args)
         else:
             ref = handle.handle_request.remote(method_name, args, kwargs)

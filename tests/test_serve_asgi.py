@@ -176,8 +176,13 @@ def test_handle_streaming_iterator(serve_cluster):
     assert items == [0, 1, 4, 9, 16]
     items = list(handle.options(stream=True).method("agen").remote(3))
     assert items == [100, 101, 102]
-    # Non-streaming handle still returns a plain ref for normal methods.
-    assert not isinstance(handle.remote, type(None))
+    # A streamed handle keeps streaming through attribute access.
+    assert list(handle.options(stream=True).agen.remote(2)) == [100, 101]
+    # Non-streaming handle still returns a plain awaitable ref whose
+    # value is the stream marker, not an iterator.
+    ref = handle.remote(1)
+    marker = ray_tpu.get(ref)
+    assert isinstance(marker, dict) and "__serve_stream__" in marker
 
 
 def test_handle_stream_on_non_generator(serve_cluster):
