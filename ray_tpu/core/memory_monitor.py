@@ -96,9 +96,15 @@ class MemoryMonitor:
         self._usage_fn = usage_fn or system_memory
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # One kill per breach observation: give the freed memory a poll
-        # period to show up before choosing another victim.
+        # Kill grace: a SIGKILL'd multi-GiB process takes time to return
+        # its RSS to the OS; choosing another victim before the last one
+        # has actually exited (plus a settle window) would cascade-kill
+        # every worker on the node during one sustained spike.
+        self._last_victim_proc = None
+        self._last_kill_time = 0.0
         self.kills = 0
+
+    KILL_SETTLE_S = 1.0
 
     def start(self):
         self._thread = threading.Thread(
@@ -119,6 +125,14 @@ class MemoryMonitor:
         used, total = self._usage_fn()
         if total <= 0 or used / total < self._threshold:
             return
+        # Let the previous kill land before sacrificing anyone else.
+        if self._last_victim_proc is not None:
+            if self._last_victim_proc.poll() is None:
+                return  # still dying
+            if time.monotonic() - self._last_kill_time < \
+                    max(self.KILL_SETTLE_S, 2 * self._period_s):
+                return  # exited, but give the RSS a moment to reclaim
+            self._last_victim_proc = None
         victim = self._pick_victim()
         if victim is None:
             logger.error(
@@ -127,10 +141,9 @@ class MemoryMonitor:
                 "the host may OOM", 100 * used / total,
                 100 * self._threshold)
             return
-        handle, retriable = victim
+        handle, spec, retriable = victim
         rss = process_rss(handle.pid)
-        task_desc = (f"running {handle.current_task.name!r}"
-                     if handle.current_task is not None
+        task_desc = (f"running {spec.name!r}" if spec is not None
                      else "serving direct-transport tasks")
         reason = (
             f"node memory usage {used / (1 << 30):.2f}/"
@@ -139,14 +152,19 @@ class MemoryMonitor:
             f"worker pid={handle.pid} (rss {rss / (1 << 30):.2f} GiB) "
             f"{task_desc}"
             + ("" if retriable else " (task is not retriable)"))
+        with self._raylet.pool._lock:
+            if handle.current_task is not spec or handle.state != "busy":
+                # The task we chose finished (and something else may have
+                # been dispatched) between selection and kill — stand
+                # down this round rather than OOM-blame the wrong task.
+                return
+            handle.oom_kill_reason = reason
         logger.warning("OOM killer: %s", reason)
-        handle.oom_kill_reason = reason
         self.kills += 1
+        self._last_victim_proc = handle.proc
+        self._last_kill_time = time.monotonic()
         try:
-            if handle.proc is not None:
-                handle.proc.kill()
-            else:
-                os.kill(handle.pid, 9)
+            handle.proc.kill()  # _pick_victim only returns proc-owning ones
         except (OSError, ProcessLookupError):
             pass
 
@@ -164,16 +182,17 @@ class MemoryMonitor:
                 continue
             spec = h.current_task
             if spec is None:
-                direct.append(h)   # dedicated to a direct-task lease
+                direct.append((h, None))  # dedicated to a direct-task lease
             elif spec.actor_creation:
                 continue
             elif spec.max_retries > 0:
-                retriable.append(h)
+                retriable.append((h, spec))
             else:
-                fallback.append(h)
+                fallback.append((h, spec))
         for group in (retriable, fallback, direct):
             if group:
-                newest = max(group,
-                             key=lambda h: h.task_started or h.last_idle)
-                return newest, group is retriable
+                newest, spec = max(
+                    group, key=lambda hs: hs[0].task_started
+                    or hs[0].last_idle)
+                return newest, spec, group is retriable
         return None

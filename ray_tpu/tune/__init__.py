@@ -33,11 +33,13 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    BOHBSearcher,
     Searcher,
     TPESearcher,
     choice,
@@ -247,4 +249,5 @@ __all__ = [
     "MedianStoppingRule",
     "grid_search", "choice", "uniform", "loguniform", "randint", "quniform",
     "sample_from", "get_checkpoint", "Searcher", "TPESearcher",
+    "BOHBSearcher", "PB2",
 ]

@@ -269,3 +269,144 @@ class PopulationBasedTraining(TrialScheduler):
                 factor = self._rng.choice([0.8, 1.2])
                 out[key] = out[key] * factor
         return out
+
+
+class _GP:
+    """Minimal squared-exponential Gaussian process (numpy only) — the
+    reference's PB2 leans on the external GPy package
+    (`tune/schedulers/pb2_utils.py`); this is the self-contained core it
+    actually needs: fit on normalized inputs, predict mean/std."""
+
+    def __init__(self, lengthscale: float = 0.3, noise: float = 1e-3):
+        self.ls = lengthscale
+        self.noise = noise
+        self._X = self._alpha = self._L = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    @staticmethod
+    def _sq_dists(A, B):
+        import numpy as np
+
+        return ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+
+    def _k(self, A, B):
+        import numpy as np
+
+        return np.exp(-0.5 * self._sq_dists(A, B) / self.ls ** 2)
+
+    def fit(self, X, y):
+        import numpy as np
+
+        X = np.asarray(X, float)
+        y = np.asarray(y, float)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        K = self._k(X, X) + self.noise * np.eye(len(X))
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, yn))
+        self._X = X
+        return self
+
+    def predict(self, Xs):
+        import numpy as np
+
+        Xs = np.asarray(Xs, float)
+        Ks = self._k(Xs, self._X)
+        mu = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return (mu * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference `tune/schedulers/pb2.py`):
+    PBT where the explore step is a GP-UCB suggestion over continuous
+    hyperparameter bounds instead of a random perturbation — markedly
+    more sample-efficient with small populations.
+
+    ``hyperparam_bounds`` maps each tuned key to ``(lower, upper)``.
+    Each perturbation interval records (current hyperparams -> score
+    improvement over the interval); exploit-triggered explores fit the
+    GP on that data and pick the candidate maximizing mean + kappa * std
+    within bounds.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 1.5,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds: "
+                             "{key: (lower, upper), ...}")
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = kappa
+        # (normalized hyperparam vector, score delta over one interval)
+        self._data: List[Any] = []
+        self._last_score: Dict[str, float] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        decision = super().on_trial_result(trial, result)
+        if trial.num_results % self.interval == 0:
+            value = result.get(self.metric)
+            if value is not None:
+                prev = self._last_score.get(trial.trial_id)
+                if prev is not None:
+                    delta = (value - prev if self.mode == "max"
+                             else prev - value)
+                    self._data.append(
+                        (self._normalize(trial.config), float(delta)))
+                self._last_score[trial.trial_id] = float(value)
+        if decision == self.EXPLOIT:
+            # The trial is about to adopt a top trial's checkpoint: the
+            # next interval's score jump measures the weight copy, not
+            # the new hyperparams — dropping the baseline keeps that
+            # contaminated delta out of the GP's training data.
+            self._last_score.pop(trial.trial_id, None)
+        return decision
+
+    def _normalize(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        keys = list(self.bounds)
+        if len(self._data) < 4:
+            # Cold start: uniform exploration inside bounds.
+            for k in keys:
+                lo, hi = self.bounds[k]
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        X = [x for x, _ in self._data[-64:]]
+        y = [d for _, d in self._data[-64:]]
+        try:
+            gp = _GP().fit(X, y)
+        except np.linalg.LinAlgError:
+            for k in keys:
+                lo, hi = self.bounds[k]
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        rng = np.random.default_rng(self._rng.randrange(1 << 30))
+        cands = rng.random((64, len(keys)))
+        mu, sd = gp.predict(cands)
+        best = cands[int(np.argmax(mu + self.kappa * sd))]
+        for k, x in zip(keys, best):
+            lo, hi = self.bounds[k]
+            out[k] = lo + float(x) * (hi - lo)
+        return out

@@ -159,11 +159,16 @@ class TPESearcher(Searcher):
 
     # ----------------------------------------------------------- suggestion
 
+    def _model_history(self) -> List[Any]:
+        """Observations the KDE models (subclasses pick a fidelity)."""
+        return self._history
+
     def suggest(self) -> Dict[str, Any]:
-        if len(self._history) < self.n_initial:
+        hist = self._model_history()
+        if len(hist) < self.n_initial:
             return {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
                     for k, v in self.param_space.items()}
-        ordered = sorted(self._history, key=lambda cs: cs[1],
+        ordered = sorted(hist, key=lambda cs: cs[1],
                          reverse=(self.mode == "max"))
         n_good = max(1, int(len(ordered) * self.gamma))
         good = [c for c, _ in ordered[:n_good]]
@@ -229,6 +234,45 @@ class TPESearcher(Searcher):
         if isinstance(dom, QUniform):
             return round(v / dom.q) * dom.q
         return v
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's Bayesian half, self-contained (reference wraps the external
+    `hpbandster` package via `tune/search/bohb/bohb_search.py`; that
+    library isn't available here).
+
+    Observations are kept per fidelity (the result's
+    ``training_iteration``, fed through ``on_result``); the KDE models
+    the LARGEST budget that has at least ``n_initial`` observations —
+    BOHB's rule — so early low-fidelity scores guide sampling until
+    enough full-budget results exist, then the model sharpens. Pair with
+    ``HyperBandScheduler`` for the bracketed early stopping half
+    (reference pairs TuneBOHB with HyperBandForBOHB).
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "min", n_initial: int = 6, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(param_space, metric, mode, n_initial=n_initial,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
+        # budget -> [(config, score)]; a config's entry at a budget is its
+        # latest score there.
+        self._by_budget: Dict[int, List[Any]] = {}
+
+    def on_result(self, config: Dict[str, Any], result: Dict[str, Any]):
+        score = result.get(self.metric)
+        if score is None:
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._by_budget.setdefault(budget, []).append(
+            (dict(config), float(score)))
+
+    def _model_history(self) -> List[Any]:
+        for budget in sorted(self._by_budget, reverse=True):
+            obs = self._by_budget[budget]
+            if len(obs) >= self.n_initial:
+                return obs
+        return self._history
 
 
 class BasicVariantGenerator:

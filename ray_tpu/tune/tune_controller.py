@@ -267,6 +267,13 @@ class TuneController:
             trial.checkpoint_path = path
         trial.last_result.update(metrics)
         trial.metrics_history.append(metrics)
+        if self.searcher is not None and hasattr(self.searcher, "on_result"):
+            # Fidelity-aware searchers (BOHB) model intermediate results
+            # at their budget (training_iteration), not just final scores.
+            try:
+                self.searcher.on_result(trial.config, metrics)
+            except Exception:
+                logger.exception("searcher on_result failed")
         decision = self.scheduler.on_trial_result(trial, metrics)
         if self._stop_condition_met(metrics):
             decision = TrialScheduler.STOP

@@ -187,13 +187,14 @@ def test_victim_policy_prefers_newest_retriable():
     mm = MemoryMonitor(FakeRaylet([old_retriable, new_retriable,
                                    newest_nonretriable, actor, idle]),
                        refresh_ms=1000, threshold=0.95)
-    victim, retriable = mm._pick_victim()
+    victim, spec, retriable = mm._pick_victim()
     assert victim is new_retriable and retriable
+    assert spec is new_retriable.current_task
 
     # No retriable: newest non-retriable; actors never.
     mm2 = MemoryMonitor(FakeRaylet([newest_nonretriable, actor]),
                         refresh_ms=1000, threshold=0.95)
-    victim, retriable = mm2._pick_victim()
+    victim, spec, retriable = mm2._pick_victim()
     assert victim is newest_nonretriable and not retriable
 
     mm3 = MemoryMonitor(FakeRaylet([actor, idle]), refresh_ms=1000,
