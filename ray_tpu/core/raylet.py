@@ -194,7 +194,12 @@ class WorkerPool:
         want = required_env or {}
         with self._lock:
             for h in self._workers.values():
-                if h.state == "idle" and not h.is_actor and h.granted_env == want:
+                # oom_kill_reason: the memory monitor has condemned this
+                # worker; a SIGKILL is in flight — leasing it would get a
+                # fresh task killed and blamed with the old task's OOM.
+                if (h.state == "idle" and not h.is_actor
+                        and h.granted_env == want
+                        and not h.oom_kill_reason):
                     h.state = "busy"
                     return h
             return None
@@ -868,7 +873,8 @@ class Raylet:
         renv = spec.runtime_env or {}
         for k, v in (renv.get("env_vars") or {}).items():
             env[str(k)] = str(v)
-        if renv.get("working_dir") or renv.get("py_modules"):
+        if renv.get("working_dir") or renv.get("py_modules") \
+                or renv.get("pip"):
             from ray_tpu.core import runtime_env as renv_mod
 
             env.update(renv_mod.granted_env(renv))

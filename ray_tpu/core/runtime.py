@@ -728,7 +728,8 @@ class CoreRuntime:
     def _prepare_runtime_env(self, renv):
         """Local working_dir/py_modules paths -> content-addressed KV URIs
         through the shared memoizing cache (core/runtime_env.EnvCache)."""
-        if not renv or not (renv.get("working_dir") or renv.get("py_modules")):
+        if not renv or not (renv.get("working_dir") or renv.get("py_modules")
+                            or renv.get("pip")):
             return renv
         if self._env_cache is None:
             from ray_tpu.core.runtime_env import EnvCache

@@ -16,8 +16,19 @@ framework's needs:
   it hasn't cached under `session_dir/runtime_env/<sha>/`, extracts,
   chdirs into the working_dir and prepends py_modules to sys.path.
 
-conda/pip/container isolation is out of scope (no package installs in the
-target environment); `env_vars` pass through as before.
+- **pip / venv** (worker side): `{"pip": [...], "pip_wheelhouse": dir}`
+  builds a venv from a LOCAL wheelhouse (`pip install --no-index
+  --find-links`), offline by design — the target hosts have no package
+  index. Venvs are cached per content hash (package list + wheelhouse
+  manifest) under the session dir and activated by prepending their
+  site-packages to sys.path; the pip spec rides the same
+  `RAY_TPU_RUNTIME_ENV` marker, so env-matched worker leasing keeps
+  different pip environments in different processes. (The reference's
+  pip plugin, `python/ray/_private/runtime_env/pip.py`, re-launches
+  workers inside the venv and resolves from an index; both are
+  unavailable/unwanted here.)
+
+conda/container isolation is out of scope; `env_vars` pass through.
 """
 
 from __future__ import annotations
@@ -69,6 +80,60 @@ def _upload(gcs, blob: bytes) -> str:
     return uri
 
 
+def _normalize_pip(out: Dict[str, Any]) -> None:
+    """Canonicalize the pip spec: {"pip": [...pkgs...]} (+ optional
+    "pip_wheelhouse") or {"pip": {"packages": [...], "wheelhouse": ...}}
+    into the dict form with an absolute wheelhouse path. Validated driver
+    side so a typo'd wheelhouse fails at submission, not in a worker."""
+    pip = out.get("pip")
+    if pip is None:
+        return
+    if isinstance(pip, dict):
+        packages = list(pip.get("packages") or [])
+        wheelhouse = pip.get("wheelhouse") or out.pop("pip_wheelhouse", None)
+    else:
+        packages = list(pip)
+        wheelhouse = out.pop("pip_wheelhouse", None)
+    wheelhouse = wheelhouse or os.environ.get("RAY_TPU_WHEELHOUSE")
+    if not packages:
+        out.pop("pip", None)
+        return
+    if not wheelhouse:
+        raise ValueError(
+            "runtime_env pip requires a wheelhouse (pip_wheelhouse=..., "
+            "pip={'wheelhouse': ...} or RAY_TPU_WHEELHOUSE): this "
+            "environment installs offline from local wheels only")
+    wheelhouse = os.path.abspath(wheelhouse)
+    if not os.path.isdir(wheelhouse):
+        raise ValueError(f"pip wheelhouse {wheelhouse!r} is not a directory")
+    out["pip"] = {"packages": sorted(packages), "wheelhouse": wheelhouse}
+    # Hash computed DRIVER-side and carried in the spec (hence in the
+    # worker-pool env marker): rebuilding a wheel changes the marker, so
+    # pooled workers on the stale venv are never re-leased for the new
+    # env — they'd otherwise serve old code from their sys.path.
+    out["pip"]["env_hash"] = pip_env_hash(out["pip"])
+
+
+def pip_env_hash(pip: Dict[str, Any]) -> str:
+    """Content hash identifying one venv: the package list plus the
+    wheelhouse manifest (file names + sizes), so adding or rebuilding a
+    wheel produces a fresh venv instead of stale-cache confusion."""
+    h = hashlib.sha256()
+    for p in pip["packages"]:
+        h.update(p.encode())
+        h.update(b"\0")
+    wh = pip["wheelhouse"]
+    try:
+        for name in sorted(os.listdir(wh)):
+            if name.endswith(".whl"):
+                h.update(name.encode())
+                h.update(str(os.path.getsize(
+                    os.path.join(wh, name))).encode())
+    except OSError:
+        pass
+    return h.hexdigest()[:24]
+
+
 def prepare(runtime_env: Optional[Dict[str, Any]], gcs
             ) -> Optional[Dict[str, Any]]:
     """Driver side: replace local paths with uploaded content URIs.
@@ -76,6 +141,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], gcs
     if not runtime_env:
         return runtime_env
     out = dict(runtime_env)
+    _normalize_pip(out)
     wd = out.get("working_dir")
     if wd and not wd.startswith(URI_PREFIX):
         if not os.path.isdir(wd):
@@ -121,7 +187,8 @@ class EnvCache:
     def prepare(self, runtime_env: Optional[Dict[str, Any]]
                 ) -> Optional[Dict[str, Any]]:
         if not runtime_env or not (runtime_env.get("working_dir")
-                                   or runtime_env.get("py_modules")):
+                                   or runtime_env.get("py_modules")
+                                   or runtime_env.get("pip")):
             return runtime_env
         key = repr(sorted((k, repr(v)) for k, v in runtime_env.items()))
         now = self._time.monotonic()
@@ -130,11 +197,22 @@ class EnvCache:
             if entry is not None and now - entry[1] < self._revalidate_s:
                 return entry[0]
         prepared = entry[0] if entry is not None else None
-        if prepared is None or not self._uris_exist(prepared):
+        if prepared is None or not self._uris_exist(prepared) \
+                or not self._pip_fresh(prepared):
             prepared = prepare(runtime_env, self._gcs)
         with self._lock:
             self._entries[key] = (prepared, now)
         return prepared
+
+    @staticmethod
+    def _pip_fresh(prepared: Dict[str, Any]) -> bool:
+        """Re-hash the wheelhouse at revalidation: a rebuilt wheel must
+        produce a new env marker (and thus fresh workers/venvs) within
+        one revalidate window."""
+        pip = prepared.get("pip")
+        if not pip or not isinstance(pip, dict):
+            return True
+        return pip.get("env_hash") == pip_env_hash(pip)
 
     def _uris_exist(self, prepared: Dict[str, Any]) -> bool:
         uris = [prepared.get("working_dir")] + list(
@@ -151,10 +229,11 @@ class EnvCache:
 
 def granted_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     """Raylet side: the env-var marker that isolates worker pools per
-    runtime environment (URIs only — env_vars are granted separately)."""
+    runtime environment (URIs + pip spec — env_vars are granted
+    separately)."""
     if not runtime_env:
         return {}
-    uris = {k: runtime_env[k] for k in ("working_dir", "py_modules")
+    uris = {k: runtime_env[k] for k in ("working_dir", "py_modules", "pip")
             if runtime_env.get(k)}
     if not uris:
         return {}
@@ -197,6 +276,9 @@ def materialize(gcs, session_dir: str) -> None:
                 shutil.rmtree(tmp, ignore_errors=True)  # lost the race
         return dest
 
+    pip = uris.get("pip")
+    if pip:
+        _activate_venv(_ensure_venv(pip, cache))
     for uri in uris.get("py_modules", []) or []:
         path = fetch(uri)
         if path not in sys.path:
@@ -208,3 +290,75 @@ def materialize(gcs, session_dir: str) -> None:
         if path not in sys.path:
             sys.path.insert(0, path)
         logger.info("runtime_env: working_dir %s", path)
+
+
+def _ensure_venv(pip: Dict[str, Any], cache: str) -> str:
+    """Build (or reuse) the content-addressed venv for a pip spec.
+    Creation is offline: `pip install --no-index --find-links
+    <wheelhouse>`. Concurrent workers building the same env serialize on
+    an fcntl lock; the finished venv is moved into place atomically so a
+    crashed build never half-caches."""
+    import fcntl
+    import shutil
+    import subprocess
+    import tempfile
+
+    env_hash = pip.get("env_hash") or pip_env_hash(pip)
+    dest = os.path.join(cache, f"venv-{env_hash}")
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(cache, exist_ok=True)
+    lock_path = os.path.join(cache, f"venv-{env_hash}.lock")
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if os.path.isdir(dest):  # another worker won the build
+            return dest
+        tmp = tempfile.mkdtemp(prefix=f"venv-{env_hash}.", dir=cache)
+        try:
+            # Activation is a sys.path prefix in the SAME interpreter
+            # (the base environment stays visible underneath), so the
+            # "venv" needs only a site-packages dir for pip --target —
+            # no interpreter copy, no `python -m venv` subprocess.
+            os.makedirs(_venv_site_packages(tmp), exist_ok=True)
+            subprocess.run(
+                [sys.executable, "-m", "pip", "install", "--no-index",
+                 "--find-links", pip["wheelhouse"],
+                 "--target", _venv_site_packages(tmp),
+                 *pip["packages"]],
+                check=True, capture_output=True, timeout=600)
+            os.rename(tmp, dest)
+        except subprocess.CalledProcessError as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env pip install failed for {pip['packages']}: "
+                f"{(e.stderr or b'').decode(errors='replace')[-800:]}"
+            ) from None
+        except subprocess.TimeoutExpired:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env pip install timed out for {pip['packages']}"
+            ) from None
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise
+    return dest
+
+
+def _venv_site_packages(venv_dir: str) -> str:
+    return os.path.join(
+        venv_dir, "lib",
+        f"python{sys.version_info.major}.{sys.version_info.minor}",
+        "site-packages")
+
+
+def _activate_venv(venv_dir: str) -> None:
+    """In-process activation: the venv's site-packages gets import
+    priority. (The reference re-launches the worker under the venv's
+    interpreter; this framework's workers materialize envs after spawn,
+    before any user import, which the sys.path prefix covers.)"""
+    site = _venv_site_packages(venv_dir)
+    if site not in sys.path:
+        sys.path.insert(0, site)
+    os.environ["VIRTUAL_ENV"] = venv_dir
+    logger.info("runtime_env: venv %s", venv_dir)

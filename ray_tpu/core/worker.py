@@ -195,6 +195,12 @@ class WorkerRuntime(CoreRuntime):
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
         try:
+            if getattr(self, "_env_setup_error", None):
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                raise RuntimeEnvSetupError(
+                    f"runtime_env setup failed on this worker: "
+                    f"{self._env_setup_error}")
             args, kwargs = self._resolve_args(spec)
             if spec.actor_creation:
                 cls = serialization.loads(spec.actor_class_blob)
@@ -486,8 +492,18 @@ def main():
     if os.environ.get("RAY_TPU_RUNTIME_ENV"):
         from ray_tpu.core import runtime_env as renv_mod
 
-        renv_mod.materialize(runtime.gcs,
-                             os.environ.get("RAY_TPU_SESSION_DIR", "/tmp"))
+        try:
+            renv_mod.materialize(runtime.gcs,
+                                 os.environ.get("RAY_TPU_SESSION_DIR",
+                                                "/tmp"))
+        except Exception as e:  # noqa: BLE001 — surface to tasks, below
+            # Dying here would crash-loop worker spawns while the queued
+            # task waits forever; instead stay registered and fail every
+            # dispatched task with a typed setup error (reference:
+            # RuntimeEnvSetupError on the task, runtime_env_agent path).
+            logging.getLogger(__name__).error(
+                "runtime_env setup failed: %s", e)
+            runtime._env_setup_error = f"{type(e).__name__}: {e}"
     if GLOBAL_CONFIG.log_to_driver:
         from ray_tpu.core.log_streaming import LogStreamer
 

@@ -17,6 +17,9 @@ class DataContext:
     max_buffered_blocks_per_op: int = 16
     read_parallelism: int = -1  # -1 = auto (min(files, 2*CPUs, 192))
     eager_free: bool = True
+    # Per-operator wall/rows stats (ds.stats()); one fire-and-forget
+    # actor call per executed block when enabled.
+    enable_stats: bool = True
 
     _instance: Optional["DataContext"] = None
     _lock = threading.Lock()

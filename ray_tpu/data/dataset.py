@@ -32,6 +32,8 @@ def _map_rows_transform(fn):
     def transform(block):
         return [fn(row) for row in BlockAccessor(block).rows()]
 
+    transform._op_name = (
+        f"Map({getattr(fn, '__name__', 'fn')})")
     return transform
 
 
@@ -42,6 +44,8 @@ def _flat_map_transform(fn):
             out.extend(fn(row))
         return out
 
+    transform._op_name = (
+        f"FlatMap({getattr(fn, '__name__', 'fn')})")
     return transform
 
 
@@ -54,6 +58,8 @@ def _filter_transform(fn):
         keep = np.asarray([bool(fn(row)) for row in acc.rows()])
         return {k: v[keep] for k, v in batch.items()}
 
+    transform._op_name = (
+        f"Filter({getattr(fn, '__name__', 'fn')})")
     return transform
 
 
@@ -74,6 +80,8 @@ def _map_batches_transform(fn, batch_size: Optional[int], fn_kwargs):
             pieces.append(BlockAccessor.batch_to_block(out))
         return BlockAccessor.concat(pieces)
 
+    transform._op_name = (
+        f"MapBatches({getattr(fn, '__name__', 'fn')})")
     return transform
 
 
@@ -342,11 +350,37 @@ class Dataset:
         if self._materialized_refs is not None:
             yield from self._materialized_refs
             return
+        from ray_tpu.data.context import DataContext
         from ray_tpu.data.executor import StreamingExecutor
 
+        collector = None
+        if DataContext.get_current().enable_stats:
+            from ray_tpu.data import stats as stats_mod
+
+            # One collector per Dataset, reused across executions and
+            # reaped with the Dataset object (a per-execution actor
+            # would leak one worker process per epoch).
+            collector = getattr(self, "_stats_collector", None)
+            if collector is None:
+                collector = stats_mod.make_collector()
+                self._stats_collector = collector
         executor = StreamingExecutor(self._transforms,
-                                     resources=self._resources)
-        yield from executor.execute(iter(self._work))
+                                     resources=self._resources,
+                                     stats_collector=collector)
+        self._executed_blocks = 0
+        for ref in executor.execute(iter(self._work)):
+            self._executed_blocks += 1
+            yield ref
+
+    def stats(self):
+        """Per-operator wall/rows/blocks summary of the most recent
+        execution (reference `Dataset.stats()`,
+        `data/_internal/stats.py`). None before any execution."""
+        from ray_tpu.data import stats as stats_mod
+
+        return stats_mod.fetch(getattr(self, "_stats_collector", None),
+                               expected_blocks=getattr(
+                                   self, "_executed_blocks", None))
 
     def _iter_block_values(self) -> Iterator[Block]:
         import ray_tpu
@@ -357,6 +391,8 @@ class Dataset:
     def materialize(self) -> "Dataset":
         refs = list(self._iter_block_refs())
         out = Dataset(self._work, self._transforms, self._resources)
+        out._stats_collector = getattr(self, "_stats_collector", None)
+        out._executed_blocks = getattr(self, "_executed_blocks", None)
         out._materialized_refs = refs
         # Keep a plan for re-execution-from-refs.
         out._work = [(None, (r,)) for r in refs]

@@ -33,13 +33,27 @@ def _fused_apply(fns, producer, *args):
     return block
 
 
+def _fused_apply_stats(fns, collector, producer, *args):
+    """Stats-collecting remote body: same as _fused_apply, plus one
+    fire-and-forget per-op timing record to the collector actor."""
+    from ray_tpu.data.stats import timed_apply
+
+    block, records = timed_apply(fns, producer, args)
+    try:
+        collector.record.remote(records)
+    except Exception:  # noqa: BLE001 — stats must never fail the block
+        pass
+    return block
+
+
 class StreamingExecutor:
     """Pumps (producer, args) work items through fused transforms."""
 
     def __init__(self, transforms: List[Callable],
                  max_in_flight: Optional[int] = None,
                  max_buffered: Optional[int] = None,
-                 resources: Optional[dict] = None):
+                 resources: Optional[dict] = None,
+                 stats_collector: Optional[Any] = None):
         from ray_tpu.data.context import DataContext
 
         ctx = DataContext.get_current()
@@ -47,6 +61,7 @@ class StreamingExecutor:
         self._max_in_flight = max_in_flight or ctx.max_tasks_in_flight_per_op
         self._max_buffered = max_buffered or ctx.max_buffered_blocks_per_op
         self._resources = resources
+        self._stats = stats_collector
 
     def execute(self, work: Iterator[Tuple[Optional[Callable], tuple]]
                 ) -> Iterator[Any]:
@@ -54,9 +69,12 @@ class StreamingExecutor:
         submission order (streaming)."""
         import ray_tpu
 
-        remote_fn = ray_tpu.remote(_fused_apply)
-        if self._resources:
-            remote_fn = remote_fn.options(**self._resources)
+        if self._stats is not None:
+            base = ray_tpu.remote(_fused_apply_stats)
+        else:
+            base = ray_tpu.remote(_fused_apply)
+        remote_fn = base.options(**self._resources) if self._resources \
+            else base
 
         work_iter = iter(work)
         in_flight: dict = {}          # ref -> submission index
@@ -73,7 +91,13 @@ class StreamingExecutor:
                 except StopIteration:
                     exhausted = True
                     break
-                ref = remote_fn.remote(self._transforms, producer, *args)
+                if self._stats is not None:
+                    ref = remote_fn.remote(self._transforms,
+                                           self._stats.actor,
+                                           producer, *args)
+                else:
+                    ref = remote_fn.remote(self._transforms, producer,
+                                           *args)
                 in_flight[ref] = submitted
                 submitted += 1
             # Yield strictly in submission order (the reference's streaming

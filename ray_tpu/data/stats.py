@@ -1,0 +1,156 @@
+"""Per-operator execution stats for Dataset pipelines.
+
+Equivalent of the reference's `python/ray/data/_internal/stats.py`
+(`DatasetStats` + the `_StatsActor` aggregation): every fused remote
+block task times its producer and each transform, then pushes one
+fire-and-forget record per block to a zero-CPU collector actor; after an
+execution `ds.stats()` renders a per-operator wall/rows/blocks summary
+for diagnosing pipeline bottlenecks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def block_rows(block: Any) -> int:
+    """Best-effort row count of a block (list, dict-of-columns, ndarray,
+    DataFrame)."""
+    try:
+        if isinstance(block, dict):
+            return len(next(iter(block.values()))) if block else 0
+        return len(block)
+    except TypeError:
+        return 1
+
+
+class _StatsCollector:
+    """Zero-CPU actor accumulating (op_index, op_name, wall_s, rows)
+    records; one batched push per executed block."""
+
+    def __init__(self):
+        # (index, name) -> [blocks, rows_out, wall_s]
+        self._ops: Dict[Tuple[int, str], List[float]] = {}
+        self._batches = 0  # record() calls == executed blocks
+        self._started = time.time()
+
+    def record(self, entries: List[Tuple[int, str, float, int]]):
+        self._batches += 1
+        for idx, name, wall, rows in entries:
+            agg = self._ops.setdefault((idx, name), [0, 0, 0.0])
+            agg[0] += 1
+            agg[1] += rows
+            agg[2] += wall
+
+    def summary(self) -> Dict[str, Any]:
+        ops = [{"index": idx, "name": name, "blocks": int(b),
+                "rows": int(r), "wall_s": w}
+               for (idx, name), (b, r, w) in sorted(self._ops.items())]
+        return {"ops": ops, "blocks_recorded": self._batches,
+                "elapsed_s": time.time() - self._started}
+
+
+class CollectorHandle:
+    """Shared ownership wrapper: datasets (and their materialized
+    derivatives) hold this; when the last holder is garbage-collected a
+    weakref finalizer kills the actor — per-execution collectors would
+    otherwise leak one worker process per epoch."""
+
+    def __init__(self, actor):
+        self.actor = actor
+
+
+class DatasetStats:
+    """Rendered summary handed back by `ds.stats()`."""
+
+    def __init__(self, summary: Dict[str, Any]):
+        self._summary = summary
+
+    @property
+    def ops(self) -> List[Dict[str, Any]]:
+        return self._summary["ops"]
+
+    def __repr__(self) -> str:
+        lines = ["Dataset execution stats:"]
+        for op in self.ops:
+            wall = op["wall_s"]
+            per_block = wall / op["blocks"] if op["blocks"] else 0.0
+            lines.append(
+                f"  {op['name']}: {op['blocks']} blocks, "
+                f"{op['rows']} rows, {wall:.3f}s wall "
+                f"({per_block * 1000:.1f}ms/block)")
+        lines.append(f"  total elapsed: {self._summary['elapsed_s']:.3f}s")
+        return "\n".join(lines)
+
+
+def timed_apply(fns: List[Any], producer, args: tuple
+                ) -> Tuple[Any, List[Tuple[int, str, float, int]]]:
+    """Run producer + fused transforms, timing each op. Returns the
+    final block and the per-op records for this block."""
+    records: List[Tuple[int, str, float, int]] = []
+    t0 = time.perf_counter()
+    block = producer(*args) if producer is not None else args[0]
+    if producer is not None:
+        records.append(
+            (-1, getattr(producer, "_op_name", None)
+             or f"Read({getattr(producer, '__name__', 'producer')})",
+             time.perf_counter() - t0, block_rows(block)))
+    for i, fn in enumerate(fns):
+        t1 = time.perf_counter()
+        block = fn(block)
+        records.append(
+            (i, getattr(fn, "_op_name", None)
+             or getattr(fn, "__name__", "transform"),
+             time.perf_counter() - t1, block_rows(block)))
+    return block, records
+
+
+def make_collector() -> Optional[CollectorHandle]:
+    """Spawn the zero-CPU stats actor (None if the cluster is down),
+    wrapped for GC-driven reaping."""
+    import weakref
+
+    import ray_tpu
+
+    try:
+        actor = ray_tpu.remote(_StatsCollector).options(num_cpus=0).remote()
+    except Exception:  # noqa: BLE001 — stats must never break execution
+        return None
+    handle = CollectorHandle(actor)
+    weakref.finalize(handle, reap_collector, actor)
+    return handle
+
+
+def reap_collector(actor) -> None:
+    import ray_tpu
+
+    try:
+        ray_tpu.kill(actor)
+    except Exception:  # noqa: BLE001 — cluster may already be down
+        pass
+
+
+def fetch(collector: Optional[CollectorHandle],
+          expected_blocks: Optional[int] = None,
+          timeout_s: float = 2.0) -> Optional[DatasetStats]:
+    """Summary snapshot. record() pushes are fire-and-forget from worker
+    processes with no cross-client ordering vs this summary call, so
+    when the caller knows how many blocks executed we poll until the
+    collector has seen them all (or a short timeout)."""
+    import ray_tpu
+
+    if collector is None:
+        return None
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            summary = ray_tpu.get(collector.actor.summary.remote(),
+                                  timeout=10)
+            if (not expected_blocks
+                    or summary["blocks_recorded"] >= expected_blocks
+                    or time.monotonic() >= deadline):
+                return DatasetStats(summary)
+            time.sleep(0.02)
+    except Exception:  # noqa: BLE001
+        return None

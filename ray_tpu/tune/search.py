@@ -135,7 +135,8 @@ class TPESearcher(Searcher):
 
     def __init__(self, param_space: Dict[str, Any], metric: str,
                  mode: str = "min", n_initial: int = 8, gamma: float = 0.25,
-                 n_candidates: int = 24, seed: Optional[int] = None):
+                 n_candidates: int = 24, exploration: float = 0.1,
+                 seed: Optional[int] = None):
         for k, v in param_space.items():
             if isinstance(v, GridSearch):
                 raise ValueError(
@@ -147,6 +148,10 @@ class TPESearcher(Searcher):
         self.n_initial = n_initial
         self.gamma = gamma
         self.n_candidates = n_candidates
+        # Fraction of suggestions drawn uniformly even after the model
+        # kicks in: pure exploitation of a sparse KDE can fixate on a
+        # boundary and starve the model of fresh observations.
+        self.exploration = exploration
         self._rng = random.Random(seed)
         self._history: List[Any] = []  # (config, score) with score not None
 
@@ -165,12 +170,16 @@ class TPESearcher(Searcher):
 
     def suggest(self) -> Dict[str, Any]:
         hist = self._model_history()
-        if len(hist) < self.n_initial:
+        if len(hist) < self.n_initial \
+                or self._rng.random() < self.exploration:
             return {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
                     for k, v in self.param_space.items()}
         ordered = sorted(hist, key=lambda cs: cs[1],
                          reverse=(self.mode == "max"))
-        n_good = max(1, int(len(ordered) * self.gamma))
+        # At least two good points once possible: a single-point "good"
+        # KDE gets bandwidth = the whole span and models nothing.
+        n_good = max(2 if len(ordered) >= 4 else 1,
+                     int(len(ordered) * self.gamma))
         good = [c for c, _ in ordered[:n_good]]
         bad = [c for c, _ in ordered[n_good:]] or good
         out: Dict[str, Any] = {}
@@ -255,21 +264,25 @@ class BOHBSearcher(TPESearcher):
                  n_candidates: int = 24, seed: Optional[int] = None):
         super().__init__(param_space, metric, mode, n_initial=n_initial,
                          gamma=gamma, n_candidates=n_candidates, seed=seed)
-        # budget -> [(config, score)]; a config's entry at a budget is its
-        # latest score there.
-        self._by_budget: Dict[int, List[Any]] = {}
+        # budget -> {config key -> (config, score)}: one entry per
+        # distinct config per budget, latest score wins — replayed
+        # iterations (checkpoint restores, exploit restarts) must not
+        # double-weight a config in the KDE or inflate a budget past
+        # n_initial with duplicates.
+        self._by_budget: Dict[int, Dict[str, Any]] = {}
 
     def on_result(self, config: Dict[str, Any], result: Dict[str, Any]):
         score = result.get(self.metric)
         if score is None:
             return
         budget = int(result.get("training_iteration", 1))
-        self._by_budget.setdefault(budget, []).append(
-            (dict(config), float(score)))
+        key = repr(sorted(config.items(), key=lambda kv: kv[0]))
+        self._by_budget.setdefault(budget, {})[key] = (
+            dict(config), float(score))
 
     def _model_history(self) -> List[Any]:
         for budget in sorted(self._by_budget, reverse=True):
-            obs = self._by_budget[budget]
+            obs = list(self._by_budget[budget].values())
             if len(obs) >= self.n_initial:
                 return obs
         return self._history

@@ -285,3 +285,41 @@ def test_map_groups_equal_keys_across_types(ray_start_shared):
         lambda rows: {"k": rows[0]["k"], "total": sum(r["v"] for r in rows)})
     rows = sorted(out.take_all(), key=lambda r: float(r["k"]))
     assert [r["total"] for r in rows] == [111, 5]
+
+
+def test_dataset_stats_per_op(ray_start_shared):
+    """ds.stats() reports per-operator blocks/rows/wall after execution
+    (reference Dataset.stats, data/_internal/stats.py)."""
+    from ray_tpu import data
+
+    def double(r):
+        return {"id": r["id"] * 2}
+
+    ds = data.range(100, parallelism=4).map(double).filter(
+        lambda r: r["id"] % 4 == 0)
+    out = ds.materialize()
+    assert sorted(r["id"] for r in out.take_all())[:3] == [0, 4, 8]
+    stats = out.stats()
+    assert stats is not None
+    names = [op["name"] for op in stats.ops]
+    assert any(n.startswith("Map(double)") for n in names), names
+    assert any(n.startswith("Filter(") for n in names), names
+    read_ops = [op for op in stats.ops if op["index"] == -1]
+    assert read_ops and read_ops[0]["blocks"] == 4
+    map_op = next(op for op in stats.ops if op["name"] == "Map(double)")
+    assert map_op["rows"] == 100 and map_op["blocks"] == 4
+    assert "blocks" in repr(stats) and "wall" in repr(stats)
+
+
+def test_dataset_stats_disabled(ray_start_shared):
+    from ray_tpu import data
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    ctx.enable_stats = False
+    try:
+        ds = data.range(10, parallelism=2).map(lambda x: x)
+        ds = ds.materialize()
+        assert ds.stats() is None
+    finally:
+        ctx.enable_stats = True

@@ -1,0 +1,111 @@
+"""Runtime env pip/venv plugin: offline install from a local wheelhouse.
+
+Reference behavior: `python/ray/_private/runtime_env/pip.py` builds a
+virtualenv per runtime_env and runs workers inside it; here the venv is
+built offline (`--no-index --find-links <wheelhouse>`), cached by
+content hash, and activated via sys.path before any user import. The
+test builds its own trivial wheel (a wheel is just a zip) so nothing is
+fetched from any index.
+"""
+
+import os
+import zipfile
+
+import pytest
+
+
+def _make_wheel(wheelhouse: str, name: str = "rtpkg", version: str = "1.0",
+                value: int = 123) -> str:
+    os.makedirs(wheelhouse, exist_ok=True)
+    whl = os.path.join(wheelhouse, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        zf.writestr(f"{dist}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{dist}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib:"
+                    " true\nTag: py3-none-any\n")
+        zf.writestr(f"{dist}/RECORD", "")
+    return whl
+
+
+def test_normalize_and_hash(tmp_path):
+    from ray_tpu.core.runtime_env import _normalize_pip, pip_env_hash
+
+    wh = str(tmp_path / "wheels")
+    _make_wheel(wh)
+    env = {"pip": ["rtpkg"], "pip_wheelhouse": wh}
+    _normalize_pip(env)
+    assert env["pip"]["packages"] == ["rtpkg"]
+    assert env["pip"]["wheelhouse"] == wh
+    assert "pip_wheelhouse" not in env
+    h1 = env["pip"]["env_hash"]
+    assert h1 == pip_env_hash(env["pip"])
+    # Adding a wheel changes the hash (stale venvs/workers never reused).
+    _make_wheel(wh, name="other")
+    assert pip_env_hash(env["pip"]) != h1
+
+    with pytest.raises(ValueError, match="wheelhouse"):
+        _normalize_pip({"pip": ["rtpkg"]})
+    with pytest.raises(ValueError, match="not a directory"):
+        _normalize_pip({"pip": ["x"], "pip_wheelhouse": "/nope/nope"})
+
+
+def test_pip_env_installs_in_worker(tmp_path):
+    """A task with a pip runtime_env imports the wheel's package; a task
+    without it cannot (worker-pool isolation by env marker)."""
+    import ray_tpu
+
+    wh = str(tmp_path / "wheels")
+    _make_wheel(wh, value=777)
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"pip": ["rtpkg"],
+                                     "pip_wheelhouse": wh})
+        def with_pkg():
+            import rtpkg
+
+            return rtpkg.VALUE, os.environ.get("VIRTUAL_ENV", "")
+
+        @ray_tpu.remote
+        def without_pkg():
+            try:
+                import rtpkg  # noqa: F401
+
+                return "importable"
+            except ImportError:
+                return "missing"
+
+        value, venv = ray_tpu.get(with_pkg.remote(), timeout=120)
+        assert value == 777
+        assert "venv-" in venv
+        assert ray_tpu.get(without_pkg.remote(), timeout=60) == "missing"
+
+        # Second task with the same env hits the cached venv (same dir).
+        _, venv2 = ray_tpu.get(with_pkg.remote(), timeout=120)
+        assert venv2 == venv
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pip_env_missing_package_fails_loudly(tmp_path):
+    import ray_tpu
+
+    wh = str(tmp_path / "wheels")
+    _make_wheel(wh)
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"pip": ["no-such-package"],
+                                     "pip_wheelhouse": wh})
+        def f():
+            return 1
+
+        with pytest.raises(Exception,
+                           match="runtime_env setup failed|pip install"):
+            ray_tpu.get(f.remote(), timeout=120)
+    finally:
+        ray_tpu.shutdown()

@@ -86,10 +86,14 @@ def test_bohb_models_largest_informative_budget():
         s.on_result({"x": i / 5}, {"loss": i, "training_iteration": 1})
     for i in range(2):
         s.on_result({"x": i / 2}, {"loss": i, "training_iteration": 4})
-    assert s._model_history() == s._by_budget[1]
-    # Third budget-4 observation flips the model to the higher fidelity.
+    assert s._model_history() == list(s._by_budget[1].values())
+    # Replaying an iteration must not duplicate (restore/exploit replay).
+    s.on_result({"x": 0.0}, {"loss": 5.0, "training_iteration": 4})
+    assert len(s._by_budget[4]) == 2
+    assert dict(s._by_budget[4])[repr(sorted({"x": 0.0}.items()))][1] == 5.0
+    # Third DISTINCT budget-4 observation flips to the higher fidelity.
     s.on_result({"x": 0.9}, {"loss": 0.1, "training_iteration": 4})
-    assert s._model_history() == s._by_budget[4]
+    assert s._model_history() == list(s._by_budget[4].values())
 
 
 def test_bohb_converges_on_quadratic():
