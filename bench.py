@@ -479,6 +479,169 @@ print(json.dumps(out))
 
 
 # --------------------------------------------------------------------------- #
+# Scalability envelope (reference release/benchmarks/README.md:9-31)
+# --------------------------------------------------------------------------- #
+
+
+def _envelope_main(n_tasks: int, n_actors: int, n_pgs: int, n_refs: int,
+                   broadcast_mb: int) -> dict:
+    """Runs inside a fresh subprocess: a 4-raylet fake cluster exercising
+    the reference's scalability-envelope shapes (many queued tasks, many
+    actors, many placement groups, many-ref get, large-object broadcast
+    across nodes). Scaled by the caller; returns the metrics dict."""
+    import time as _time
+
+    import numpy as _np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    out: dict = {}
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4})
+    for _ in range(3):
+        cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+    cluster.connect()
+    try:
+        @ray_tpu.remote
+        def noop(i):
+            return i
+
+        ray_tpu.get([noop.remote(i) for i in range(20)])  # warm workers
+
+        # Many queued tasks: submit far beyond capacity, then drain.
+        t0 = _time.perf_counter()
+        refs = [noop.remote(i) for i in range(n_tasks)]
+        submit_s = _time.perf_counter() - t0
+        ray_tpu.get(refs)
+        total_s = _time.perf_counter() - t0
+        out["envelope_tasks"] = n_tasks
+        out["envelope_task_submit_per_s"] = n_tasks / submit_s
+        out["envelope_task_throughput_per_s"] = n_tasks / total_s
+        del refs
+
+        # Many-ref get (reference ray.get on 10k refs).
+        refs = [noop.remote(i) for i in range(n_refs)]
+        ray_tpu.wait(refs, num_returns=n_refs, timeout=600)
+        t0 = _time.perf_counter()
+        vals = ray_tpu.get(refs)
+        out["envelope_get_many_refs_s"] = _time.perf_counter() - t0
+        assert len(vals) == n_refs
+        del refs, vals
+
+        # Many actors: create, one call each, kill.
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        # Let the direct transport return its idle leases first so actor
+        # creations can REUSE pooled workers instead of cold-spawning
+        # past the pool (a cold spawn storm on a small host outruns the
+        # 30s registration window).
+        _time.sleep(3.0)
+        t0 = _time.perf_counter()
+        actors = []
+        # Waves: an unbounded spawn storm can outrun worker registration
+        # on small hosts; waves of pool size still measure steady rate.
+        wave = 8
+        for start in range(0, n_actors, wave):
+            batch = [A.options(num_cpus=0.01).remote()
+                     for _ in range(min(wave, n_actors - start))]
+            ray_tpu.get([a.ping.remote() for a in batch])
+            actors.extend(batch)
+        out["envelope_actors"] = n_actors
+        out["envelope_actor_create_call_per_s"] = (
+            n_actors / (_time.perf_counter() - t0))
+        for a in actors:
+            ray_tpu.kill(a)
+        del actors
+
+        # Many placement groups (1 tiny bundle each): create+ready+remove.
+        t0 = _time.perf_counter()
+        pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n_pgs)]
+        for pg in pgs:
+            pg.ready()  # blocking (2PC commit across the fake nodes)
+        for pg in pgs:
+            remove_placement_group(pg)
+        out["envelope_pgs"] = n_pgs
+        out["envelope_pg_cycle_per_s"] = n_pgs / (_time.perf_counter() - t0)
+
+        # Broadcast: one large object read by one task per node.
+        arr = _np.random.default_rng(0).random(
+            broadcast_mb * 1024 * 1024 // 8)
+        big = ray_tpu.put(arr)
+
+        @ray_tpu.remote
+        def checksum(x):
+            return float(x[::4096].sum())
+
+        expect = float(arr[::4096].sum())
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        nodes = [n["NodeID"] for n in ray_tpu.nodes() if n["Alive"]]
+        # Warm one worker per node first: the broadcast number should
+        # measure the object read path, not cold interpreter spawns.
+        ray_tpu.get([noop.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=True)).remote(0) for nid in nodes],
+            timeout=600)
+        t0 = _time.perf_counter()
+        reads = [checksum.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=True)).remote(big) for nid in nodes]
+        sums = ray_tpu.get(reads, timeout=600)
+        dt = _time.perf_counter() - t0
+        assert all(abs(s - expect) < 1e-6 * max(1.0, abs(expect))
+                   for s in sums)
+        out["envelope_broadcast_mb"] = broadcast_mb
+        out["envelope_broadcast_nodes"] = len(nodes)
+        out["envelope_broadcast_gb_s"] = (
+            arr.nbytes * len(nodes) / dt / 1e9)
+    finally:
+        cluster.shutdown()
+    return out
+
+
+def bench_envelope(quick: bool) -> dict:
+    """Subprocess-isolated envelope run (its fake cluster must not touch
+    the bench's own runtime)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    sizes = ((3000, 30, 20, 2000, 128) if quick
+             else (20000, 200, 100, 10000, 1024))
+    code = ("import bench, json; "
+            f"print('ENV_RESULT ' + json.dumps(bench._envelope_main"
+            f"{sizes!r}))")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    # Concurrent cold spawns share this host's cores with the whole fake
+    # cluster; the default 30s registration window is sized for a real
+    # node running one raylet.
+    env["RAY_TPU_WORKER_LEASE_TIMEOUT_MS"] = "180000"
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=1800,
+                          cwd=os.path.dirname(os.path.abspath(__file__)),
+                          env=env)
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("ENV_RESULT "):
+            return _json.loads(line[len("ENV_RESULT "):])
+    raise RuntimeError(
+        f"envelope run failed (rc={proc.returncode}): "
+        f"{(proc.stderr or '')[-500:]}")
+
+
+# --------------------------------------------------------------------------- #
 # Serve: batched GPT-2 sampler behind HTTP under concurrent load
 # --------------------------------------------------------------------------- #
 
@@ -587,6 +750,7 @@ def main(out=None):
     ap.add_argument("--skip-train", action="store_true")
     ap.add_argument("--skip-ppo", action="store_true")
     ap.add_argument("--skip-serve", action="store_true")
+    ap.add_argument("--skip-envelope", action="store_true")
     args = ap.parse_args()
 
     import ray_tpu
@@ -653,6 +817,11 @@ def main(out=None):
             extra.update(bench_serve(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["serve_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_envelope:
+        try:
+            extra.update(bench_envelope(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["envelope_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

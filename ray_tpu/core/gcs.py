@@ -105,6 +105,9 @@ class GcsServer:
         # Tables
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
+        # node -> actor creations currently in flight (hybrid scheduling
+        # counts them toward utilization; heartbeat load reports lag).
+        self._inflight_creates: Dict[NodeID, int] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.jobs: Dict[JobID, JobInfo] = {}
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
@@ -772,12 +775,21 @@ class GcsServer:
                     continue
                 create_client = RpcClient(
                     info.address, name=f"gcs-create-actor-{actor_id.hex()[:8]}")
+                with self._lock:
+                    self._inflight_creates[node_id] = \
+                        self._inflight_creates.get(node_id, 0) + 1
                 try:
                     resp = create_client.call(
                         "create_actor", {"spec": spec},
                         timeout=GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0 * 2)
                 finally:
                     create_client.close()
+                    with self._lock:
+                        n = self._inflight_creates.get(node_id, 1) - 1
+                        if n <= 0:
+                            self._inflight_creates.pop(node_id, None)
+                        else:
+                            self._inflight_creates[node_id] = n
             except Exception as e:
                 logger.warning("actor %s creation on %s failed: %s",
                                actor_id.hex()[:12], node_id.hex()[:12], e)
@@ -824,13 +836,24 @@ class GcsServer:
                     return target.node_id
             if not candidates:
                 return None
-            # Pack: most-utilized feasible node first (binpacking friendly).
-            def score(n: NodeInfo):
+
+            # Hybrid (reference scheduling_policy.cc): pack onto the
+            # most-utilized node while it stays under the threshold, then
+            # spread to the least-utilized — tiny actors no longer all
+            # funnel onto one node whose worker spawns serialize. Creates
+            # in flight count toward utilization: heartbeats lag, and N
+            # concurrent creations would otherwise all pick the same
+            # node before its load report catches up.
+            def utilization(n: NodeInfo) -> float:
                 total = sum(n.resources_total.values()) or 1.0
                 avail = sum(n.resources_available.values())
-                return avail / total
-            candidates.sort(key=score)
-            return candidates[0].node_id
+                inflight = self._inflight_creates.get(n.node_id, 0)
+                return (total - avail) / total + 0.1 * inflight
+
+            packable = [n for n in candidates if utilization(n) < 0.5]
+            if packable:
+                return max(packable, key=utilization).node_id
+            return min(candidates, key=utilization).node_id
 
     def _on_actor_failure(self, info: ActorInfo, reason: str):
         with self._lock:

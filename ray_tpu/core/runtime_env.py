@@ -116,19 +116,22 @@ def _normalize_pip(out: Dict[str, Any]) -> None:
 
 def pip_env_hash(pip: Dict[str, Any]) -> str:
     """Content hash identifying one venv: the package list plus the
-    wheelhouse manifest (file names + sizes), so adding or rebuilding a
-    wheel produces a fresh venv instead of stale-cache confusion."""
+    wheelhouse manifest (path + file names + sizes + mtimes — mtime
+    catches a rebuilt wheel whose byte size happens to match), so adding
+    or rebuilding a wheel produces a fresh venv instead of stale-cache
+    confusion."""
     h = hashlib.sha256()
     for p in pip["packages"]:
         h.update(p.encode())
         h.update(b"\0")
     wh = pip["wheelhouse"]
+    h.update(wh.encode())
     try:
         for name in sorted(os.listdir(wh)):
             if name.endswith(".whl"):
+                st = os.stat(os.path.join(wh, name))
                 h.update(name.encode())
-                h.update(str(os.path.getsize(
-                    os.path.join(wh, name))).encode())
+                h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
     except OSError:
         pass
     return h.hexdigest()[:24]
@@ -307,6 +310,15 @@ def _ensure_venv(pip: Dict[str, Any], cache: str) -> str:
     dest = os.path.join(cache, f"venv-{env_hash}")
     if os.path.isdir(dest):
         return dest
+    if not os.path.isdir(pip["wheelhouse"]):
+        # Wheelhouses are LOCAL paths, deliberately not shipped through
+        # the GCS KV (they can dwarf the blob store): on multi-host
+        # clusters they must exist at the same path on every node
+        # (shared filesystem or baked into the image).
+        raise RuntimeError(
+            f"pip wheelhouse {pip['wheelhouse']!r} does not exist on "
+            f"this node; wheelhouses must be present at the same path "
+            f"on every node (shared FS or machine image)")
     os.makedirs(cache, exist_ok=True)
     lock_path = os.path.join(cache, f"venv-{env_hash}.lock")
     with open(lock_path, "w") as lock_f:

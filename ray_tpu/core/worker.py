@@ -149,6 +149,15 @@ class WorkerRuntime(CoreRuntime):
                 self._execute(spec)
             else:
                 self._execute_direct(spec, reply_conn)
+            if getattr(self, "_env_setup_error", None):
+                # The failure has been delivered to exactly one task (as
+                # RuntimeEnvSetupError); exit so this poisoned worker
+                # leaves the pool — a retry gets a FRESH worker whose env
+                # build may succeed, instead of re-leasing this one and
+                # failing the same env forever.
+                logger.error("exiting after runtime_env setup failure")
+                self._stopping.set()
+                return
 
     # ----------------------------------------------------------- execution
 

@@ -367,14 +367,19 @@ class Dataset:
         executor = StreamingExecutor(self._transforms,
                                      resources=self._resources,
                                      stats_collector=collector)
-        self._executed_blocks = 0
+        # Cumulative across executions: the collector aggregates every
+        # run of this Dataset, so the stats() flush barrier must expect
+        # the total, not just the latest run's blocks.
+        if getattr(self, "_executed_blocks", None) is None:
+            self._executed_blocks = 0
         for ref in executor.execute(iter(self._work)):
             self._executed_blocks += 1
             yield ref
 
     def stats(self):
-        """Per-operator wall/rows/blocks summary of the most recent
-        execution (reference `Dataset.stats()`,
+        """Per-operator wall/rows/blocks summary, aggregated over every
+        execution of this Dataset so far (re-iterating a lazy dataset
+        adds to the totals — reference `Dataset.stats()`,
         `data/_internal/stats.py`). None before any execution."""
         from ray_tpu.data import stats as stats_mod
 

@@ -71,8 +71,10 @@ class StreamingExecutor:
 
         if self._stats is not None:
             base = ray_tpu.remote(_fused_apply_stats)
+            extra = (self._stats.actor,)
         else:
             base = ray_tpu.remote(_fused_apply)
+            extra = ()
         remote_fn = base.options(**self._resources) if self._resources \
             else base
 
@@ -91,13 +93,8 @@ class StreamingExecutor:
                 except StopIteration:
                     exhausted = True
                     break
-                if self._stats is not None:
-                    ref = remote_fn.remote(self._transforms,
-                                           self._stats.actor,
-                                           producer, *args)
-                else:
-                    ref = remote_fn.remote(self._transforms, producer,
-                                           *args)
+                ref = remote_fn.remote(self._transforms, *extra,
+                                       producer, *args)
                 in_flight[ref] = submitted
                 submitted += 1
             # Yield strictly in submission order (the reference's streaming

@@ -1,0 +1,18 @@
+"""Scalability-envelope smoke (reference release/benchmarks/README.md).
+
+The real numbers come from `python bench.py` (bench_envelope); this
+keeps the envelope harness itself from rotting, at toy sizes.
+"""
+
+
+def test_envelope_smoke():
+    import bench
+
+    out = bench._envelope_main(60, 4, 3, 40, 8)
+    assert out["envelope_tasks"] == 60
+    assert out["envelope_task_throughput_per_s"] > 0
+    assert out["envelope_get_many_refs_s"] >= 0
+    assert out["envelope_actors"] == 4
+    assert out["envelope_pgs"] == 3
+    assert out["envelope_broadcast_nodes"] >= 1
+    assert out["envelope_broadcast_gb_s"] > 0
