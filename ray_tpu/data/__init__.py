@@ -88,25 +88,60 @@ def from_arrow(table) -> Dataset:
 
 # -------------------------------------------------------------------- reads #
 
-
-def read_parquet(paths, *, columns: Optional[List[str]] = None,
-                 parallelism: int = -1) -> Dataset:
-    files = _ds.expand_paths(paths)
-    return Dataset([(_ds.read_parquet_file, (f, columns)) for f in files])
+Partitioning = _ds.Partitioning
 
 
-def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+def _file_work(paths, reader, *reader_args,
+               partitioning: Optional["Partitioning"] = None,
+               partition_filter=None):
+    """Shared file-read planning: expand paths, apply the partition
+    filter (on parsed partition dicts when a scheme is given, else on
+    raw paths), and wrap the reader to attach partition columns
+    (reference `file_based_datasource.py` + `partitioning.py`)."""
     import functools
 
     files = _ds.expand_paths(paths)
+    if partition_filter is not None:
+        if partitioning is not None:
+            files = [f for f in files
+                     if partition_filter(partitioning.parse(f))]
+        else:
+            files = [f for f in files if partition_filter(f)]
+        if not files:
+            raise FileNotFoundError(
+                "partition_filter excluded every input file")
+    if partitioning is not None:
+        reader = functools.partial(_ds.partitioned_reader, reader)
+        return [(reader, (f, partitioning) + reader_args) for f in files]
+    return [(reader, (f,) + reader_args) for f in files]
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 partitioning: Optional["Partitioning"] = None,
+                 partition_filter=None,
+                 parallelism: int = -1) -> Dataset:
+    return Dataset(_file_work(paths, _ds.read_parquet_file, columns,
+                              partitioning=partitioning,
+                              partition_filter=partition_filter))
+
+
+def read_csv(paths, *, partitioning: Optional["Partitioning"] = None,
+             partition_filter=None, parallelism: int = -1, **kw) -> Dataset:
+    import functools
+
     reader = functools.partial(_ds.read_csv_file, **kw) if kw \
         else _ds.read_csv_file
-    return Dataset([(reader, (f,)) for f in files])
+    return Dataset(_file_work(paths, reader,
+                              partitioning=partitioning,
+                              partition_filter=partition_filter))
 
 
-def read_json(paths, *, lines: bool = True, parallelism: int = -1) -> Dataset:
-    files = _ds.expand_paths(paths)
-    return Dataset([(_ds.read_json_file, (f, lines)) for f in files])
+def read_json(paths, *, lines: bool = True,
+              partitioning: Optional["Partitioning"] = None,
+              partition_filter=None, parallelism: int = -1) -> Dataset:
+    return Dataset(_file_work(paths, _ds.read_json_file, lines,
+                              partitioning=partitioning,
+                              partition_filter=partition_filter))
 
 
 def read_text(paths, *, encoding: str = "utf-8",
@@ -141,10 +176,30 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = -1
 
 
 def read_images(paths, *, size=None, mode: Optional[str] = None,
-                parallelism: int = -1) -> Dataset:
+                partitioning: Optional["Partitioning"] = None,
+                partition_filter=None, parallelism: int = -1) -> Dataset:
     """Decoded images as {"image": ndarray, "path": str} rows."""
+    return Dataset(_file_work(paths, _ds.read_image_file, size, mode,
+                              partitioning=partitioning,
+                              partition_filter=partition_filter))
+
+
+def read_webdataset(paths, *, decode: bool = True,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset tar shards -> sample rows grouped by basename stem
+    ({'__key__': ..., '<ext>': value}); one block per shard (reference
+    `ray.data.read_webdataset`, standard tarfile — no webdataset dep)."""
     files = _ds.expand_paths(paths)
-    return Dataset([(_ds.read_image_file, (f, size, mode)) for f in files])
+    return Dataset([(_ds.read_webdataset_shard, (f, decode))
+                    for f in files])
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: int = -1) -> Dataset:
+    """MongoDB collection rows (reference `ray.data.read_mongo`); needs
+    pymongo at execution time."""
+    return Dataset([(_ds.read_mongo_collection,
+                     (uri, database, collection, pipeline))])
 
 
 __all__ = [
@@ -154,5 +209,5 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy", "read_binary_files", "read_tfrecords", "read_sql",
-    "read_images",
+    "read_images", "read_webdataset", "read_mongo", "Partitioning",
 ]

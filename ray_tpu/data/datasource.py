@@ -33,6 +33,106 @@ def expand_paths(paths) -> List[str]:
     return out
 
 
+# -------------------------------------------------------------- partitioning #
+
+
+class Partitioning:
+    """Path-based partitioning scheme (reference
+    `python/ray/data/datasource/partitioning.py` Partitioning /
+    PathPartitionParser).
+
+    style="hive": `.../year=2024/country=de/part.parquet` -> columns
+    {year: "2024", country: "de"}.
+    style="dir": positional `field_names` map path directories under
+    `base_dir` to columns: field_names=["year", "country"] parses
+    `.../2024/de/part.parquet`.
+    """
+
+    def __init__(self, style: str = "hive",
+                 base_dir: Optional[str] = None,
+                 field_names: Optional[List[str]] = None):
+        if style not in ("hive", "dir"):
+            raise ValueError(f"unknown partitioning style {style!r}")
+        if style == "dir" and not field_names:
+            raise ValueError("style='dir' requires field_names")
+        self.style = style
+        self.base_dir = os.path.normpath(base_dir) if base_dir else None
+        self.field_names = list(field_names or [])
+
+    def parse(self, path: str) -> Dict[str, str]:
+        """Partition column values encoded in `path` (empty when none)."""
+        rel = os.path.dirname(os.path.abspath(path))
+        if self.base_dir:
+            base = os.path.abspath(self.base_dir)
+            # Containment, not string prefix: /data/tbl_backup must not
+            # read as inside /data/tbl.
+            if rel != base and not rel.startswith(base + os.sep):
+                return {}
+            rel = rel[len(base):].lstrip(os.sep)
+        parts = [p for p in rel.split(os.sep) if p]
+        if self.style == "hive":
+            out = {}
+            for p in parts:
+                if "=" in p:
+                    k, _, v = p.partition("=")
+                    out[k] = v
+            return out
+        # dir style: the LAST len(field_names) directories map by position.
+        tail = parts[-len(self.field_names):]
+        if len(tail) < len(self.field_names):
+            return {}
+        return dict(zip(self.field_names, tail))
+
+
+def attach_partition_columns(block: Any, parts: Dict[str, str]) -> Any:
+    """Append constant partition columns to a block (tabular blocks:
+    pandas / arrow / dict-of-arrays / list-of-dict rows)."""
+    if not parts:
+        return block
+    try:
+        import pandas as pd
+
+        if isinstance(block, pd.DataFrame):
+            for k, v in parts.items():
+                if k not in block.columns:
+                    block[k] = v
+            return block
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            n = block.num_rows
+            for k, v in parts.items():
+                if k not in block.column_names:
+                    block = block.append_column(k, pa.array([v] * n))
+            return block
+    except ImportError:
+        pass
+    if isinstance(block, dict):
+        n = len(next(iter(block.values()))) if block else 0
+        for k, v in parts.items():
+            block.setdefault(k, np.full(n, v, dtype=object))
+        return block
+    if isinstance(block, list) and block and isinstance(block[0], dict):
+        for row in block:
+            for k, v in parts.items():
+                row.setdefault(k, v)
+        return block
+    return block
+
+
+def partitioned_reader(reader, path: str,
+                       partitioning: Optional[Partitioning], *args, **kw):
+    """Wrap a per-file reader: parse the path's partition values and
+    attach them as columns."""
+    block = reader(path, *args, **kw)
+    if partitioning is not None:
+        block = attach_partition_columns(block, partitioning.parse(path))
+    return block
+
+
 # ------------------------------------------------------------------ readers #
 
 
@@ -191,6 +291,97 @@ def read_image_file(path: str, size=None, mode: Optional[str] = None
             img = img.resize(tuple(size))
         arr = np.asarray(img)
     return [{"image": arr, "path": path}]
+
+
+def read_webdataset_shard(path: str, decode: bool = True
+                          ) -> List[Dict[str, Any]]:
+    """One WebDataset tar shard -> sample rows (reference
+    `python/ray/data/read_api.py` read_webdataset / the webdataset
+    format: files sharing a basename stem form one sample; extensions
+    become fields). Standard tarfile only — no webdataset dependency."""
+    import io
+    import json as _json
+    import tarfile
+
+    samples: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    with tarfile.open(path, "r:*") as tf:
+        for member in tf:
+            if not member.isfile():
+                continue
+            name = os.path.basename(member.name)
+            if name.startswith("."):
+                continue
+            stem, _, ext = name.partition(".")
+            raw = tf.extractfile(member).read()
+            value: Any = raw
+            if decode:
+                if ext in ("txt", "text"):
+                    value = raw.decode("utf-8", "replace")
+                elif ext == "cls":
+                    value = int(raw.decode().strip())
+                elif ext == "json":
+                    value = _json.loads(raw)
+                elif ext in ("jpg", "jpeg", "png", "webp"):
+                    try:
+                        from PIL import Image
+
+                        value = np.asarray(Image.open(io.BytesIO(raw)))
+                    except Exception:  # noqa: BLE001 — no PIL: raw bytes
+                        value = raw
+            if stem not in samples:
+                samples[stem] = {"__key__": stem}
+                order.append(stem)
+            samples[stem][ext] = value
+    return [samples[k] for k in order]
+
+
+def write_webdataset_shard(rows: List[Dict[str, Any]], path: str) -> str:
+    """Rows ({'__key__': ..., ext: value}) -> one tar shard."""
+    import io
+    import json as _json
+    import tarfile
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with tarfile.open(path, "w") as tf:
+        for i, row in enumerate(rows):
+            key = str(row.get("__key__", f"{i:08d}"))
+            for ext, value in row.items():
+                if ext == "__key__":
+                    continue
+                if isinstance(value, (bytes, bytearray)):
+                    raw = bytes(value)
+                elif isinstance(value, str):
+                    raw = value.encode()
+                elif isinstance(value, (int, np.integer)):
+                    raw = str(int(value)).encode()
+                else:
+                    raw = _json.dumps(
+                        value.tolist() if isinstance(value, np.ndarray)
+                        else value).encode()
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(raw)
+                tf.addfile(info, io.BytesIO(raw))
+    return path
+
+
+def read_mongo_collection(uri: str, database: str, collection: str,
+                          pipeline=None) -> List[Dict[str, Any]]:
+    """MongoDB collection -> rows (reference MongoDatasource). Requires
+    pymongo (not bundled; a clear error gates it)."""
+    try:
+        import pymongo
+    except ImportError as e:
+        raise ImportError(
+            "read_mongo requires the pymongo package, which is not "
+            "installed in this environment") from e
+    client = pymongo.MongoClient(uri)
+    try:
+        coll = client[database][collection]
+        cursor = coll.aggregate(pipeline) if pipeline else coll.find()
+        return [{k: v for k, v in doc.items()} for doc in cursor]
+    finally:
+        client.close()
 
 
 def make_range_block(start: int, stop: int) -> Dict[str, np.ndarray]:

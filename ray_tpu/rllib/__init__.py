@@ -28,6 +28,7 @@ from ray_tpu.rllib.impala import (
     vtrace_returns,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
+from ray_tpu.rllib.external import PolicyClient, PolicyServer
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.offline import (
     BC,

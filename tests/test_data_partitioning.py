@@ -1,0 +1,96 @@
+"""Path partitioning + webdataset/mongo datasources.
+
+Reference behavior: `python/ray/data/datasource/partitioning.py`
+(Partitioning/PathPartitionParser/PathPartitionFilter on file readers)
+and `ray.data.read_webdataset` / `read_mongo`.
+"""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+
+
+def _write_partitioned_csv(base):
+    import pandas as pd
+
+    for year, country, vals in [("2023", "de", [1, 2]),
+                                ("2023", "us", [3]),
+                                ("2024", "de", [4, 5, 6])]:
+        d = os.path.join(base, f"year={year}", f"country={country}")
+        os.makedirs(d, exist_ok=True)
+        pd.DataFrame({"v": vals}).to_csv(os.path.join(d, "part.csv"),
+                                         index=False)
+
+
+def test_partitioning_parse_hive_and_dir(tmp_path):
+    p = data.Partitioning("hive")
+    assert p.parse("/x/year=2024/country=de/f.parquet") == {
+        "year": "2024", "country": "de"}
+    assert p.parse("/plain/path/f.parquet") == {}
+
+    p2 = data.Partitioning("dir", field_names=["year", "country"])
+    assert p2.parse("/data/2024/de/f.csv") == {"year": "2024",
+                                               "country": "de"}
+    with pytest.raises(ValueError, match="field_names"):
+        data.Partitioning("dir")
+    with pytest.raises(ValueError, match="style"):
+        data.Partitioning("banana")
+
+
+def test_read_csv_hive_partitioned(ray_start_shared, tmp_path):
+    base = str(tmp_path / "tbl")
+    _write_partitioned_csv(base)
+    ds = data.read_csv(base, partitioning=data.Partitioning("hive"))
+    rows = ds.take_all()
+    assert len(rows) == 6
+    assert all({"v", "year", "country"} <= set(r.keys()) for r in rows)
+    de_2024 = [r["v"] for r in rows
+               if r["year"] == "2024" and r["country"] == "de"]
+    assert sorted(de_2024) == [4, 5, 6]
+
+
+def test_partition_filter_prunes_files(ray_start_shared, tmp_path):
+    base = str(tmp_path / "tbl")
+    _write_partitioned_csv(base)
+    ds = data.read_csv(
+        base, partitioning=data.Partitioning("hive"),
+        partition_filter=lambda parts: parts.get("year") == "2023")
+    rows = ds.take_all()
+    assert sorted(r["v"] for r in rows) == [1, 2, 3]
+    with pytest.raises(FileNotFoundError, match="partition_filter"):
+        data.read_csv(base, partitioning=data.Partitioning("hive"),
+                      partition_filter=lambda parts: False)
+
+
+def test_webdataset_round_trip(ray_start_shared, tmp_path):
+    shard_dir = str(tmp_path / "wds")
+    rows = [{"__key__": f"{i:04d}", "txt": f"hello {i}", "cls": i,
+             "json": {"idx": i}} for i in range(10)]
+    ds = data.from_items(rows, parallelism=2)
+    shards = ds.write_webdataset(shard_dir)
+    assert len(shards) == 2
+    assert all(tarfile.is_tarfile(s) for s in shards)
+
+    back = data.read_webdataset(os.path.join(shard_dir, "*.tar"))
+    got = sorted(back.take_all(), key=lambda r: r["__key__"])
+    assert len(got) == 10
+    assert got[3]["txt"] == "hello 3"
+    assert got[3]["cls"] == 3
+    assert got[3]["json"] == {"idx": 3}
+
+
+def test_read_mongo_gated():
+    try:
+        import pymongo  # noqa: F401
+
+        pytest.skip("pymongo installed; the import gate cannot fire "
+                    "(and no mongod is available to connect to)")
+    except ImportError:
+        pass
+    ds = data.read_mongo("mongodb://localhost", "db", "coll")
+    with pytest.raises(Exception, match="pymongo"):
+        ds.take_all()

@@ -1,0 +1,118 @@
+"""External env: policy server + client over HTTP.
+
+Reference behavior: `rllib/env/policy_server_input.py` /
+`policy_client.py` — an external simulator asks the current policy for
+actions and logs rewards; the server assembles complete episodes into
+trainable batches.
+"""
+
+import numpy as np
+import pytest
+
+
+def _make_server(explore=True):
+    from ray_tpu.rllib.external import PolicyServer
+    from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+
+    module = DiscretePolicyModule(SpecDict(4, 2), hidden=(16, 16))
+    return PolicyServer(module, explore=explore, seed=0)
+
+
+def test_external_episode_collection():
+    from ray_tpu.rllib import sample_batch as sb
+    from ray_tpu.rllib.external import PolicyClient
+
+    server = _make_server()
+    try:
+        client = PolicyClient(server.address)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            eid = client.start_episode()
+            obs = rng.standard_normal(4).astype(np.float32)
+            for step in range(5):
+                a = client.get_action(eid, obs)
+                assert a in (0, 1)
+                obs = rng.standard_normal(4).astype(np.float32)
+                client.log_returns(eid, 1.0)
+            client.end_episode(eid, obs, terminated=True)
+        batch = server.sample_batch()
+        assert batch is not None
+        assert batch[sb.OBS].shape == (15, 4)
+        assert batch[sb.ACTIONS].shape == (15,)
+        # Every step logged reward 1.0 and attribution is per transition.
+        np.testing.assert_allclose(batch[sb.REWARDS], np.ones(15))
+        assert batch["next_obs"].shape == (15, 4)
+        # done only on the terminal transition of each episode
+        assert batch[sb.DONES].sum() == 3
+        assert server.episode_returns() == [5.0, 5.0, 5.0]
+        # drained: next sample is empty until more episodes finish
+        assert server.sample_batch() is None
+    finally:
+        server.stop()
+
+
+def test_external_batch_trains_dqn_learner():
+    """Collected external transitions are learnable (DQN TD update)."""
+    from ray_tpu.rllib.dqn import DQNConfig, DQNLearner, QModule
+    from ray_tpu.rllib.external import PolicyClient
+    from ray_tpu.rllib.rl_module import SpecDict
+
+    server = _make_server()
+    try:
+        client = PolicyClient(server.address)
+        rng = np.random.default_rng(1)
+        eid = client.start_episode()
+        obs = rng.standard_normal(4).astype(np.float32)
+        for _ in range(32):
+            client.get_action(eid, obs)
+            obs = rng.standard_normal(4).astype(np.float32)
+            client.log_returns(eid, float(rng.random()))
+        client.end_episode(eid, obs)
+        batch = server.sample_batch()
+        learner = DQNLearner(QModule(SpecDict(4, 2), hidden=(16, 16)),
+                             DQNConfig(), seed=0)
+        metrics, td = learner.update_dqn(batch)
+        assert np.isfinite(metrics["td_loss"])
+        assert len(td) == 32
+    finally:
+        server.stop()
+
+
+def test_weight_sync_changes_actions():
+    """Greedy actions reflect set_weights (policy updates propagate)."""
+    import jax
+
+    server = _make_server(explore=False)
+    try:
+        from ray_tpu.rllib.external import PolicyClient
+
+        client = PolicyClient(server.address)
+        obs = np.full(4, 0.5, np.float32)
+
+        def greedy_action():
+            eid = client.start_episode()
+            a = client.get_action(eid, obs)
+            client.end_episode(eid, obs)
+            return a
+
+        greedy_action()  # exercises inference with the initial weights
+        # Swap in all-zero weights: zero logits argmax to action 0 —
+        # proving set_weights() actually changes served actions.
+        params = jax.device_get(server.params)
+        zeroed = jax.tree_util.tree_map(np.zeros_like, params)
+        server.set_weights(zeroed)
+        assert greedy_action() == 0
+    finally:
+        server.stop()
+
+
+def test_client_error_surfacing():
+    from ray_tpu.rllib.external import PolicyClient
+
+    server = _make_server()
+    try:
+        client = PolicyClient(server.address)
+        with pytest.raises(Exception):
+            client.get_action("nonexistent-episode", [0, 0, 0, 0])
+    finally:
+        server.stop()
