@@ -8,6 +8,7 @@ each file/chunk becomes a read task fused with downstream transforms.
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -101,6 +102,14 @@ def _file_work(paths, reader, *reader_args,
     import functools
 
     files = _ds.expand_paths(paths)
+    if (partitioning is not None and partitioning.base_dir is None
+            and isinstance(paths, str) and os.path.isdir(paths)):
+        # Scope parsing to the read root: an ancestor directory that
+        # happens to contain '=' (".../run=3/tbl/...") must not leak in
+        # as a partition column.
+        partitioning = _ds.Partitioning(
+            partitioning.style, base_dir=paths,
+            field_names=partitioning.field_names or None)
     if partition_filter is not None:
         if partitioning is not None:
             files = [f for f in files

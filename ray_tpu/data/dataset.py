@@ -594,24 +594,7 @@ class Dataset:
     def write_webdataset(self, path: str) -> List[str]:
         """One tar shard per block; rows must be dicts whose keys are
         webdataset extensions (plus optional __key__)."""
-        import os as _os
-
-        import ray_tpu
-        from ray_tpu.data.datasource import write_webdataset_shard
-
-        _os.makedirs(path, exist_ok=True)
-
-        @ray_tpu.remote
-        def _write_shard(block, out):
-            rows = list(BlockAccessor(block).rows())
-            return write_webdataset_shard(rows, out)
-
-        refs = [
-            _write_shard.remote(
-                ref, _os.path.join(path, f"shard-{i:06d}.tar"))
-            for i, ref in enumerate(self._iter_block_refs())
-        ]
-        return ray_tpu.get(refs)
+        return self._write(path, "webdataset")
 
     def _write(self, path: str, fmt: str, **kw) -> List[str]:
         import os

@@ -349,12 +349,14 @@ def write_webdataset_shard(rows: List[Dict[str, Any]], path: str) -> str:
             for ext, value in row.items():
                 if ext == "__key__":
                     continue
+                if isinstance(value, np.generic):
+                    value = value.item()  # np scalar -> plain python
                 if isinstance(value, (bytes, bytearray)):
                     raw = bytes(value)
                 elif isinstance(value, str):
                     raw = value.encode()
-                elif isinstance(value, (int, np.integer)):
-                    raw = str(int(value)).encode()
+                elif isinstance(value, int):
+                    raw = str(value).encode()
                 else:
                     raw = _json.dumps(
                         value.tolist() if isinstance(value, np.ndarray)
@@ -415,6 +417,9 @@ def write_block(block: Any, path: str, index: int, fmt: str,
         col = kw.get("column", "item")
         np.save(out, acc.to_batch()[col])
         out += ".npy"
+    elif fmt == "webdataset":
+        out = os.path.join(path, f"shard-{index:06d}.tar")
+        write_webdataset_shard(list(acc.rows()), out)
     else:
         raise ValueError(f"unknown write format {fmt}")
     return out

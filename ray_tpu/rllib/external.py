@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -31,7 +32,7 @@ logger = logging.getLogger(__name__)
 
 class _EpisodeState:
     __slots__ = ("obs", "action", "logp", "value", "transitions", "total",
-                 "pending_reward")
+                 "pending_reward", "last_active")
 
     def __init__(self):
         self.obs = None
@@ -44,6 +45,7 @@ class _EpisodeState:
         # arrives: held here until the transition they belong to is
         # created (at the next get_action / end_episode).
         self.pending_reward = 0.0
+        self.last_active = time.monotonic()
 
 
 class PolicyServer:
@@ -54,8 +56,15 @@ class PolicyServer:
     episodes accumulate until `sample_batch()` drains them.
     """
 
+    # Episodes with no traffic for this long are abandoned (crashed
+    # simulator) and evicted; returns history is ring-bounded.
+    EPISODE_TTL_S = 600.0
+    MAX_RETURNS_KEPT = 1000
+
     def __init__(self, module, host: str = "127.0.0.1", port: int = 0,
                  explore: bool = True, seed: int = 0):
+        from collections import deque
+
         from ray_tpu._jax_env import apply_jax_platform_env
 
         apply_jax_platform_env()
@@ -68,7 +77,7 @@ class PolicyServer:
         self._lock = threading.Lock()
         self._episodes: Dict[str, _EpisodeState] = {}
         self._complete: List[Dict[str, Any]] = []
-        self._episode_returns: List[float] = []
+        self._episode_returns = deque(maxlen=self.MAX_RETURNS_KEPT)
         self._eid = 0
         server = self
 
@@ -106,6 +115,7 @@ class PolicyServer:
         cmd = req.get("command")
         if cmd == "start_episode":
             with self._lock:
+                self._evict_stale_locked()
                 self._eid += 1
                 eid = f"ep{self._eid}"
                 self._episodes[eid] = _EpisodeState()
@@ -127,11 +137,18 @@ class PolicyServer:
                 bool(req.get("terminated", True)))
         raise ValueError(f"unknown command {cmd!r}")
 
+    def _evict_stale_locked(self):
+        now = time.monotonic()
+        for eid, ep in list(self._episodes.items()):
+            if now - ep.last_active > self.EPISODE_TTL_S:
+                del self._episodes[eid]  # abandoned simulator
+
     def _get_action(self, eid: str, obs: np.ndarray) -> Dict[str, Any]:
         import jax
 
         with self._lock:
             ep = self._episodes[eid]
+            ep.last_active = time.monotonic()
             self._rng, key = jax.random.split(self._rng)
             params = self.params
         batch_obs = obs[None, ...]
@@ -144,6 +161,13 @@ class PolicyServer:
             logp = np.zeros(1, np.float32)
         action = int(np.asarray(action)[0])
         with self._lock:
+            # The lock was released for inference: a concurrent
+            # end_episode may have finalized this episode — appending to
+            # the popped object would silently drop the step.
+            if self._episodes.get(eid) is not ep:
+                raise KeyError(
+                    f"episode {eid} ended while an action request was "
+                    f"in flight")
             if ep.obs is not None:
                 # The previous step's transition completes now that we
                 # know its successor observation and the rewards logged

@@ -42,9 +42,17 @@ from ray_tpu.train.trainer import (
     Result,
     TorchTrainer,
 )
+from ray_tpu.train.integrations import (
+    LightGBMTrainer,
+    TransformersTrainer,
+    XGBoostTrainer,
+    prepare_trainer,
+)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
 __all__ = [
+    "TransformersTrainer", "XGBoostTrainer", "LightGBMTrainer",
+    "prepare_trainer",
     "Backend", "BackendConfig", "JaxBackend", "JaxConfig", "BackendExecutor",
     "TrainingFailedError", "Checkpoint", "CheckpointManager",
     "BatchPredictor", "Predictor", "JaxPredictor",

@@ -1,0 +1,175 @@
+"""Framework-integration trainers: HF Transformers, XGBoost, LightGBM.
+
+Equivalent of the reference's wrapper-trainer families
+(`python/ray/train/huggingface/transformers/`, `train/xgboost/`,
+`train/lightgbm/`): thin, honest adapters that run the external
+framework's training loop inside this framework's worker group with
+metrics/checkpoints flowing through `train.session.report`.
+
+TPU-first note: these wrappers exist for migration parity — the
+TPU-native training path is JaxTrainer (the reference makes the same
+split: its TorchTrainer family is the GPU path, GBDT trainers are
+CPU-host work). XGBoost/LightGBM aren't bundled in this environment, so
+their trainers validate availability at construction with a clear
+error.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.backend import TorchConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+def _require(module: str, trainer: str):
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{trainer} requires the {module!r} package, which is not "
+            f"installed in this environment") from e
+
+
+class TransformersTrainer(DataParallelTrainer):
+    """Run a Hugging Face `transformers` training loop on the worker
+    group (reference `TransformersTrainer` /
+    `huggingface/transformers/_transformers_utils.py`).
+
+    The per-worker loop receives the config and builds its own
+    `transformers.Trainer` (or manual loop); under num_workers > 1 the
+    torch process group is formed (gloo on CPU hosts) before the loop
+    runs, so `transformers`' DDP integration sees a ready
+    `torch.distributed`. Use `prepare_trainer` to wire HF's reporting
+    into this framework's session.
+    """
+
+    def __init__(self, train_loop_per_worker, *, train_loop_config=None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config=None, run_config=None, datasets=None,
+                 resume_from_checkpoint=None):
+        _require("transformers", "TransformersTrainer")
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config, run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+
+def prepare_trainer(hf_trainer):
+    """Attach a callback to a `transformers.Trainer` that forwards its
+    logged metrics to `train.session.report` (reference
+    `RayTrainReportCallback`), so Tune/Train see HF progress natively."""
+    transformers = _require("transformers", "prepare_trainer")
+
+    from ray_tpu.train import session
+
+    class _ReportCallback(transformers.TrainerCallback):
+        def on_log(self, args, state, control, logs=None, **kwargs):
+            if logs:
+                metrics = {k: v for k, v in logs.items()
+                           if isinstance(v, (int, float))}
+                metrics.setdefault("step", state.global_step)
+                session.report(metrics)
+
+    hf_trainer.add_callback(_ReportCallback())
+    return hf_trainer
+
+
+class _GBDTTrainer(DataParallelTrainer):
+    """Shared shape for the boosting trainers: single worker (the GBDT
+    libraries multithread internally; the reference distributes via
+    xgboost-ray which has no equivalent here), params + train_fn."""
+
+    _module = ""
+    _name = ""
+
+    def __init__(self, *, params: Dict[str, Any],
+                 train_fn: Optional[Callable] = None,
+                 label_column: str = "label",
+                 num_boost_round: int = 10,
+                 datasets=None, scaling_config=None, run_config=None,
+                 resume_from_checkpoint=None):
+        _require(self._module, self._name)
+        self._params = dict(params)
+        self._label_column = label_column
+        self._num_boost_round = num_boost_round
+        self._user_train_fn = train_fn
+        super().__init__(
+            self._loop,
+            train_loop_config={},
+            backend_config=None,
+            scaling_config=scaling_config, run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+    def _loop(self, config):
+        raise NotImplementedError
+
+
+class XGBoostTrainer(_GBDTTrainer):
+    """Reference `train/xgboost/xgboost_trainer.py`: boosts on the
+    worker from the 'train' dataset shard, reporting eval metrics per
+    round through the session."""
+
+    _module = "xgboost"
+    _name = "XGBoostTrainer"
+
+    def _loop(self, config):
+        import numpy as np
+        import xgboost as xgb
+
+        from ray_tpu.train import session
+
+        ds = session.get_dataset_shard("train")
+        batches = list(ds.iter_batches()) if ds is not None else []
+        X = np.concatenate([
+            np.column_stack([v for k, v in b.items()
+                             if k != self._label_column])
+            for b in batches])
+        y = np.concatenate([b[self._label_column] for b in batches])
+        dtrain = xgb.DMatrix(X, label=y)
+        results: Dict[str, Any] = {}
+        booster = xgb.train(self._params, dtrain,
+                            num_boost_round=self._num_boost_round,
+                            evals=[(dtrain, "train")],
+                            evals_result=results)
+        final = {k: float(v[-1])
+                 for k, v in results.get("train", {}).items()}
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        session.report({"boost_rounds": self._num_boost_round, **final},
+                       checkpoint=Checkpoint.from_dict(
+                           {"model": booster.save_raw()}))
+
+
+class LightGBMTrainer(_GBDTTrainer):
+    """Reference `train/lightgbm/lightgbm_trainer.py`."""
+
+    _module = "lightgbm"
+    _name = "LightGBMTrainer"
+
+    def _loop(self, config):
+        import lightgbm as lgb
+        import numpy as np
+
+        from ray_tpu.train import session
+
+        ds = session.get_dataset_shard("train")
+        batches = list(ds.iter_batches()) if ds is not None else []
+        X = np.concatenate([
+            np.column_stack([v for k, v in b.items()
+                             if k != self._label_column])
+            for b in batches])
+        y = np.concatenate([b[self._label_column] for b in batches])
+        train_set = lgb.Dataset(X, label=y)
+        booster = lgb.train(self._params, train_set,
+                            num_boost_round=self._num_boost_round)
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        session.report({"boost_rounds": self._num_boost_round},
+                       checkpoint=Checkpoint.from_dict(
+                           {"model": booster.model_to_string()}))
