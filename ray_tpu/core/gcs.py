@@ -627,6 +627,20 @@ class GcsServer:
             except Exception:
                 pass
 
+    def handle_set_node_resource(self, conn: Connection,
+                                 data: Dict[str, Any]):
+        """Route a dynamic-resource update to the owning raylet
+        (reference `experimental/dynamic_resources.py` set_resource)."""
+        node_id = data["node_id"]
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or info.state != "ALIVE":
+                raise ValueError(f"node {node_id.hex()[:12]} is not alive")
+        return self._raylet(node_id).call(
+            "set_resource",
+            {"resource_name": data["resource_name"],
+             "capacity": data["capacity"]}, timeout=10)
+
     def handle_borrow_add(self, conn: Connection, data: Dict[str, Any]):
         """A non-owner process deserialized reference(s) to object(s):
         keep them alive past the owner's free until the borrower drops

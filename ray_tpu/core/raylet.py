@@ -86,6 +86,18 @@ class ResourceManager:
                     self.total.pop(r, None)
                     self.available.pop(r, None)
 
+    def set_total(self, name: str, capacity: float) -> None:
+        """Atomically set one resource's TOTAL capacity (dynamic custom
+        resources): the read-modify-write must not race concurrent
+        bundle add/remove or another set."""
+        with self._lock:
+            delta = capacity - self.total.get(name, 0.0)
+            self.total[name] = self.total.get(name, 0.0) + delta
+            self.available[name] = self.available.get(name, 0.0) + delta
+            if abs(self.total[name]) < 1e-9:
+                self.total.pop(name, None)
+                self.available.pop(name, None)
+
     def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
         with self._lock:
             return dict(self.total), dict(self.available)
@@ -1513,6 +1525,20 @@ class Raylet:
 
     def handle_contains_object(self, conn: Connection, data: Dict[str, Any]):
         return {"contains": self.store.contains(data["object_id"])}
+
+    def handle_set_resource(self, conn: Connection, data: Dict[str, Any]):
+        """Dynamic custom resources (reference
+        `experimental/dynamic_resources.py` -> raylet SetResource): set a
+        resource's TOTAL capacity on this node at runtime; queued tasks
+        waiting on it re-dispatch."""
+        name = data["resource_name"]
+        capacity = float(data["capacity"])
+        if name in ("CPU", "TPU", "memory"):
+            raise ValueError(
+                f"cannot dynamically override built-in resource {name!r}")
+        self.resources.set_total(name, capacity)
+        self._dispatch_event.set()
+        return {"total": capacity}
 
     # ------------------------------------------------- placement group 2PC
 

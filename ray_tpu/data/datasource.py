@@ -351,6 +351,8 @@ def write_webdataset_shard(rows: List[Dict[str, Any]], path: str) -> str:
                     continue
                 if isinstance(value, np.generic):
                     value = value.item()  # np scalar -> plain python
+                if isinstance(value, bool):
+                    value = int(value)  # .cls reads back via int()
                 if isinstance(value, (bytes, bytearray)):
                     raw = bytes(value)
                 elif isinstance(value, str):

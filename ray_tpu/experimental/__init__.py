@@ -1,5 +1,6 @@
 """Experimental utilities (reference `python/ray/experimental/`)."""
 
-from ray_tpu.experimental import internal_kv
+from ray_tpu.experimental import internal_kv, tqdm_ray
+from ray_tpu.experimental.dynamic_resources import set_resource
 
-__all__ = ["internal_kv"]
+__all__ = ["internal_kv", "set_resource", "tqdm_ray"]

@@ -127,6 +127,7 @@ class PolicyServer:
         if cmd == "log_returns":
             with self._lock:
                 ep = self._episodes[req["episode_id"]]
+                ep.last_active = time.monotonic()  # still alive: no TTL
                 ep.total += float(req["reward"])
                 ep.pending_reward += float(req["reward"])
             return {}

@@ -79,35 +79,87 @@ def prepare_trainer(hf_trainer):
     return hf_trainer
 
 
+def _gbdt_training_matrix(label_column: str):
+    """Worker side: the 'train' dataset shard as (X, y) arrays."""
+    import numpy as np
+
+    from ray_tpu.train import session
+
+    ds = session.get_dataset_shard("train")
+    batches = list(ds.iter_batches()) if ds is not None else []
+    if not batches:
+        raise ValueError(
+            "GBDT trainers require a non-empty 'train' dataset "
+            "(datasets={'train': ds})")
+    X = np.concatenate([
+        np.column_stack([v for k, v in b.items() if k != label_column])
+        for b in batches])
+    y = np.concatenate([b[label_column] for b in batches])
+    return X, y
+
+
+def _xgboost_loop(config):
+    import xgboost as xgb
+
+    from ray_tpu.train import session
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    X, y = _gbdt_training_matrix(config["label_column"])
+    dtrain = xgb.DMatrix(X, label=y)
+    results: Dict[str, Any] = {}
+    booster = xgb.train(config["params"], dtrain,
+                        num_boost_round=config["num_boost_round"],
+                        evals=[(dtrain, "train")], evals_result=results)
+    final = {k: float(v[-1]) for k, v in results.get("train", {}).items()}
+    session.report({"boost_rounds": config["num_boost_round"], **final},
+                   checkpoint=Checkpoint.from_dict(
+                       {"model": booster.save_raw()}))
+
+
+def _lightgbm_loop(config):
+    import lightgbm as lgb
+
+    from ray_tpu.train import session
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    X, y = _gbdt_training_matrix(config["label_column"])
+    booster = lgb.train(config["params"], lgb.Dataset(X, label=y),
+                        num_boost_round=config["num_boost_round"])
+    session.report({"boost_rounds": config["num_boost_round"]},
+                   checkpoint=Checkpoint.from_dict(
+                       {"model": booster.model_to_string()}))
+
+
 class _GBDTTrainer(DataParallelTrainer):
     """Shared shape for the boosting trainers: single worker (the GBDT
     libraries multithread internally; the reference distributes via
-    xgboost-ray which has no equivalent here), params + train_fn."""
+    xgboost-ray, which has no equivalent here). The train loop is a
+    module-level function and every knob rides train_loop_config, so
+    workers never receive a pickled trainer object (with the full
+    driver-side datasets inside)."""
 
     _module = ""
     _name = ""
+    _loop_fn: Callable = None
 
     def __init__(self, *, params: Dict[str, Any],
-                 train_fn: Optional[Callable] = None,
                  label_column: str = "label",
                  num_boost_round: int = 10,
                  datasets=None, scaling_config=None, run_config=None,
                  resume_from_checkpoint=None):
         _require(self._module, self._name)
-        self._params = dict(params)
-        self._label_column = label_column
-        self._num_boost_round = num_boost_round
-        self._user_train_fn = train_fn
+        if not datasets or "train" not in datasets:
+            raise ValueError(
+                f"{self._name} requires datasets={{'train': ...}}")
         super().__init__(
-            self._loop,
-            train_loop_config={},
+            type(self)._loop_fn,
+            train_loop_config={"params": dict(params),
+                               "label_column": label_column,
+                               "num_boost_round": num_boost_round},
             backend_config=None,
             scaling_config=scaling_config, run_config=run_config,
             datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint)
-
-    def _loop(self, config):
-        raise NotImplementedError
 
 
 class XGBoostTrainer(_GBDTTrainer):
@@ -117,33 +169,7 @@ class XGBoostTrainer(_GBDTTrainer):
 
     _module = "xgboost"
     _name = "XGBoostTrainer"
-
-    def _loop(self, config):
-        import numpy as np
-        import xgboost as xgb
-
-        from ray_tpu.train import session
-
-        ds = session.get_dataset_shard("train")
-        batches = list(ds.iter_batches()) if ds is not None else []
-        X = np.concatenate([
-            np.column_stack([v for k, v in b.items()
-                             if k != self._label_column])
-            for b in batches])
-        y = np.concatenate([b[self._label_column] for b in batches])
-        dtrain = xgb.DMatrix(X, label=y)
-        results: Dict[str, Any] = {}
-        booster = xgb.train(self._params, dtrain,
-                            num_boost_round=self._num_boost_round,
-                            evals=[(dtrain, "train")],
-                            evals_result=results)
-        final = {k: float(v[-1])
-                 for k, v in results.get("train", {}).items()}
-        from ray_tpu.train.checkpoint import Checkpoint
-
-        session.report({"boost_rounds": self._num_boost_round, **final},
-                       checkpoint=Checkpoint.from_dict(
-                           {"model": booster.save_raw()}))
+    _loop_fn = staticmethod(_xgboost_loop)
 
 
 class LightGBMTrainer(_GBDTTrainer):
@@ -151,25 +177,4 @@ class LightGBMTrainer(_GBDTTrainer):
 
     _module = "lightgbm"
     _name = "LightGBMTrainer"
-
-    def _loop(self, config):
-        import lightgbm as lgb
-        import numpy as np
-
-        from ray_tpu.train import session
-
-        ds = session.get_dataset_shard("train")
-        batches = list(ds.iter_batches()) if ds is not None else []
-        X = np.concatenate([
-            np.column_stack([v for k, v in b.items()
-                             if k != self._label_column])
-            for b in batches])
-        y = np.concatenate([b[self._label_column] for b in batches])
-        train_set = lgb.Dataset(X, label=y)
-        booster = lgb.train(self._params, train_set,
-                            num_boost_round=self._num_boost_round)
-        from ray_tpu.train.checkpoint import Checkpoint
-
-        session.report({"boost_rounds": self._num_boost_round},
-                       checkpoint=Checkpoint.from_dict(
-                           {"model": booster.model_to_string()}))
+    _loop_fn = staticmethod(_lightgbm_loop)

@@ -69,7 +69,7 @@ def test_partition_filter_prunes_files(ray_start_shared, tmp_path):
 def test_webdataset_round_trip(ray_start_shared, tmp_path):
     shard_dir = str(tmp_path / "wds")
     rows = [{"__key__": f"{i:04d}", "txt": f"hello {i}", "cls": i,
-             "json": {"idx": i}} for i in range(10)]
+             "json": {"idx": i}, "flag": bool(i % 2)} for i in range(10)]
     ds = data.from_items(rows, parallelism=2)
     shards = ds.write_webdataset(shard_dir)
     assert len(shards) == 2
@@ -81,6 +81,7 @@ def test_webdataset_round_trip(ray_start_shared, tmp_path):
     assert got[3]["txt"] == "hello 3"
     assert got[3]["cls"] == 3
     assert got[3]["json"] == {"idx": 3}
+    assert got[3]["flag"] == b"1"  # bools write as ints (cls-decodable)
 
 
 def test_read_mongo_gated():

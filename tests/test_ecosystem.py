@@ -120,3 +120,54 @@ def test_pool_bounds_concurrency(ray_start_shared):
     for t in {s for span in spans for s in span}:
         overlap = sum(1 for a, b in spans if a < t < b)
         assert overlap <= 2, f"{overlap} chunks ran concurrently"
+
+
+def test_dynamic_resources(ray_start_regular):
+    """set_resource adds capacity at runtime and queued tasks dispatch
+    (reference experimental/dynamic_resources.py)."""
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu.experimental import set_resource
+
+    @ray_tpu.remote(resources={"widget": 1})
+    def needs_widget():
+        return "ran"
+
+    ref = needs_widget.remote()
+    done, pending = ray_tpu.wait([ref], timeout=1.0)
+    assert not done, "task ran without the resource existing"
+    set_resource("widget", 2)
+    assert ray_tpu.get(ref, timeout=30) == "ran"
+    # Capacity shows in the cluster view and can be removed again.
+    time.sleep(1.5)  # heartbeat-carried
+    total = {r: v for n in ray_tpu.nodes() for r, v in n["Resources"].items()}
+    assert total.get("widget") == 2
+    set_resource("widget", 0)
+    import pytest
+
+    with pytest.raises(Exception, match="built-in"):
+        set_resource("CPU", 64)
+
+
+def test_tqdm_ray_in_worker(ray_start_regular, capsys):
+    import ray_tpu
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work():
+        out = 0
+        for i in tqdm_ray.tqdm(range(50), desc="crunch",
+                               flush_interval_s=0.0):
+            out += i
+        return out
+
+    assert ray_tpu.get(work.remote()) == sum(range(50))
+    # Local (driver-side) use prints rate-limited lines.
+    bar = tqdm_ray.tqdm(total=10, desc="local", flush_interval_s=0.0)
+    for _ in range(10):
+        bar.update()
+    bar.close()
+    captured = capsys.readouterr()
+    assert "local" in captured.out and "10/10" in captured.out
