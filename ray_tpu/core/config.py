@@ -46,7 +46,9 @@ _flag("runtime_env_eviction_grace_s", float, 300.0,
       "task specs may still reference it)")
 _flag("health_check_period_ms", int, 2000, "GCS node health check period")
 _flag("health_check_failure_threshold", int, 5, "Missed health checks before a node is marked dead")
-_flag("worker_lease_timeout_ms", int, 30000, "Max time waiting for a worker lease")
+_flag("worker_lease_timeout_ms", int, 60000,
+      "Max time waiting for a worker lease (covers a cold worker spawn: "
+      "a fresh interpreter importing jax can take >30s on a loaded host)")
 _flag("worker_pool_prestart", int, 0, "Number of workers to prestart per node")
 _flag("worker_idle_timeout_ms", int, 60000, "Idle worker reap timeout")
 _flag("max_pending_lease_requests", int, 10, "In-flight lease requests per scheduling key")

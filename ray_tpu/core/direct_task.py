@@ -137,10 +137,19 @@ class DirectTaskTransport:
         with self._lock:
             pending = self._pending.get(key)
             if pending:
-                for lease in self._leases.get(key, ()):
+                leases = self._leases.get(key, ())
+                # Adaptive depth: steady-state stays shallow (latency,
+                # work stealing across leases), but a submission burst
+                # deepens the per-worker pipeline so the batch framing
+                # actually amortizes — depth 2 would cap batches at 2.
+                n_leases = max(1, len(leases))
+                depth = min(16, max(pipeline,
+                                    (len(pending) + n_leases - 1)
+                                    // n_leases))
+                for lease in leases:
                     if lease.closed or lease.client is None:
                         continue
-                    while pending and len(lease.inflight) < pipeline:
+                    while pending and len(lease.inflight) < depth:
                         spec = pending.popleft()
                         lease.inflight.add(spec.task_id.binary())
                         self._task_lease[spec.task_id.binary()] = lease
@@ -159,8 +168,16 @@ class DirectTaskTransport:
                 for r in key_reqs:
                     self._inflight_reqs.pop(r, None)
                     self._req_spec.pop(r, None)
+        # One framed message per lease per pump: submission bursts would
+        # otherwise pay per-task framing + a syscall pair per spec.
+        grouped: List[Tuple[_Lease, List[TaskSpec]]] = []
         for lease, spec in to_send:
-            self._send(lease, spec)
+            if grouped and grouped[-1][0] is lease:
+                grouped[-1][1].append(spec)
+            else:
+                grouped.append((lease, [spec]))
+        for lease, specs in grouped:
+            self._send_batch(lease, specs)
         for _ in range(max(0, want_requests)):
             self._request_lease(key, template)
         if cancel_reqs:
@@ -179,17 +196,23 @@ class DirectTaskTransport:
                 except Exception:  # noqa: BLE001 — raylet gone: queue died
                     pass
 
-    def _send(self, lease: _Lease, spec: TaskSpec):
-        def cb(env, _payload, spec=spec, lease=lease):
+    def _send_batch(self, lease: _Lease, specs: List[TaskSpec]):
+        def cb(env, _payload, specs=specs, lease=lease):
             if env.get("_lost") or env.get("e"):
                 # Connection-level failures funnel through _on_worker_lost;
                 # a remote handler error (shouldn't happen — the handler
-                # only enqueues) fails the task.
+                # only enqueues) fails the task(s).
                 if env.get("e"):
-                    self._fail_inflight(lease, spec, env["e"])
+                    for spec in specs:
+                        self._fail_inflight(lease, spec, env["e"])
 
         try:
-            lease.client.call_async("direct_call", {"spec": spec}, cb)
+            if len(specs) == 1:
+                lease.client.call_async("direct_call", {"spec": specs[0]},
+                                        cb)
+            else:
+                lease.client.call_async("direct_call_batch",
+                                        {"specs": specs}, cb)
         except ConnectionLost:
             self._on_worker_lost(lease)
 

@@ -94,7 +94,11 @@ class ResourceManager:
             delta = capacity - self.total.get(name, 0.0)
             self.total[name] = self.total.get(name, 0.0) + delta
             self.available[name] = self.available.get(name, 0.0) + delta
-            if abs(self.total[name]) < 1e-9:
+            # Delete only when nothing is outstanding: a running task's
+            # debt (available < total) must survive a zeroing so its
+            # eventual release() can't mint capacity from nowhere.
+            if abs(self.total[name]) < 1e-9 \
+                    and abs(self.available[name]) < 1e-9:
                 self.total.pop(name, None)
                 self.available.pop(name, None)
 
@@ -1533,9 +1537,13 @@ class Raylet:
         waiting on it re-dispatch."""
         name = data["resource_name"]
         capacity = float(data["capacity"])
-        if name in ("CPU", "TPU", "memory"):
+        if name in ("CPU", "TPU", "memory", "object_store_memory") \
+                or name.startswith("node:"):
             raise ValueError(
                 f"cannot dynamically override built-in resource {name!r}")
+        if capacity < 0:
+            raise ValueError(
+                f"resource capacity must be >= 0, got {capacity}")
         self.resources.set_total(name, capacity)
         self._dispatch_event.set()
         return {"total": capacity}
