@@ -66,6 +66,9 @@ _flag("rpc_connect_timeout_s", float, 10.0, "TCP connect timeout for internal RP
 _flag("rpc_call_timeout_s", float, 120.0, "Default RPC call timeout")
 _flag("direct_task_enabled", _parse_bool, True,
       "Lease-cached direct-to-worker submission for eligible normal tasks")
+_flag("direct_burst_depth_max", int, 16,
+      "Cap on the adaptive per-worker pipeline deepening during "
+      "submission bursts (set to direct_pipeline_depth to disable)")
 _flag("direct_pipeline_depth", int, 2,
       "Task specs in flight per leased worker (keeps the worker busy while "
       "a result is on the wire)")

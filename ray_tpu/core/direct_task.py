@@ -65,6 +65,9 @@ class DirectTaskTransport:
         self._rt = runtime
         self._lock = threading.RLock()
         self._pending: Dict[Tuple, deque] = defaultdict(deque)
+        # Most recent spec per key: the lease-request resource template
+        # when a burst was fully absorbed into existing pipelines.
+        self._last_spec: Dict[Tuple, TaskSpec] = {}
         self._leases: Dict[Tuple, List[_Lease]] = defaultdict(list)
         self._inflight_reqs: Dict[bytes, Tuple] = {}  # req_id -> key
         self._req_spec: Dict[bytes, TaskSpec] = {}    # req_id -> pseudo spec
@@ -120,6 +123,7 @@ class DirectTaskTransport:
             if self._closed:
                 raise ConnectionLost("direct transport closed")
             self._pending[key].append(spec)
+            self._last_spec[key] = spec  # lease-request template
             self._ensure_reaper()
         self._pump(key)
 
@@ -136,6 +140,7 @@ class DirectTaskTransport:
         cancel_reqs: List[bytes] = []
         with self._lock:
             pending = self._pending.get(key)
+            backlog = len(pending) if pending else 0
             if pending:
                 leases = self._leases.get(key, ())
                 # Adaptive depth: steady-state stays shallow (latency,
@@ -143,9 +148,9 @@ class DirectTaskTransport:
                 # deepens the per-worker pipeline so the batch framing
                 # actually amortizes — depth 2 would cap batches at 2.
                 n_leases = max(1, len(leases))
-                depth = min(16, max(pipeline,
-                                    (len(pending) + n_leases - 1)
-                                    // n_leases))
+                depth = min(GLOBAL_CONFIG.direct_burst_depth_max,
+                            max(pipeline,
+                                (backlog + n_leases - 1) // n_leases))
                 for lease in leases:
                     if lease.closed or lease.client is None:
                         continue
@@ -156,12 +161,22 @@ class DirectTaskTransport:
                         lease.last_used = time.monotonic()
                         to_send.append((lease, spec))
             key_reqs = [r for r, k in self._inflight_reqs.items() if k == key]
-            if pending:
+            if backlog:
+                # Scale-out sizes from the ORIGINAL backlog at the
+                # steady-state pipeline depth: a burst the deepened
+                # pipeline absorbed must still fan out to more workers —
+                # those queued specs sit behind serial execution
+                # otherwise (and must never CANCEL requests).
                 n_leases = len(self._leases.get(key, ()))
                 cap = GLOBAL_CONFIG.direct_max_leases
-                want_requests = min(len(pending),
-                                    cap - len(key_reqs) - n_leases)
-                template = pending[0]
+                desired = -(-backlog // max(1, pipeline))  # ceil
+                want_requests = min(
+                    max(len(pending), desired - n_leases - len(key_reqs)),
+                    cap - len(key_reqs) - n_leases)
+                template = (pending[0] if pending
+                            else self._last_spec.get(key))
+                if template is None:
+                    want_requests = 0
             elif key_reqs:
                 # Demand drained: withdraw every outstanding request.
                 cancel_reqs = key_reqs

@@ -1541,9 +1541,15 @@ class Raylet:
                 or name.startswith("node:"):
             raise ValueError(
                 f"cannot dynamically override built-in resource {name!r}")
-        if capacity < 0:
+        import math
+
+        if capacity < 0 or not math.isfinite(capacity):
+            # NaN would poison the ledger permanently: the abs()<eps
+            # delete guard and every feasibility comparison are False
+            # against NaN.
             raise ValueError(
-                f"resource capacity must be >= 0, got {capacity}")
+                f"resource capacity must be finite and >= 0, "
+                f"got {capacity}")
         self.resources.set_total(name, capacity)
         self._dispatch_event.set()
         return {"total": capacity}

@@ -196,7 +196,7 @@ def _ensure_proxy(host: str, port: int) -> int:
             name=_PROXY_NAME, namespace=SERVE_NAMESPACE,
             lifetime="detached", max_concurrency=256, num_cpus=0.1,
         ).remote(host, port)
-    return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+    return ray_tpu.get(proxy.ready.remote(), timeout=60.0)
 
 
 def _graph_order(root: Application) -> list:

@@ -195,7 +195,10 @@ def test_handle_composition_between_deployments(serve_cluster):
 def test_gpt2_sampler_deployment_batches(serve_cluster):
     from ray_tpu.serve.examples import GPT2Sampler
 
-    handle = serve.run(GPT2Sampler.bind("tiny", 64, 4))
+    # Generous deploy budget: replica __init__ jit-compiles a tiny GPT-2,
+    # which can exceed the 60s default when the host is loaded (this test
+    # flaked twice in contended full-suite runs).
+    handle = serve.run(GPT2Sampler.bind("tiny", 64, 4), timeout_s=180.0)
     refs = [handle.remote({"ids": [1, 2, 3 + i], "max_new_tokens": 4})
             for i in range(8)]
     outs = ray_tpu.get(refs)
