@@ -65,9 +65,11 @@ class DirectTaskTransport:
         self._rt = runtime
         self._lock = threading.RLock()
         self._pending: Dict[Tuple, deque] = defaultdict(deque)
-        # Most recent spec per key: the lease-request resource template
-        # when a burst was fully absorbed into existing pipelines.
-        self._last_spec: Dict[Tuple, TaskSpec] = {}
+        # (resources, runtime_env) of the most recent spec per key: the
+        # lease-request template when the local queue is empty but deep
+        # pipelines still warrant scale-out. Deliberately NOT the full
+        # spec — that would pin function blobs + inline args forever.
+        self._last_template: Dict[Tuple, Tuple] = {}
         self._leases: Dict[Tuple, List[_Lease]] = defaultdict(list)
         self._inflight_reqs: Dict[bytes, Tuple] = {}  # req_id -> key
         self._req_spec: Dict[bytes, TaskSpec] = {}    # req_id -> pseudo spec
@@ -123,7 +125,8 @@ class DirectTaskTransport:
             if self._closed:
                 raise ConnectionLost("direct transport closed")
             self._pending[key].append(spec)
-            self._last_spec[key] = spec  # lease-request template
+            self._last_template[key] = (dict(spec.resources),
+                                        spec.runtime_env)
             self._ensure_reaper()
         self._pump(key)
 
@@ -141,40 +144,64 @@ class DirectTaskTransport:
         with self._lock:
             pending = self._pending.get(key)
             backlog = len(pending) if pending else 0
+            key_reqs = [r for r, k in self._inflight_reqs.items()
+                        if k == key]
             if pending:
-                leases = self._leases.get(key, ())
-                # Adaptive depth: steady-state stays shallow (latency,
-                # work stealing across leases), but a submission burst
-                # deepens the per-worker pipeline so the batch framing
-                # actually amortizes — depth 2 would cap batches at 2.
-                n_leases = max(1, len(leases))
-                depth = min(GLOBAL_CONFIG.direct_burst_depth_max,
-                            max(pipeline,
-                                (backlog + n_leases - 1) // n_leases))
+                leases = [l for l in self._leases.get(key, ())
+                          if not l.closed and l.client is not None]
+                n_leases = len(leases)
+                cap = GLOBAL_CONFIG.direct_max_leases
+                # Phase 1 — steady state: fill each lease to the base
+                # pipeline depth (latency + cross-lease balance).
                 for lease in leases:
-                    if lease.closed or lease.client is None:
-                        continue
-                    while pending and len(lease.inflight) < depth:
+                    while pending and len(lease.inflight) < pipeline:
                         spec = pending.popleft()
                         lease.inflight.add(spec.task_id.binary())
                         self._task_lease[spec.task_id.binary()] = lease
                         lease.last_used = time.monotonic()
                         to_send.append((lease, spec))
-            key_reqs = [r for r, k in self._inflight_reqs.items() if k == key]
+                # Phase 2 — burst deepening, with a RESERVE: keep enough
+                # specs pending to seed the leases still obtainable
+                # (outstanding requests + headroom to the cap). Absorbed
+                # specs can't migrate off a worker's queue, so
+                # absorbing everything would both serialize the burst
+                # and let the next pump read "demand drained" and
+                # cancel the very scale-out requests fanning it out.
+                if pending:
+                    obtainable = max(0, cap - n_leases)
+                    reserve = min(len(pending), obtainable * pipeline)
+                    absorb = len(pending) - reserve
+                    if absorb > 0 and n_leases:
+                        depth = min(
+                            GLOBAL_CONFIG.direct_burst_depth_max,
+                            max(pipeline,
+                                pipeline + (absorb + n_leases - 1)
+                                // n_leases))
+                        for lease in leases:
+                            while pending and absorb > 0 \
+                                    and len(lease.inflight) < depth:
+                                spec = pending.popleft()
+                                absorb -= 1
+                                lease.inflight.add(spec.task_id.binary())
+                                self._task_lease[spec.task_id.binary()] = \
+                                    lease
+                                lease.last_used = time.monotonic()
+                                to_send.append((lease, spec))
             if backlog:
                 # Scale-out sizes from the ORIGINAL backlog at the
-                # steady-state pipeline depth: a burst the deepened
-                # pipeline absorbed must still fan out to more workers —
-                # those queued specs sit behind serial execution
-                # otherwise (and must never CANCEL requests).
+                # steady-state pipeline depth.
                 n_leases = len(self._leases.get(key, ()))
                 cap = GLOBAL_CONFIG.direct_max_leases
                 desired = -(-backlog // max(1, pipeline))  # ceil
                 want_requests = min(
-                    max(len(pending), desired - n_leases - len(key_reqs)),
+                    max(len(pending) if pending else 0,
+                        desired - n_leases - len(key_reqs)),
                     cap - len(key_reqs) - n_leases)
-                template = (pending[0] if pending
-                            else self._last_spec.get(key))
+                if pending:
+                    template = (dict(pending[0].resources),
+                                pending[0].runtime_env)
+                else:
+                    template = self._last_template.get(key)
                 if template is None:
                     want_requests = 0
             elif key_reqs:
@@ -194,7 +221,7 @@ class DirectTaskTransport:
         for lease, specs in grouped:
             self._send_batch(lease, specs)
         for _ in range(max(0, want_requests)):
-            self._request_lease(key, template)
+            self._request_lease(key, *template)
         if cancel_reqs:
             by_addr: Dict[str, List[bytes]] = defaultdict(list)
             with self._lock:
@@ -239,15 +266,16 @@ class DirectTaskTransport:
 
     # ---------------------------------------------------------------- leases
 
-    def _request_lease(self, key, template: TaskSpec):
+    def _request_lease(self, key, resources: Dict[str, float],
+                       runtime_env: Optional[Dict[str, Any]]):
         pseudo = TaskSpec(
             task_id=TaskID.for_task(self._rt.job_id),
             job_id=self._rt.job_id,
             name=LEASE_SPEC_NAME,
             function_id=None,
             function_blob=None,
-            resources=dict(template.resources),
-            runtime_env=template.runtime_env,
+            resources=dict(resources),
+            runtime_env=runtime_env,
         )
         req_id = pseudo.task_id.binary()
         with self._lock:

@@ -20,6 +20,7 @@ Differences from the reference, deliberate for the TPU design:
 from __future__ import annotations
 
 import logging
+import math
 import os
 import subprocess
 import sys
@@ -1541,8 +1542,6 @@ class Raylet:
                 or name.startswith("node:"):
             raise ValueError(
                 f"cannot dynamically override built-in resource {name!r}")
-        import math
-
         if capacity < 0 or not math.isfinite(capacity):
             # NaN would poison the ledger permanently: the abs()<eps
             # delete guard and every feasibility comparison are False

@@ -27,6 +27,7 @@ from ray_tpu.rllib.impala import (
     IMPALALearner,
     vtrace_returns,
 )
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
 from ray_tpu.rllib.external import PolicyClient, PolicyServer
 from ray_tpu.rllib.learner import Learner, LearnerGroup

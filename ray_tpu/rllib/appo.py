@@ -1,0 +1,175 @@
+"""APPO: asynchronous PPO on the IMPALA machinery.
+
+Equivalent of the reference's `rllib/algorithms/appo/appo.py` (APPOConfig
+extends ImpalaConfig; `appo_torch_policy.py` loss): IMPALA's async
+sampling + V-trace off-policy correction, with PPO's clipped surrogate
+computed against the behavior policy and a slow-moving TARGET policy
+network providing the V-trace/KL anchor — the piece that keeps the
+surrogate stable when rollouts lag many updates behind.
+
+TPU-first: like the other learners, one jitted update fused by XLA; the
+target params ride as an explicit jit argument (replicated under dp
+sharding) so syncing the target never retraces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+    vtrace_returns,
+)
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.4            # reference APPOConfig default
+    use_kl_loss: bool = False
+    kl_coeff: float = 1.0
+    # Learner updates between target-network syncs (reference
+    # target_update_frequency).
+    target_update_frequency: int = 1
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPOLearner(IMPALALearner):
+    """V-trace advantages + PPO clip, anchored on a target policy."""
+
+    def __init__(self, module, config, seed: int = 0, **kw):
+        import jax
+
+        super().__init__(module, config, seed=seed, **kw)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._updates_since_sync = 0
+        if self.num_devices > 1:
+            rep = self._rep_sharding
+            self.target_params = jax.device_put(self.target_params, rep)
+            self._update_appo = jax.jit(
+                self._update_appo_impl,
+                in_shardings=(rep, rep, rep, self._batch_sharding),
+                out_shardings=(rep, rep, rep))
+        else:
+            self._update_appo = jax.jit(self._update_appo_impl)
+
+    # The base sharded `update` path jits compute_loss(params, batch);
+    # APPO's loss needs the target params as a separately-replicated jit
+    # argument, so it owns its update fn and overrides update().
+
+    def _appo_loss(self, params, target_params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        T, B = batch[sb.ACTIONS].shape
+        obs_ext = jnp.concatenate([batch[sb.OBS], batch["last_obs"]],
+                                  axis=0)
+        flat = {
+            "obs": obs_ext.reshape(((T + 1) * B,) + obs_ext.shape[2:]),
+            "actions": jnp.concatenate(
+                [batch[sb.ACTIONS],
+                 jnp.zeros((1, B), batch[sb.ACTIONS].dtype)],
+                axis=0).reshape((T + 1) * B),
+        }
+        out = self.module.forward_train(params, flat)
+        cur_logp = out["logp"].reshape(T + 1, B)[:T]
+        vf_ext = out["vf"].reshape(T + 1, B)
+        vf = vf_ext[:T]
+        entropy = out["entropy"].reshape(T + 1, B)[:T]
+
+        # Target-policy log-probs anchor the V-trace correction and the
+        # optional KL (reference: vtrace uses the target model's action
+        # distribution; appo_torch_policy.py).
+        tgt_out = self.module.forward_train(target_params, flat)
+        tgt_logp = jax.lax.stop_gradient(
+            tgt_out["logp"].reshape(T + 1, B)[:T])
+
+        next_vf = jnp.where(batch[sb.DONES] > 0,
+                            batch["behavior_next_vf"], vf_ext[1:])
+        vs, pg_adv = vtrace_returns(
+            behavior_logp=batch[sb.LOGP],
+            target_logp=tgt_logp,
+            rewards=batch[sb.REWARDS],
+            terminateds=batch["terminateds"],
+            dones=batch[sb.DONES],
+            values=vf,
+            next_values=jax.lax.stop_gradient(next_vf),
+            gamma=cfg.gamma,
+            clip_rho_threshold=cfg.vtrace_clip_rho_threshold,
+            clip_c_threshold=cfg.vtrace_clip_c_threshold,
+        )
+        if cfg.standardize_advantages:
+            pg_adv = (pg_adv - jnp.mean(pg_adv)) / (jnp.std(pg_adv) + 1e-8)
+
+        # PPO clip against the BEHAVIOR policy's logp (what generated
+        # the samples), with V-trace-corrected advantages.
+        ratio = jnp.exp(cur_logp - batch[sb.LOGP])
+        surrogate = jnp.minimum(
+            pg_adv * ratio,
+            pg_adv * jnp.clip(ratio, 1 - cfg.clip_param,
+                              1 + cfg.clip_param))
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean((vs - vf) ** 2)
+        mean_entropy = jnp.mean(entropy)
+        loss = policy_loss + cfg.vf_loss_coeff * vf_loss \
+            - cfg.entropy_coeff * mean_entropy
+        kl = jnp.mean(tgt_logp - cur_logp)
+        if cfg.use_kl_loss:
+            loss = loss + cfg.kl_coeff * kl
+        return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                      "entropy": mean_entropy, "kl": kl,
+                      "mean_ratio": jnp.mean(ratio)}
+
+    def _update_appo_impl(self, params, target_params, opt_state, batch):
+        import jax
+        import optax
+
+        (loss, metrics), grads = jax.value_and_grad(
+            self._appo_loss, has_aux=True)(params, target_params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        prepared = self._prepare_batch(batch, axis=self.dp_axis)
+        if prepared is None:
+            return {}
+        self.params, self.opt_state, metrics = self._update_appo(
+            self.params, self.target_params, self.opt_state, prepared)
+        self._updates_since_sync += 1
+        if self._updates_since_sync >= self.config.target_update_frequency:
+            self.sync_target()
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self):
+        import jax
+
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._updates_since_sync = 0
+
+    def get_state(self):
+        import jax
+
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        return state
+
+    def set_state(self, state):
+        super().set_state(state)
+        self.target_params = state.get("target_params", self.params)
+
+
+class APPO(IMPALA):
+    """Reference `appo.py`: the IMPALA training loop, APPO learner."""
+
+    learner_cls = APPOLearner

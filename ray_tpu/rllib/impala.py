@@ -212,6 +212,9 @@ class IMPALALearner(Learner):
 class IMPALA:
     """Async-sampling algorithm (reference `impala.py:677` training_step)."""
 
+    # Subclasses on the same async machinery (APPO) swap the learner.
+    learner_cls = None  # default: IMPALALearner
+
     def __init__(self, config: IMPALAConfig):
         import ray_tpu
 
@@ -225,8 +228,9 @@ class IMPALA:
             connectors=config.connectors)
         module = build_module_from_env_spec(self.workers.env_spec(),
                                             hidden=config.hidden)
+        learner_cls = type(self).learner_cls or IMPALALearner
         self.learner_group = LearnerGroup(
-            lambda **kw: IMPALALearner(module, config, seed=config.seed, **kw),
+            lambda **kw: learner_cls(module, config, seed=config.seed, **kw),
             mode=config.learner_mode,
             resources=config.learner_resources,
             num_learners=config.num_learners)

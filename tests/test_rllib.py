@@ -343,3 +343,74 @@ def test_impala_survives_worker_kill(ray_start_shared):
         assert np.isfinite(m["total_loss"])
     finally:
         algo.stop()
+
+
+def test_appo_trains_cartpole(ray_start_shared):
+    """APPO (reference rllib/algorithms/appo): IMPALA machinery + PPO
+    clip + target policy; a couple of iterations must run and learn
+    finite losses with target syncs."""
+    import numpy as np
+
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                      rollout_fragment_length=16)
+            .training(updates_per_iteration=2, fragments_per_batch=2,
+                      clip_param=0.3, use_kl_loss=True, kl_coeff=0.5)
+            ).build()
+    try:
+        for _ in range(2):
+            res = algo.train()
+        assert np.isfinite(res["total_loss"])
+        assert "kl" in res and "mean_ratio" in res
+        # checkpoint round-trips target params
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            algo.save(d)
+            algo.restore(d)
+        res = algo.train()
+        assert np.isfinite(res["total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_appo_learner_dp_parity():
+    """APPO's target-anchored update matches single-device under dp=4."""
+    import numpy as np
+
+    from ray_tpu.rllib import sample_batch as sb
+    from ray_tpu.rllib.appo import APPOConfig, APPOLearner
+    from ray_tpu.rllib.rl_module import DiscretePolicyModule, SpecDict
+
+    T, B, obs = 5, 8, 4
+    rng = np.random.default_rng(3)
+    batch = {
+        sb.OBS: rng.standard_normal((T, B, obs)).astype(np.float32),
+        "last_obs": rng.standard_normal((1, B, obs)).astype(np.float32),
+        sb.ACTIONS: rng.integers(0, 2, (T, B)).astype(np.int32),
+        sb.LOGP: np.log(np.full((T, B), 0.5, np.float32)),
+        sb.REWARDS: rng.standard_normal((T, B)).astype(np.float32),
+        sb.DONES: (rng.random((T, B)) < 0.1).astype(np.float32),
+        "terminateds": np.zeros((T, B), np.float32),
+        "behavior_next_vf": rng.standard_normal((T, B)).astype(np.float32),
+    }
+    cfg = APPOConfig(target_update_frequency=2)
+
+    def make(n):
+        module = DiscretePolicyModule(SpecDict(obs, 2), hidden=(16, 16))
+        return APPOLearner(module, cfg, seed=0, num_devices=n)
+
+    import jax
+
+    l1, l4 = make(1), make(4)
+    for _ in range(3):  # crosses a target sync boundary
+        m1, m4 = l1.update(batch), l4.update(batch)
+    assert abs(m1["total_loss"] - m4["total_loss"]) < 1e-4
+    f1 = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree_util.tree_leaves(l1.get_weights())])
+    f4 = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree_util.tree_leaves(l4.get_weights())])
+    np.testing.assert_allclose(f1, f4, rtol=1e-4, atol=1e-5)
