@@ -20,12 +20,7 @@ from typing import Dict
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
-from ray_tpu.rllib.impala import (
-    IMPALA,
-    IMPALAConfig,
-    IMPALALearner,
-    vtrace_returns,
-)
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 
 
 @dataclass
@@ -69,45 +64,18 @@ class APPOLearner(IMPALALearner):
         import jax.numpy as jnp
 
         cfg = self.config
-        T, B = batch[sb.ACTIONS].shape
-        obs_ext = jnp.concatenate([batch[sb.OBS], batch["last_obs"]],
-                                  axis=0)
-        flat = {
-            "obs": obs_ext.reshape(((T + 1) * B,) + obs_ext.shape[2:]),
-            "actions": jnp.concatenate(
-                [batch[sb.ACTIONS],
-                 jnp.zeros((1, B), batch[sb.ACTIONS].dtype)],
-                axis=0).reshape((T + 1) * B),
-        }
-        out = self.module.forward_train(params, flat)
-        cur_logp = out["logp"].reshape(T + 1, B)[:T]
-        vf_ext = out["vf"].reshape(T + 1, B)
-        vf = vf_ext[:T]
-        entropy = out["entropy"].reshape(T + 1, B)[:T]
+        heads = self._fragment_forward(params, batch)
+        cur_logp, vf, entropy = heads["logp"], heads["vf"], heads["entropy"]
 
-        # Target-policy log-probs anchor the V-trace correction and the
-        # optional KL (reference: vtrace uses the target model's action
+        # Target-policy heads anchor the V-trace correction and the KL
+        # (reference: vtrace runs on the target model's action
         # distribution; appo_torch_policy.py).
-        tgt_out = self.module.forward_train(target_params, flat)
-        tgt_logp = jax.lax.stop_gradient(
-            tgt_out["logp"].reshape(T + 1, B)[:T])
+        tgt_heads = jax.lax.stop_gradient(
+            self._fragment_forward(target_params, batch))
+        tgt_logp = tgt_heads["logp"]
 
-        next_vf = jnp.where(batch[sb.DONES] > 0,
-                            batch["behavior_next_vf"], vf_ext[1:])
-        vs, pg_adv = vtrace_returns(
-            behavior_logp=batch[sb.LOGP],
-            target_logp=tgt_logp,
-            rewards=batch[sb.REWARDS],
-            terminateds=batch["terminateds"],
-            dones=batch[sb.DONES],
-            values=vf,
-            next_values=jax.lax.stop_gradient(next_vf),
-            gamma=cfg.gamma,
-            clip_rho_threshold=cfg.vtrace_clip_rho_threshold,
-            clip_c_threshold=cfg.vtrace_clip_c_threshold,
-        )
-        if cfg.standardize_advantages:
-            pg_adv = (pg_adv - jnp.mean(pg_adv)) / (jnp.std(pg_adv) + 1e-8)
+        vs, pg_adv = self._vtrace_advantages(tgt_logp, batch, vf,
+                                             heads["vf_ext"])
 
         # PPO clip against the BEHAVIOR policy's logp (what generated
         # the samples), with V-trace-corrected advantages.
@@ -121,8 +89,18 @@ class APPOLearner(IMPALALearner):
         mean_entropy = jnp.mean(entropy)
         loss = policy_loss + cfg.vf_loss_coeff * vf_loss \
             - cfg.entropy_coeff * mean_entropy
-        kl = jnp.mean(tgt_logp - cur_logp)
-        if cfg.use_kl_loss:
+        # Analytic KL(target || current) over full action distributions
+        # (a sampled tgt_logp - cur_logp estimator is NOT a KL: its
+        # gradient is a flat likelihood bonus on sampled actions and it
+        # can go negative).
+        if "logits" in heads and "logits" in tgt_heads:
+            cur_all = jax.nn.log_softmax(heads["logits"])
+            tgt_all = jax.nn.log_softmax(tgt_heads["logits"])
+            kl = jnp.mean(jnp.sum(
+                jnp.exp(tgt_all) * (tgt_all - cur_all), axis=-1))
+        else:  # modules without full distributions: report, don't train
+            kl = jnp.mean(tgt_logp - cur_logp)
+        if cfg.use_kl_loss and "logits" in heads:
             loss = loss + cfg.kl_coeff * kl
         return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
                       "entropy": mean_entropy, "kl": kl,

@@ -152,17 +152,18 @@ class IMPALALearner(Learner):
     # V-trace scan over T never crosses devices.
     dp_axis = 1
 
-    def compute_loss(self, params, batch):
-        import jax
+    def _fragment_forward(self, params, batch):
+        """One forward over the fragment obs plus the tail obs [T+1, B]:
+        the learner computes its OWN values everywhere (reference vtrace
+        uses learner-side values for both v_t and the bootstrap — mixing
+        the behavior worker's stale value head in poisons the targets).
+        Returns time-major [T, B] heads plus the extended value column
+        (shared by IMPALA's loss and APPO's target-anchored variant)."""
         import jax.numpy as jnp
 
-        cfg = self.config
         T, B = batch[sb.ACTIONS].shape
-        # One forward over the fragment obs plus the tail obs [T+1, B]: the
-        # learner computes its OWN values everywhere (reference vtrace uses
-        # learner-side values for both v_t and the bootstrap — mixing the
-        # behavior worker's stale value head in poisons the targets).
-        obs_ext = jnp.concatenate([batch[sb.OBS], batch["last_obs"]], axis=0)
+        obs_ext = jnp.concatenate([batch[sb.OBS], batch["last_obs"]],
+                                  axis=0)
         flat = {
             "obs": obs_ext.reshape(((T + 1) * B,) + obs_ext.shape[2:]),
             "actions": jnp.concatenate(
@@ -171,11 +172,25 @@ class IMPALALearner(Learner):
                 axis=0).reshape((T + 1) * B),
         }
         out = self.module.forward_train(params, flat)
-        target_logp = out["logp"].reshape(T + 1, B)[:T]
         vf_ext = out["vf"].reshape(T + 1, B)
-        vf = vf_ext[:T]
-        entropy = out["entropy"].reshape(T + 1, B)[:T]
+        heads = {
+            "logp": out["logp"].reshape(T + 1, B)[:T],
+            "vf": vf_ext[:T],
+            "vf_ext": vf_ext,
+            "entropy": out["entropy"].reshape(T + 1, B)[:T],
+        }
+        if "logits" in out:
+            heads["logits"] = out["logits"].reshape(
+                (T + 1, B) + out["logits"].shape[1:])[:T]
+        return heads
 
+    def _vtrace_advantages(self, target_logp, batch, vf, vf_ext):
+        """V-trace targets + pg advantages for a fragment, with the
+        done-row bootstrap substitution and optional standardization."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
         # V(x_{t+1}) under current params: within-fragment shift. At done
         # rows the shifted value belongs to the next episode's reset obs,
         # so substitute the behavior worker's value of the TRUE final obs
@@ -183,7 +198,6 @@ class IMPALALearner(Learner):
         # genuinely need it).
         next_vf = jnp.where(batch[sb.DONES] > 0,
                             batch["behavior_next_vf"], vf_ext[1:])
-
         vs, pg_adv = vtrace_returns(
             behavior_logp=batch[sb.LOGP],
             target_logp=target_logp,
@@ -198,6 +212,17 @@ class IMPALALearner(Learner):
         )
         if cfg.standardize_advantages:
             pg_adv = (pg_adv - jnp.mean(pg_adv)) / (jnp.std(pg_adv) + 1e-8)
+        return vs, pg_adv
+
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        heads = self._fragment_forward(params, batch)
+        target_logp = heads["logp"]
+        vf, entropy = heads["vf"], heads["entropy"]
+        vs, pg_adv = self._vtrace_advantages(target_logp, batch, vf,
+                                             heads["vf_ext"])
         policy_loss = -jnp.mean(pg_adv * target_logp)
         vf_loss = 0.5 * jnp.mean((vs - vf) ** 2)
         mean_entropy = jnp.mean(entropy)

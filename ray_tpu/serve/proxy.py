@@ -48,6 +48,9 @@ class HTTPProxy:
         controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                        namespace=SERVE_NAMESPACE)
         self._runtime = ray_tpu._global_runtime
+        # deployment -> is it ASGI? (unknown = True: send full headers
+        # until the first response reveals the shape)
+        self._asgi_deployments: dict = {}
         self._router = Router(controller)
         # First table fetch is blocking — keep it off the event loop.
         await asyncio.get_running_loop().run_in_executor(
@@ -84,11 +87,22 @@ class HTTPProxy:
             "path": self._strip_prefix(path, prefix),
             "root_path": prefix.rstrip("/"),
             "query_string": request.query_string.encode("latin-1"),
-            "headers": [(k.encode("latin-1"), v.encode("latin-1"))
-                        for k, v in request.headers.items()],
             "client": (request.remote or "127.0.0.1", 0),
             "body": body,
         }
+        dispatch_version = self._router._version
+        cached = self._asgi_deployments.get(deployment)
+        if cached is None or cached[0] != dispatch_version or cached[1]:
+            # Full header set only when the deployment might be ASGI —
+            # plain JSON deployments never read them, and encoding ~20
+            # tuples per request is measurable at high rps. Learned from
+            # the first response's shape (see _respond), invalidated on
+            # routing-table changes (a redeploy can change the type).
+            # Names lowercase per the ASGI spec (apps look up
+            # b"content-type", not the client's casing).
+            http_req["headers"] = [
+                (k.lower().encode("latin-1"), v.encode("latin-1"))
+                for k, v in request.headers.items()]
         loop = asyncio.get_running_loop()
         try:
             # Fast path: non-blocking assign (no executor hop). Blocking
@@ -113,7 +127,8 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001 — user code error → 500
             return web.json_response(
                 {"error": f"{type(e).__name__}: {e}"}, status=500)
-        return await self._respond(request, deployment, result)
+        return await self._respond(request, deployment, result,
+                                   dispatch_version)
 
     @staticmethod
     def _strip_prefix(path: str, prefix: str) -> str:
@@ -126,9 +141,16 @@ class HTTPProxy:
         with self._router._lock:
             return self._router._table.get(deployment)
 
-    async def _respond(self, request, deployment: str, result):
+    async def _respond(self, request, deployment: str, result,
+                       dispatch_version: int):
         from aiohttp import web
 
+        # Stamp with the version the request was DISPATCHED under: a
+        # redeploy landing mid-flight must not get its type cached from
+        # the old replica's response shape.
+        self._asgi_deployments[deployment] = (
+            dispatch_version,
+            isinstance(result, dict) and bool(result.get("__serve_http__")))
         if isinstance(result, dict) and result.get("__serve_http__"):
             from multidict import CIMultiDict
 

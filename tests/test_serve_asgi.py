@@ -47,6 +47,10 @@ async def _mini_asgi(scope, receive, send):
         if not event.get("more_body"):
             break
     if path == "/hello":
+        # ASGI spec: header names arrive lowercased regardless of the
+        # client's casing.
+        names = [k for k, _ in scope["headers"]]
+        assert all(k == k.lower() for k in names), names
         await send({"type": "http.response.start", "status": 200,
                     "headers": [(b"content-type", b"application/json"),
                                 (b"x-app", b"mini")]})
