@@ -153,6 +153,17 @@ class WorkerPool:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(GLOBAL_CONFIG.to_env())
+        if "RAY_TPU_GRANTED_TPU" not in (env_extra or {}):
+            # CPU-only worker: drop the site-level accelerator-plugin
+            # trigger (a sitecustomize that registers the TPU backend
+            # imports jax at interpreter start — ~2 s of CPU per spawn,
+            # measured 10x the rest of worker startup) and pin jax to CPU
+            # so user code touching jax cannot grab chips another process
+            # owns. Chip access flows through TPU resource grants only
+            # (see module docstring "TPU note").
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["RAY_TPU_JAX_PLATFORM"] = "cpu"
         env.update(env_extra or {})
         # Workers must resolve ray_tpu (and the driver's modules) even when
         # the driver got them via sys.path manipulation rather than an

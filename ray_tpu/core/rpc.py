@@ -65,6 +65,13 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
     return envelope, body[4 + elen :]
 
 
+# Handler return sentinel: the response will be sent later by the handler
+# itself via Connection.reply(msg_id, ...) — used by long-running calls
+# (e.g. actor_call_light) so the connection thread isn't parked while the
+# method executes.
+DEFERRED = object()
+
+
 class Connection:
     """Server-side handle for one client connection; supports pushes."""
 
@@ -74,9 +81,28 @@ class Connection:
         self.send_lock = threading.Lock()
         self.meta: Dict[str, Any] = {}  # handlers stash identity here (node id, worker id)
         self.alive = True
+        # msg id of the request currently being handled (connection threads
+        # process requests serially; a DEFERRED handler must read this
+        # synchronously in its body).
+        self.current_msg_id = 0
+
+    def reply(self, msg_id: int, method: str, data: Any = None,
+              error: Optional[str] = None):
+        """Send the response for a DEFERRED request."""
+        env = {"i": msg_id, "k": "resp", "m": method}
+        if error is not None:
+            env["e"] = error
+            payload = b""
+        else:
+            payload = serialization.dumps_ctrl(data)
+        try:
+            _send_msg(self.sock, env, payload, self.send_lock)
+        except OSError as e:
+            self.alive = False
+            raise ConnectionLost(str(e))
 
     def push(self, method: str, data: Any):
-        payload = serialization.dumps(data)
+        payload = serialization.dumps_ctrl(data)
         try:
             _send_msg(self.sock, {"i": 0, "k": "push", "m": method}, payload, self.send_lock)
         except OSError as e:
@@ -178,8 +204,11 @@ class RpcServer:
                     if handler is None:
                         raise RaySystemError(f"{self._name}: no handler for '{method}'")
                     data = serialization.loads(payload) if payload else None
+                    conn.current_msg_id = envelope["i"]
                     result = handler(conn, data)
-                    out = serialization.dumps(result)
+                    if result is DEFERRED:
+                        continue  # handler replies via conn.reply()
+                    out = serialization.dumps_ctrl(result)
                 except Exception as e:
                     # Handler failures — including ConnectionLost from the
                     # handler's own outbound RPCs — must not tear down THIS
@@ -372,7 +401,7 @@ class RpcClient:
                 if slot is not None:
                     callback({"e": "connection lost", "_lost": True}, b"")
                 return
-        payload = serialization.dumps(data)
+        payload = serialization.dumps_ctrl(data)
         try:
             _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method},
                       payload, self._send_lock)
@@ -394,7 +423,7 @@ class RpcClient:
         slot = {"event": threading.Event()}
         with self._pending_lock:
             self._pending[msg_id] = slot
-        payload = serialization.dumps(data)
+        payload = serialization.dumps_ctrl(data)
         try:
             _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method}, payload, self._send_lock)
         except OSError as e:

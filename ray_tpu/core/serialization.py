@@ -41,7 +41,19 @@ def serialize(value: Any) -> List[memoryview | bytes]:
         oob.append(buf)
         return False  # out-of-band
 
-    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=callback)
+    # C-pickle fast path (~2.5x cheaper than cloudpickle and this is every
+    # task arg / put value / return). Plain pickle serializes driver-script
+    # classes BY REFERENCE ("__main__.X") — dumps fine here, unresolvable
+    # on the peer — so any payload referencing __main__ falls back to
+    # cloudpickle's by-value capture. Closures/lambdas/locals fail the
+    # plain dump outright and fall back the same way.
+    try:
+        payload = pickle.dumps(value, protocol=5, buffer_callback=callback)
+        if b"__main__" in payload:
+            raise ValueError("by-reference __main__ pickle")
+    except Exception:  # noqa: BLE001 — retry by value
+        oob.clear()
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=callback)
     raw_views: List[memoryview] = []
     lens: List[int] = []
     for b in oob:
@@ -111,8 +123,23 @@ def deserialize(data: memoryview | bytes, zero_copy: bool = True) -> Any:
 
 
 def dumps(value: Any) -> bytes:
-    """Plain in-band cloudpickle (for control-plane messages)."""
+    """Plain in-band cloudpickle (for user functions/classes, which must
+    be captured BY VALUE — a __main__-defined function pickled by
+    reference would dump fine here and fail to import on the worker)."""
     return cloudpickle.dumps(value)
+
+
+def dumps_ctrl(value: Any) -> bytes:
+    """Control-plane envelope serializer: C-pickle first (2.5x faster than
+    cloudpickle on a TaskSpec, and this runs on every RPC), cloudpickle
+    only when plain pickle cannot (closures, locals). Safe because control
+    messages carry framework types and PRE-SERIALIZED user blobs only —
+    user functions/classes/args all flow as bytes produced by dumps()/
+    serialize() upstream, never as live objects."""
+    try:
+        return pickle.dumps(value, protocol=5)
+    except Exception:  # noqa: BLE001 — closure/local type in the envelope
+        return cloudpickle.dumps(value)
 
 
 def loads(data: bytes) -> Any:

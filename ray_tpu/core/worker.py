@@ -35,7 +35,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.common import TaskSpec
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import JobID, NodeID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.rpc import Connection, RpcServer
+from ray_tpu.core.rpc import DEFERRED, Connection, RpcServer
 from ray_tpu.core.runtime import CoreRuntime
 
 logger = logging.getLogger(__name__)
@@ -53,6 +53,8 @@ class WorkerRuntime(CoreRuntime):
         # Direct server must exist before registration (address is reported).
         self.direct_server = RpcServer(name="worker-direct")
         self.direct_server.register("actor_call", self._handle_actor_call)
+        self.direct_server.register("actor_call_light",
+                                    self._handle_actor_call_light)
         self.direct_server.register("direct_call", self._handle_direct_call)
         self.direct_server.register("direct_call_batch",
                                     self._handle_direct_call_batch)
@@ -92,6 +94,36 @@ class WorkerRuntime(CoreRuntime):
         self._async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._stopping = threading.Event()
         self._cancel_task_id = None  # ray.cancel target (see on_cancel_exec)
+        # Task-event batching (reference task_event_buffer_.h: events are
+        # buffered and flushed on an interval, never sent per task — an
+        # inline RPC per task costs more than dispatching the task).
+        self._event_buf: list = []
+        self._event_lock = threading.Lock()
+        self._event_flusher = threading.Thread(
+            target=self._event_flush_loop, name="task-event-flush",
+            daemon=True)
+        self._event_flusher.start()
+
+    def _buffer_task_events(self, events: list):
+        with self._event_lock:
+            self._event_buf.extend(events)
+
+    def _event_flush_loop(self, period_s: float = 1.0):
+        while not self._stopping.wait(period_s):
+            self._flush_task_events()
+        # Final drain: the last tasks before a graceful exit must still
+        # reach the timeline/state API (they were sent inline pre-batching).
+        self._flush_task_events()
+
+    def _flush_task_events(self):
+        with self._event_lock:
+            batch, self._event_buf = self._event_buf, []
+        if not batch:
+            return
+        try:
+            self.raylet.call_async("direct_task_event", {"events": batch})
+        except Exception:  # noqa: BLE001 — observability only
+            pass
 
     # ------------------------------------------------------------ plumbing
 
@@ -289,14 +321,11 @@ class WorkerRuntime(CoreRuntime):
             "queued_at": spec.submitted_at,
             **(spec.trace_ctx or {}),
         }
-        try:
-            self.raylet.call_async("direct_task_event", {"events": [
-                dict(base, state="RUNNING", ts=started),
-                dict(base, state="FAILED" if error_blob is not None
-                     else "FINISHED", ts=_time.time()),
-            ]})
-        except Exception:  # noqa: BLE001 — observability only
-            pass
+        self._buffer_task_events([
+            dict(base, state="RUNNING", ts=started),
+            dict(base, state="FAILED" if error_blob is not None
+                 else "FINISHED", ts=_time.time()),
+        ])
 
     def _pack_returns(self, spec: TaskSpec, out: Any) -> List[Any]:
         if spec.num_returns == 1:
@@ -379,6 +408,58 @@ class WorkerRuntime(CoreRuntime):
             # and future creation): complete it now instead of dropping it.
             self._try_cancel_actor_call(tid, fut, conn, spec)
         return {"accepted": True}
+
+    def _handle_actor_call_light(self, conn: Connection, data: Dict[str, Any]):
+        """Lean request/response actor invocation — no TaskSpec, no
+        ObjectRefs, no lineage, result rides the RPC response itself.
+
+        The actor-task machinery costs ~10x a raw RPC round trip (spec
+        build + arg framing + record/ref bookkeeping on the caller, spec
+        decode + reply push + task events here), which is pure overhead
+        for high-rate stateless dispatch like the Serve proxy's
+        per-request hop (the reference's proxy pays the equivalent C++
+        fast path, `core_worker` direct actor submit). Semantics kept:
+        runs on the actor executor (max_concurrency respected, async
+        methods on the actor loop); dropped: ordering, cancellation,
+        retries, task events — callers that need those use the full
+        actor_call. Caller contract: args must not reference driver
+        ``__main__`` types (serialize() falls back to by-value capture,
+        so in practice any picklable args work)."""
+        mid = conn.current_msg_id
+        name = data["m"]
+        if self.actor_instance is None:
+            raise RuntimeError("actor not initialized")
+        method = getattr(self.actor_instance, name, None)
+        if method is None:
+            raise AttributeError(
+                f"actor {type(self.actor_instance).__name__!r} "
+                f"has no method {name!r}")
+        args = serialization.deserialize(data["a"]) if data.get("a") else ()
+        kwargs = serialization.deserialize(data["kw"]) if data.get("kw") else {}
+
+        def reply_ok(out):
+            conn.reply(mid, "actor_call_light",
+                       {"r": serialization.serialize_to_bytes(out)})
+
+        def reply_err(e: BaseException):
+            conn.reply(mid, "actor_call_light",
+                       {"err": serialization.serialize_exception(e, name)})
+
+        if asyncio.iscoroutinefunction(getattr(method, "__func__", method)):
+            async def run_async():
+                try:
+                    reply_ok(await method(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001 — delivered to caller
+                    reply_err(e)
+            asyncio.run_coroutine_threadsafe(run_async(), self._async_loop)
+        else:
+            def run():
+                try:
+                    reply_ok(method(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001 — delivered to caller
+                    reply_err(e)
+            self._actor_executor.submit(run)
+        return DEFERRED
 
     def _try_cancel_actor_call(self, tid: bytes, fut, caller_conn: Connection,
                                spec: TaskSpec) -> bool:
@@ -553,6 +634,11 @@ def main():
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGUSR1, _cancel)
+    if os.environ.get("RAY_TPU_WORKER_STACK_SAMPLING"):
+        import faulthandler
+        faulthandler.register(
+            signal.SIGUSR2,
+            file=open(f"/tmp/wstack-{os.getpid()}.txt", "w"))
     # Bind the process-global runtime so user code calling ray_tpu.get/put/
     # remote inside tasks routes through this worker's CoreRuntime.
     import ray_tpu

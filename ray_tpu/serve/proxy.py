@@ -51,6 +51,11 @@ class HTTPProxy:
         # deployment -> is it ASGI? (unknown = True: send full headers
         # until the first response reveals the shape)
         self._asgi_deployments: dict = {}
+        # replica_id -> RpcClient for the light request/response lane
+        # (invalidated on any transport error; pruned against the routing
+        # table when its version changes — see _dispatch).
+        self._light_clients: dict = {}
+        self._light_version = -2  # != router's initial -1: prune on first use
         self._router = Router(controller)
         # First table fetch is blocking — keep it off the event loop.
         await asyncio.get_running_loop().run_in_executor(
@@ -105,22 +110,7 @@ class HTTPProxy:
                 for k, v in request.headers.items()]
         loop = asyncio.get_running_loop()
         try:
-            # Fast path: non-blocking assign (no executor hop). Blocking
-            # admission control falls back to a thread; either way the
-            # result is awaited via the runtime's future registry (no
-            # thread parked per in-flight request).
-            import functools
-
-            ref = self._router.try_assign(deployment, "__serve_http__",
-                                          (http_req,), {})
-            if ref is None:
-                ref = await loop.run_in_executor(
-                    None, functools.partial(
-                        self._router.assign, deployment, "__serve_http__",
-                        (http_req,), {}, timeout_s=30.0))
-            result = await asyncio.wait_for(
-                asyncio.wrap_future(self._runtime.get_future(ref)),
-                timeout=60.0)
+            result = await self._dispatch(loop, deployment, http_req)
         except asyncio.TimeoutError:
             return web.json_response(
                 {"error": "request timed out after 60s"}, status=500)
@@ -129,6 +119,96 @@ class HTTPProxy:
                 {"error": f"{type(e).__name__}: {e}"}, status=500)
         return await self._respond(request, deployment, result,
                                    dispatch_version)
+
+    async def _dispatch(self, loop, deployment: str, http_req: dict):
+        """Route one request to a replica. Light lane first: admission via
+        router.reserve(), then `actor_call_light` on the replica's direct
+        server — the result rides the RPC response, skipping the whole
+        actor-task path (TaskSpec + ObjectRef + reply push), worth ~2x on
+        trivial payloads. Any light-lane transport problem (replica
+        restarting, stale connection, saturation) falls back to the full
+        actor-call path, which owns retries and backpressure."""
+        from ray_tpu.core import serialization
+
+        version = self._router._version
+        if version != self._light_version:
+            # Prune clients for replicas that left the table (scale-down /
+            # redeploy): without this a long-lived proxy leaks one client
+            # per dead replica under autoscaling churn.
+            self._light_version = version
+            with self._router._lock:
+                live = {rid for entry in self._router._table.values()
+                        for rid, _ in entry.get("replicas", ())}
+            for rid in list(self._light_clients):
+                if rid not in live:
+                    self._light_clients.pop(rid, None)
+        choice = self._router.reserve(deployment)
+        if choice is not None:
+            replica_id, handle = choice
+            try:
+                # The reserve() slot is only freed by this release (no
+                # reaper watches light calls), so it must survive handler
+                # cancellation (client disconnect / server shutdown).
+                try:
+                    client = self._light_clients.get(replica_id)
+                    if client is None:
+                        client = await loop.run_in_executor(
+                            None, lambda: self._runtime._actor_client(
+                                handle._actor_id).client)
+                        self._light_clients[replica_id] = client
+                    fut = loop.create_future()
+
+                    def _complete(f, env, payload):
+                        if not f.done():
+                            f.set_result((env, payload))
+
+                    def cb(env, payload):
+                        loop.call_soon_threadsafe(_complete, fut, env,
+                                                  bytes(payload or b""))
+
+                    client.call_async(
+                        "actor_call_light",
+                        {"m": "handle_http",
+                         "a": serialization.serialize_to_bytes((http_req,))},
+                        cb)
+                    env, payload = await asyncio.wait_for(fut, timeout=60.0)
+                except asyncio.TimeoutError:
+                    raise
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — dead/stale connection
+                    self._light_clients.pop(replica_id, None)
+                    return await self._dispatch_heavy(loop, deployment,
+                                                      http_req)
+            finally:
+                self._router.release(replica_id)
+            if env.get("_lost") or env.get("e"):
+                # _lost: connection died mid-call. e: pre-execution failure
+                # (actor still initializing, direct server up before the
+                # instance). The heavy path queues and retries properly.
+                self._light_clients.pop(replica_id, None)
+                return await self._dispatch_heavy(loop, deployment, http_req)
+            data = serialization.loads(payload)
+            if data.get("err") is not None:
+                raise serialization.deserialize_exception(data["err"])
+            return serialization.deserialize(data["r"])
+        return await self._dispatch_heavy(loop, deployment, http_req)
+
+    async def _dispatch_heavy(self, loop, deployment: str, http_req: dict):
+        """Full actor-call path (blocking admission control on a thread;
+        result via the runtime's future registry)."""
+        import functools
+
+        ref = self._router.try_assign(deployment, "__serve_http__",
+                                      (http_req,), {})
+        if ref is None:
+            ref = await loop.run_in_executor(
+                None, functools.partial(
+                    self._router.assign, deployment, "__serve_http__",
+                    (http_req,), {}, timeout_s=30.0))
+        return await asyncio.wait_for(
+            asyncio.wrap_future(self._runtime.get_future(ref)),
+            timeout=60.0)
 
     @staticmethod
     def _strip_prefix(path: str, prefix: str) -> str:

@@ -27,6 +27,11 @@ class Router:
         self._controller = controller_handle
         self._poll_timeout_s = poll_timeout_s
         self._lock = threading.Condition()
+        # Threads parked in assign()'s backpressure wait. notify_all costs
+        # two context switches per call; at proxy request rates an
+        # unconditional notify in release() measurably taxes the hot path,
+        # so completions only notify when someone is actually waiting.
+        self._waiters = 0
         self._version = -1
         self._table: Dict[str, dict] = {}
         # replica_id -> local in-flight count
@@ -92,7 +97,11 @@ class Router:
                             f"no replica of {deployment!r} available within "
                             f"{timeout_s}s")
                     wait_t = min(wait_t, remaining)
-                self._lock.wait(timeout=wait_t)
+                self._waiters += 1
+                try:
+                    self._lock.wait(timeout=wait_t)
+                finally:
+                    self._waiters -= 1
         return self._submit(handle, replica_id, method_name, args, kwargs)
 
     def try_assign(self, deployment: str, method_name: str, args, kwargs):
@@ -108,6 +117,25 @@ class Router:
             return None
         replica_id, handle = choice
         return self._submit(handle, replica_id, method_name, args, kwargs)
+
+    def reserve(self, deployment: str) -> Optional[Tuple[str, object]]:
+        """Non-blocking admission: count an in-flight slot on a replica
+        with headroom and return (replica_id, handle), or None when
+        saturated/unknown. The caller OWNS the slot and must call
+        release() when its request completes — used by transports that
+        bypass _submit/ObjectRefs (the proxy's light lane)."""
+        if not self._started:
+            return None
+        with self._lock:
+            return self._reserve_locked(self._table.get(deployment))
+
+    def release(self, replica_id: str):
+        """Return a slot taken with reserve()."""
+        with self._lock:
+            n = self._inflight.get(replica_id, 0)
+            self._inflight[replica_id] = max(0, n - 1)
+            if self._waiters:
+                self._lock.notify_all()
 
     def _reserve_locked(self, entry):
         """Pick a replica with headroom and count the in-flight slot —
@@ -200,4 +228,5 @@ class Router:
                         if replica_id is not None:
                             n = self._inflight.get(replica_id, 0)
                             self._inflight[replica_id] = max(0, n - 1)
-                    self._lock.notify_all()
+                    if self._waiters:
+                        self._lock.notify_all()

@@ -1,0 +1,244 @@
+"""Serve light lane + control-plane fast-path serialization.
+
+Covers the r5 perf plumbing: the C-pickle fast path in serialize()/
+dumps_ctrl() (with the __main__ by-reference fallback), the DEFERRED
+deferred-reply RPC mechanism (rpc.py), router reserve()/release()
+admission accounting, and the proxy's actor_call_light lane end to end
+over HTTP (tests/test_serve_asgi.py covers the ASGI shapes; this file
+covers the transport).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+
+# --------------------------------------------------------------------- #
+# serialize() fast path
+# --------------------------------------------------------------------- #
+
+
+class MainishClass:
+    """Defined in a test module (importable on workers via PYTHONPATH), so
+    plain pickle-by-reference works for it; the __main__ fallback is
+    exercised below by faking the module name."""
+
+    def __init__(self, v):
+        self.v = v
+
+
+def test_serialize_plain_data_roundtrip():
+    from ray_tpu.core import serialization
+
+    for value in [1, "x", b"raw", {"a": [1, 2, (3, None)]}, [1.5, True]]:
+        blob = serialization.serialize_to_bytes(value)
+        assert serialization.deserialize(blob) == value
+
+
+def test_serialize_main_class_falls_back_by_value():
+    """A class claiming __module__ == '__main__' must be captured by value
+    (cloudpickle), not by reference — by-reference would dump fine and
+    fail to resolve on the worker."""
+    from ray_tpu.core import serialization
+
+    cls = type("DriverLocal", (), {"__module__": "__main__", "v": 7})
+    blob = serialization.serialize_to_bytes(cls)
+    # The blob must NOT contain a bare by-reference main lookup: by-value
+    # capture embeds cloudpickle machinery instead.
+    out = serialization.deserialize(blob)
+    assert out.v == 7
+    # And an instance inside a container:
+    inst = cls()
+    blob = serialization.serialize_to_bytes({"obj": inst})
+    assert serialization.deserialize(blob)["obj"].v == 7
+
+
+def test_serialize_string_mentioning_main_still_roundtrips():
+    from ray_tpu.core import serialization
+
+    value = {"note": "__main__ appears in this perfectly plain string"}
+    assert serialization.deserialize(
+        serialization.serialize_to_bytes(value)) == value
+
+
+def test_dumps_ctrl_closure_falls_back():
+    from ray_tpu.core import serialization
+
+    x = 41
+
+    def closure():
+        return x + 1
+
+    blob = serialization.dumps_ctrl({"fn": closure})
+    assert serialization.loads(blob)["fn"]() == 42
+
+
+def test_serialize_oob_buffers_survive_fallback():
+    """The failed fast attempt must not leak its out-of-band buffers into
+    the cloudpickle retry (oob.clear())."""
+    import numpy as np
+
+    from ray_tpu.core import serialization
+
+    cls = type("MainArr", (), {"__module__": "__main__"})
+    holder = cls()
+    holder.arr = np.arange(1024, dtype=np.float64)
+    blob = serialization.serialize_to_bytes({"h": holder})
+    out = serialization.deserialize(blob)
+    assert out["h"].arr.sum() == holder.arr.sum()
+
+
+# --------------------------------------------------------------------- #
+# DEFERRED deferred replies
+# --------------------------------------------------------------------- #
+
+
+def test_rpc_deferred_reply():
+    from ray_tpu.core.rpc import DEFERRED, RpcClient, RpcServer
+
+    server = RpcServer(name="deferred-test")
+    done = threading.Event()
+
+    def slow_echo(conn, data):
+        mid = conn.current_msg_id
+
+        def later():
+            conn.reply(mid, "slow_echo", {"r": data["x"] * 2})
+            done.set()
+
+        threading.Timer(0.05, later).start()
+        return DEFERRED
+
+    server.register("slow_echo", slow_echo)
+    server.register("fast", lambda conn, data: {"ok": True})
+    server.start()
+    try:
+        client = RpcClient(server.address, name="deferred-client")
+        # Deferred call resolves with the later reply; an interleaved
+        # normal call on the same connection is unaffected (out-of-order
+        # response matching by msg id).
+        results = {}
+        ev = threading.Event()
+
+        def cb(env, payload):
+            from ray_tpu.core import serialization
+
+            results["deferred"] = serialization.loads(bytes(payload))
+            ev.set()
+
+        client.call_async("slow_echo", {"x": 21}, cb)
+        assert client.call("fast", {}, timeout=5)["ok"] is True
+        assert ev.wait(5)
+        assert results["deferred"]["r"] == 42
+        assert done.wait(5)
+        client.close()
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# Router admission accounting
+# --------------------------------------------------------------------- #
+
+
+def test_router_reserve_release_balance():
+    from ray_tpu.serve.router import Router
+
+    router = Router.__new__(Router)  # no controller: drive the table directly
+    router._lock = threading.Condition()
+    router._waiters = 0
+    router._version = 0
+    router._inflight = {}
+    router._outstanding = {}
+    router._started = True
+    router._table = {"d": {"max_concurrent_queries": 2,
+                           "route_prefix": "/d",
+                           "replicas": [("r1", object()), ("r2", object())]}}
+
+    got = [router.reserve("d") for _ in range(5)]
+    taken = [g for g in got if g is not None]
+    # 2 replicas x limit 2 = 4 slots; the 5th reserve must be refused.
+    assert len(taken) == 4 and got[-1] is None
+    assert sorted(router._inflight.values()) == [2, 2]
+    for rid, _ in taken:
+        router.release(rid)
+    assert all(v == 0 for v in router._inflight.values())
+    # Saturated then released: reserve works again.
+    assert router.reserve("d") is not None
+
+
+def test_router_release_notifies_waiters():
+    from ray_tpu.serve.router import Router
+
+    router = Router.__new__(Router)
+    router._lock = threading.Condition()
+    router._waiters = 0
+    router._version = 0
+    router._inflight = {}
+    router._outstanding = {}
+    router._started = True
+    router._table = {"d": {"max_concurrent_queries": 1,
+                           "route_prefix": "/d",
+                           "replicas": [("r1", object())]}}
+    rid, _ = router.reserve("d")
+
+    woke = threading.Event()
+
+    def waiter():
+        with router._lock:
+            while router._reserve_locked(router._table["d"]) is None:
+                router._waiters += 1
+                try:
+                    if not router._lock.wait(timeout=5):
+                        return
+                finally:
+                    router._waiters -= 1
+        woke.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    router.release(rid)
+    assert woke.wait(5), "release() with a parked waiter must notify"
+
+
+# --------------------------------------------------------------------- #
+# Light lane end to end
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_serve_http_light_lane_end_to_end():
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=8)
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind())
+    try:
+        port = serve.http_port()
+        url = f"http://127.0.0.1:{port}/Echo"
+        for i in range(10):
+            req = urllib.request.Request(
+                url, data=json.dumps({"i": i}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert json.loads(resp.read()) == {"result": {"echo": {"i": i}}}
+        # Admission slots must be balanced after the burst: the proxy's
+        # router lives in the proxy actor, so assert via behavior — the
+        # deployment still serves after > max_concurrent_queries requests
+        # (a leaked slot per request would starve it by request 9).
+        req = urllib.request.Request(
+            url, data=b'{"last": true}',
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["result"]["echo"] == {"last": True}
+    finally:
+        serve.shutdown()
