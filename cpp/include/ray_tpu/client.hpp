@@ -1,0 +1,206 @@
+// ray_tpu C++ user API: a thin client over the cross-language gateway.
+//
+// Equivalent surface (scoped-down) to the reference's C++ user API
+// (`cpp/include/ray/api.h`): KV, Put/Get on the distributed object store,
+// task invocation by name, named-actor method calls. Where the reference
+// embeds a native CoreWorker in the C++ process, this client speaks the
+// framed-msgpack cross-language protocol to the Python-side gateway
+// (ray_tpu/xlang.py) — values are msgpack plain data both ways.
+//
+// Usage:
+//   ray_tpu::Client c("127.0.0.1:6123");          // xlang gateway address
+//   auto id = c.Put(msgpack_lite::Value(42));
+//   auto v  = c.Get(id);                          // 42
+//   auto r  = c.Call("my_module:compute", {Value(3), Value(4)});
+//   auto s  = c.ActorCall("counter", "inc", {});
+//
+// Build: g++ -std=c++17 -I cpp/include your_app.cc
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "msgpack_lite.hpp"
+
+namespace ray_tpu {
+
+using msgpack_lite::Array;
+using msgpack_lite::Map;
+using msgpack_lite::Value;
+
+class Client {
+ public:
+  explicit Client(const std::string& address) {
+    auto colon = address.rfind(':');
+    if (colon == std::string::npos)
+      throw std::invalid_argument("address must be host:port");
+    std::string host = address.substr(0, colon);
+    int port = std::stoi(address.substr(colon + 1));
+
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::invalid_argument("bad host " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + address + " failed");
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  bool Ping() { return Request("xlang_ping", Map{})["ok"].as_bool(); }
+
+  void KvPut(const std::string& key, const std::string& value,
+             const std::string& ns = "") {
+    Map req{{"key", Value::Bin(key)}, {"value", Value::Bin(value)}};
+    if (!ns.empty()) req["ns"] = Value(ns);
+    Request("xlang_kv_put", std::move(req));
+  }
+
+  // Returns nil Value when the key is absent.
+  Value KvGet(const std::string& key, const std::string& ns = "") {
+    Map req{{"key", Value::Bin(key)}};
+    if (!ns.empty()) req["ns"] = Value(ns);
+    return Request("xlang_kv_get", std::move(req))["value"];
+  }
+
+  // Object store: Put returns the object id (hex) usable from any
+  // language; Get resolves any plain-data object, including Python puts.
+  std::string Put(Value value) {
+    return Request("xlang_put", Map{{"value", std::move(value)}})["id"].as_str();
+  }
+
+  Value Get(const std::string& object_id_hex, double timeout_s = 60) {
+    return Request("xlang_get", Map{{"id", Value(object_id_hex)},
+                                    {"timeout", Value(timeout_s)}})["value"];
+  }
+
+  // Release the gateway's pin on an id returned by Put/Submit. The
+  // gateway holds such objects alive on this client's behalf (no Python
+  // ObjectRef exists for them); free when done to let the cluster
+  // reclaim the memory.
+  bool Free(const std::string& object_id_hex) {
+    return Request("xlang_free",
+                   Map{{"id", Value(object_id_hex)}})["freed"].as_bool();
+  }
+
+  // Invoke `module:function` as a cluster task and wait for the result.
+  Value Call(const std::string& fn, Array args = {}, double timeout_s = 60) {
+    return Request("xlang_call",
+                   Map{{"fn", Value(fn)},
+                       {"args", Value(std::move(args))},
+                       {"timeout", Value(timeout_s)}})["value"];
+  }
+
+  // Fire-and-track: submit and return the result object id.
+  std::string Submit(const std::string& fn, Array args = {}) {
+    return Request("xlang_call", Map{{"fn", Value(fn)},
+                                     {"args", Value(std::move(args))},
+                                     {"mode", Value("submit")}})["id"].as_str();
+  }
+
+  // Call a method on a named actor (ray_tpu actor registered with
+  // options(name=...)) and wait for the result.
+  Value ActorCall(const std::string& actor_name, const std::string& method,
+                  Array args = {}, double timeout_s = 60,
+                  const std::string& ns = "") {
+    Map req{{"name", Value(actor_name)},
+            {"method", Value(method)},
+            {"args", Value(std::move(args))},
+            {"timeout", Value(timeout_s)}};
+    if (!ns.empty()) req["namespace"] = Value(ns);
+    return Request("xlang_actor_call", std::move(req))["value"];
+  }
+
+ private:
+  // One framed request/response. Frame (matches ray_tpu/core/rpc.py):
+  //   [4B LE total][4B LE envlen][msgpack env {i,k,m}][payload]
+  Value Request(const std::string& method, Map payload) {
+    uint32_t msg_id = ++msg_counter_;
+    std::string env = Value(Map{{"i", Value(static_cast<int64_t>(msg_id))},
+                                {"k", Value("req")},
+                                {"m", Value(method)}})
+                          .encode();
+    std::string body = Value(std::move(payload)).encode();
+
+    std::string frame;
+    frame.reserve(8 + env.size() + body.size());
+    AppendLe32(frame, static_cast<uint32_t>(4 + env.size() + body.size()));
+    AppendLe32(frame, static_cast<uint32_t>(env.size()));
+    frame += env;
+    frame += body;
+    SendAll(frame);
+
+    // Responses arrive in order on this connection (single-threaded use).
+    while (true) {
+      std::string resp = RecvFrame();
+      uint32_t elen = ReadLe32(resp, 0);
+      Value renv = Value::decode(resp.substr(4, elen));
+      if (renv["k"].as_str() == "push") continue;  // not for us
+      if (!renv["e"].is_nil())
+        throw std::runtime_error("remote error: " + renv["e"].as_str());
+      std::string rbody = resp.substr(4 + elen);
+      return rbody.empty() ? Value() : Value::decode(rbody);
+    }
+  }
+
+  static void AppendLe32(std::string& out, uint32_t v) {
+    for (int i = 0; i < 4; ++i)
+      out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+
+  static uint32_t ReadLe32(const std::string& d, size_t pos) {
+    if (pos + 4 > d.size()) throw std::runtime_error("short frame");
+    uint32_t v = 0;
+    for (int i = 3; i >= 0; --i)
+      v = (v << 8) | static_cast<uint8_t>(d[pos + i]);
+    return v;
+  }
+
+  void SendAll(const std::string& data) {
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = ::send(fd_, data.data() + sent, data.size() - sent, 0);
+      if (n <= 0) throw std::runtime_error("send failed");
+      sent += static_cast<size_t>(n);
+    }
+  }
+
+  std::string RecvExact(size_t n) {
+    std::string out(n, '\0');
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = ::recv(fd_, &out[got], n - got, 0);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      got += static_cast<size_t>(r);
+    }
+    return out;
+  }
+
+  std::string RecvFrame() {
+    std::string hdr = RecvExact(4);
+    return RecvExact(ReadLe32(hdr, 0));
+  }
+
+  int fd_ = -1;
+  uint32_t msg_counter_ = 0;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,287 @@
+// Minimal msgpack codec for the ray_tpu C++ client.
+//
+// Covers the subset the cross-language protocol uses (see
+// ray_tpu/xlang.py): nil, bool, int64, double, str, bin, array, map with
+// string keys. Self-contained — no third-party deps so the client builds
+// with a bare `g++ -std=c++17`.
+//
+// Reference analogue: the C++ user API's msgpack-based XLANG
+// serialization (cpp/src/ray/runtime/ in the reference tree).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+namespace msgpack_lite {
+
+class Value;
+using Array = std::vector<Value>;
+using Map = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Arr, MapT };
+
+  Value() : type_(Type::Nil) {}
+  Value(std::nullptr_t) : type_(Type::Nil) {}
+  Value(bool b) : type_(Type::Bool), b_(b) {}
+  Value(int i) : type_(Type::Int), i_(i) {}
+  Value(int64_t i) : type_(Type::Int), i_(i) {}
+  Value(uint64_t i) : type_(Type::Int), i_(static_cast<int64_t>(i)) {}
+  Value(double d) : type_(Type::Float), d_(d) {}
+  Value(const char* s) : type_(Type::Str), s_(s) {}
+  Value(std::string s) : type_(Type::Str), s_(std::move(s)) {}
+  static Value Bin(std::string data) {
+    Value v;
+    v.type_ = Type::Bin;
+    v.s_ = std::move(data);
+    return v;
+  }
+  Value(Array a) : type_(Type::Arr), arr_(std::move(a)) {}
+  Value(Map m) : type_(Type::MapT), map_(std::move(m)) {}
+
+  Type type() const { return type_; }
+  bool is_nil() const { return type_ == Type::Nil; }
+  bool as_bool() const { check(Type::Bool); return b_; }
+  int64_t as_int() const { check(Type::Int); return i_; }
+  double as_double() const {
+    if (type_ == Type::Int) return static_cast<double>(i_);
+    check(Type::Float);
+    return d_;
+  }
+  const std::string& as_str() const {
+    if (type_ != Type::Str && type_ != Type::Bin)
+      throw std::runtime_error("msgpack: not a string/bin");
+    return s_;
+  }
+  const Array& as_array() const { check(Type::Arr); return arr_; }
+  const Map& as_map() const { check(Type::MapT); return map_; }
+
+  // map convenience: v["key"]
+  const Value& operator[](const std::string& key) const {
+    check(Type::MapT);
+    auto it = map_.find(key);
+    if (it == map_.end()) {
+      static const Value kNil;
+      return kNil;
+    }
+    return it->second;
+  }
+
+  // ---------------------------------------------------------- encoding
+
+  void encode(std::string& out) const {
+    switch (type_) {
+      case Type::Nil:
+        out.push_back(static_cast<char>(0xc0));
+        break;
+      case Type::Bool:
+        out.push_back(static_cast<char>(b_ ? 0xc3 : 0xc2));
+        break;
+      case Type::Int:
+        encode_int(out, i_);
+        break;
+      case Type::Float: {
+        out.push_back(static_cast<char>(0xcb));
+        uint64_t bits;
+        std::memcpy(&bits, &d_, 8);
+        push_be(out, bits, 8);
+        break;
+      }
+      case Type::Str:
+        if (s_.size() < 32) {
+          out.push_back(static_cast<char>(0xa0 | s_.size()));
+        } else if (s_.size() < 256) {
+          out.push_back(static_cast<char>(0xd9));
+          out.push_back(static_cast<char>(s_.size()));
+        } else {
+          out.push_back(static_cast<char>(0xda));
+          push_be(out, s_.size(), 2);
+        }
+        out.append(s_);
+        break;
+      case Type::Bin:
+        if (s_.size() < 256) {
+          out.push_back(static_cast<char>(0xc4));
+          out.push_back(static_cast<char>(s_.size()));
+        } else if (s_.size() < (1u << 16)) {
+          out.push_back(static_cast<char>(0xc5));
+          push_be(out, s_.size(), 2);
+        } else {
+          out.push_back(static_cast<char>(0xc6));
+          push_be(out, s_.size(), 4);
+        }
+        out.append(s_);
+        break;
+      case Type::Arr:
+        if (arr_.size() < 16) {
+          out.push_back(static_cast<char>(0x90 | arr_.size()));
+        } else {
+          out.push_back(static_cast<char>(0xdc));
+          push_be(out, arr_.size(), 2);
+        }
+        for (const auto& v : arr_) v.encode(out);
+        break;
+      case Type::MapT:
+        if (map_.size() < 16) {
+          out.push_back(static_cast<char>(0x80 | map_.size()));
+        } else {
+          out.push_back(static_cast<char>(0xde));
+          push_be(out, map_.size(), 2);
+        }
+        for (const auto& kv : map_) {
+          Value(kv.first).encode(out);
+          kv.second.encode(out);
+        }
+        break;
+    }
+  }
+
+  std::string encode() const {
+    std::string out;
+    encode(out);
+    return out;
+  }
+
+  // ---------------------------------------------------------- decoding
+
+  static Value decode(const std::string& data) {
+    size_t pos = 0;
+    Value v = decode_one(data, pos);
+    return v;
+  }
+
+  static Value decode_one(const std::string& d, size_t& p) {
+    uint8_t tag = need(d, p, 1);
+    p += 1;
+    if (tag <= 0x7f) return Value(static_cast<int64_t>(tag));       // pos fixint
+    if (tag >= 0xe0) return Value(static_cast<int64_t>(static_cast<int8_t>(tag)));
+    if ((tag & 0xf0) == 0x80) return decode_map(d, p, tag & 0x0f);  // fixmap
+    if ((tag & 0xf0) == 0x90) return decode_arr(d, p, tag & 0x0f);  // fixarray
+    if ((tag & 0xe0) == 0xa0) return decode_str(d, p, tag & 0x1f);  // fixstr
+    switch (tag) {
+      case 0xc0: return Value();
+      case 0xc2: return Value(false);
+      case 0xc3: return Value(true);
+      case 0xc4: return decode_bin(d, p, take_be(d, p, 1));
+      case 0xc5: return decode_bin(d, p, take_be(d, p, 2));
+      case 0xc6: return decode_bin(d, p, take_be(d, p, 4));
+      case 0xca: {  // float32
+        uint32_t bits = static_cast<uint32_t>(take_be(d, p, 4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value(static_cast<double>(f));
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = take_be(d, p, 8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Value(f);
+      }
+      case 0xcc: return Value(static_cast<int64_t>(take_be(d, p, 1)));
+      case 0xcd: return Value(static_cast<int64_t>(take_be(d, p, 2)));
+      case 0xce: return Value(static_cast<int64_t>(take_be(d, p, 4)));
+      case 0xcf: return Value(static_cast<int64_t>(take_be(d, p, 8)));
+      case 0xd0: { int8_t x = static_cast<int8_t>(take_be(d, p, 1)); return Value(static_cast<int64_t>(x)); }
+      case 0xd1: { int16_t x = static_cast<int16_t>(take_be(d, p, 2)); return Value(static_cast<int64_t>(x)); }
+      case 0xd2: { int32_t x = static_cast<int32_t>(take_be(d, p, 4)); return Value(static_cast<int64_t>(x)); }
+      case 0xd3: return Value(static_cast<int64_t>(take_be(d, p, 8)));
+      case 0xd9: return decode_str(d, p, take_be(d, p, 1));
+      case 0xda: return decode_str(d, p, take_be(d, p, 2));
+      case 0xdb: return decode_str(d, p, take_be(d, p, 4));
+      case 0xdc: return decode_arr(d, p, take_be(d, p, 2));
+      case 0xdd: return decode_arr(d, p, take_be(d, p, 4));
+      case 0xde: return decode_map(d, p, take_be(d, p, 2));
+      case 0xdf: return decode_map(d, p, take_be(d, p, 4));
+      default:
+        throw std::runtime_error("msgpack: unsupported tag " +
+                                 std::to_string(tag));
+    }
+  }
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("msgpack: wrong type access");
+  }
+
+  static void push_be(std::string& out, uint64_t v, int n) {
+    for (int i = n - 1; i >= 0; --i)
+      out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+
+  static void encode_int(std::string& out, int64_t v) {
+    if (v >= 0 && v < 128) {
+      out.push_back(static_cast<char>(v));
+    } else if (v < 0 && v >= -32) {
+      out.push_back(static_cast<char>(v));
+    } else if (v >= 0) {
+      out.push_back(static_cast<char>(0xcf));
+      push_be(out, static_cast<uint64_t>(v), 8);
+    } else {
+      out.push_back(static_cast<char>(0xd3));
+      push_be(out, static_cast<uint64_t>(v), 8);
+    }
+  }
+
+  static uint8_t need(const std::string& d, size_t p, size_t n) {
+    if (p + n > d.size()) throw std::runtime_error("msgpack: truncated");
+    return static_cast<uint8_t>(d[p]);
+  }
+
+  static uint64_t take_be(const std::string& d, size_t& p, int n) {
+    if (p + n > d.size()) throw std::runtime_error("msgpack: truncated");
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i)
+      v = (v << 8) | static_cast<uint8_t>(d[p + i]);
+    p += n;
+    return v;
+  }
+
+  static Value decode_str(const std::string& d, size_t& p, uint64_t len) {
+    if (p + len > d.size()) throw std::runtime_error("msgpack: truncated");
+    Value v(d.substr(p, len));
+    p += len;
+    return v;
+  }
+
+  static Value decode_bin(const std::string& d, size_t& p, uint64_t len) {
+    if (p + len > d.size()) throw std::runtime_error("msgpack: truncated");
+    Value v = Value::Bin(d.substr(p, len));
+    p += len;
+    return v;
+  }
+
+  static Value decode_arr(const std::string& d, size_t& p, uint64_t n) {
+    Array arr;
+    arr.reserve(n);
+    for (uint64_t i = 0; i < n; ++i) arr.push_back(decode_one(d, p));
+    return Value(std::move(arr));
+  }
+
+  static Value decode_map(const std::string& d, size_t& p, uint64_t n) {
+    Map m;
+    for (uint64_t i = 0; i < n; ++i) {
+      Value k = decode_one(d, p);
+      m[k.as_str()] = decode_one(d, p);
+    }
+    return Value(std::move(m));
+  }
+
+  Type type_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double d_ = 0.0;
+  std::string s_;
+  Array arr_;
+  Map map_;
+};
+
+}  // namespace msgpack_lite
+}  // namespace ray_tpu

@@ -144,6 +144,7 @@ class RpcServer:
         self.address = f"{self.host}:{self.port}"
         self._name = name
         self._handlers: Dict[str, Callable[[Connection, Any], Any]] = {}
+        self._raw_handlers: Dict[str, Callable[[Connection, bytes], bytes]] = {}
         self._conns: Dict[int, Connection] = {}
         self._conn_counter = itertools.count()
         self._lock = threading.Lock()
@@ -153,6 +154,15 @@ class RpcServer:
 
     def register(self, method: str, handler: Callable[[Connection, Any], Any]):
         self._handlers[method] = handler
+
+    def register_raw(self, method: str,
+                     handler: Callable[[Connection, bytes], bytes]):
+        """Register a handler that speaks raw payload bytes (no pickle on
+        either side). This is the cross-language surface: non-Python
+        clients (cpp/) frame msgpack envelopes like everyone else but
+        cannot produce or parse pickled payloads, so raw methods let them
+        carry msgpack (or any agreed encoding) end to end."""
+        self._raw_handlers[method] = handler
 
     def register_instance(self, obj: Any, prefix: str = ""):
         """Register all `handle_*` methods of obj as RPC methods."""
@@ -201,6 +211,12 @@ class RpcServer:
                 handler = self._handlers.get(method)
                 resp_env = {"i": envelope["i"], "k": "resp", "m": method}
                 try:
+                    raw = self._raw_handlers.get(method)
+                    if raw is not None:
+                        conn.current_msg_id = envelope["i"]
+                        out = raw(conn, payload)
+                        _send_msg(conn.sock, resp_env, out, conn.send_lock)
+                        continue
                     if handler is None:
                         raise RaySystemError(f"{self._name}: no handler for '{method}'")
                     data = serialization.loads(payload) if payload else None

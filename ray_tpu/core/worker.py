@@ -580,6 +580,9 @@ class WorkerRuntime(CoreRuntime):
     def _graceful_exit(self, conn: Connection, spec: TaskSpec):
         self._reply_actor_result(conn, spec, [], None)
         self._stopping.set()
+        # os._exit kills the daemon flusher before its final drain runs —
+        # flush the last tasks' events synchronously first.
+        self._flush_task_events()
         threading.Thread(target=lambda: (os._exit(0)), daemon=True).start()
 
 
@@ -617,6 +620,10 @@ def main():
         streamer.install()
 
     def _term(signum, frame):
+        try:
+            runtime._flush_task_events()  # last <=1s of buffered events
+        except Exception:  # noqa: BLE001 — exit must not be blocked
+            pass
         os._exit(0)
 
     def _cancel(signum, frame):

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import threading
 from typing import Optional
 
 logger = logging.getLogger(__name__)
@@ -145,47 +146,82 @@ class HTTPProxy:
         choice = self._router.reserve(deployment)
         if choice is not None:
             replica_id, handle = choice
+            # Slot ownership: exactly one of (this coroutine, the late
+            # callback) releases. On timeout the REPLICA IS STILL RUNNING
+            # the request, so the slot transfers to the callback and is
+            # only freed when the reply (or connection loss) arrives —
+            # releasing early would let admission control dispatch on top
+            # of an overloaded replica. pop-from-dict decides the owner.
+            slot = {"owned": True}
+            slot_lock = threading.Lock()
+
+            def _release_once():
+                with slot_lock:
+                    owned, slot["owned"] = slot["owned"], False
+                if owned:
+                    self._router.release(replica_id)
+
+            sent = False
             try:
-                # The reserve() slot is only freed by this release (no
-                # reaper watches light calls), so it must survive handler
-                # cancellation (client disconnect / server shutdown).
-                try:
-                    client = self._light_clients.get(replica_id)
-                    if client is None:
-                        client = await loop.run_in_executor(
-                            None, lambda: self._runtime._actor_client(
-                                handle._actor_id).client)
-                        self._light_clients[replica_id] = client
-                    fut = loop.create_future()
+                client = self._light_clients.get(replica_id)
+                if client is None:
+                    client = await loop.run_in_executor(
+                        None, lambda: self._runtime._actor_client(
+                            handle._actor_id).client)
+                    self._light_clients[replica_id] = client
+                fut = loop.create_future()
 
-                    def _complete(f, env, payload):
-                        if not f.done():
-                            f.set_result((env, payload))
+                def _complete(f, env, payload):
+                    if not f.done():
+                        f.set_result((env, payload))
 
-                    def cb(env, payload):
+                def cb(env, payload):
+                    # Reply (or connection loss) arrived: the replica is
+                    # done with this request — free the slot regardless of
+                    # whether the waiter is still listening (it may have
+                    # timed out; a timed-out request keeps its slot until
+                    # here precisely because the replica was still busy).
+                    try:
                         loop.call_soon_threadsafe(_complete, fut, env,
                                                   bytes(payload or b""))
+                    finally:
+                        _release_once()
 
-                    client.call_async(
-                        "actor_call_light",
-                        {"m": "handle_http",
-                         "a": serialization.serialize_to_bytes((http_req,))},
-                        cb)
-                    env, payload = await asyncio.wait_for(fut, timeout=60.0)
-                except asyncio.TimeoutError:
+                client.call_async(
+                    "actor_call_light",
+                    {"m": "handle_http",
+                     "a": serialization.serialize_to_bytes((http_req,))},
+                    cb)
+                sent = True
+                env, payload = await asyncio.wait_for(fut, timeout=60.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                if not sent:
+                    _release_once()  # cancelled pre-send: cb never fires
+                raise  # otherwise cb releases when the replica finishes
+            except Exception:  # noqa: BLE001 — dead/stale connection
+                self._light_clients.pop(replica_id, None)
+                if sent:
+                    # call_async raised after a possible partial send, and
+                    # the client delivered (or will deliver) the loss to
+                    # cb, which releases the slot. The request MAY have
+                    # executed — re-dispatching would double-run
+                    # non-idempotent work.
                     raise
-                except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001 — dead/stale connection
-                    self._light_clients.pop(replica_id, None)
-                    return await self._dispatch_heavy(loop, deployment,
-                                                      http_req)
-            finally:
-                self._router.release(replica_id)
-            if env.get("_lost") or env.get("e"):
-                # _lost: connection died mid-call. e: pre-execution failure
-                # (actor still initializing, direct server up before the
-                # instance). The heavy path queues and retries properly.
+                _release_once()  # cb never registered: we still own it
+                return await self._dispatch_heavy(loop, deployment, http_req)
+            if env.get("_lost"):
+                # Connection died after delivery: ambiguous whether the
+                # replica executed the request. Surface the failure —
+                # at-most-once, like the heavy actor path — instead of
+                # blindly re-executing.
+                self._light_clients.pop(replica_id, None)
+                raise ConnectionError(
+                    f"replica {replica_id} connection lost mid-request")
+            if env.get("e"):
+                # Pre-execution failure (actor still initializing, direct
+                # server up before the instance): provably not executed,
+                # safe to fall back to the heavy path, which queues and
+                # retries properly.
                 self._light_clients.pop(replica_id, None)
                 return await self._dispatch_heavy(loop, deployment, http_req)
             data = serialization.loads(payload)

@@ -1,0 +1,12 @@
+"""Functions invoked BY NAME from the C++ client through the xlang
+gateway (tests/test_cpp_client.py). Must be importable on workers —
+tests run with the repo root on PYTHONPATH, which spawn_worker
+propagates."""
+
+
+def add(a, b):
+    return a + b
+
+
+def boom():
+    raise RuntimeError("deliberate xlang failure")
