@@ -101,9 +101,12 @@ class Value {
         } else if (s_.size() < 256) {
           out.push_back(static_cast<char>(0xd9));
           out.push_back(static_cast<char>(s_.size()));
-        } else {
+        } else if (s_.size() < (1u << 16)) {
           out.push_back(static_cast<char>(0xda));
           push_be(out, s_.size(), 2);
+        } else {
+          out.push_back(static_cast<char>(0xdb));
+          push_be(out, s_.size(), 4);
         }
         out.append(s_);
         break;
@@ -123,18 +126,24 @@ class Value {
       case Type::Arr:
         if (arr_.size() < 16) {
           out.push_back(static_cast<char>(0x90 | arr_.size()));
-        } else {
+        } else if (arr_.size() < (1u << 16)) {
           out.push_back(static_cast<char>(0xdc));
           push_be(out, arr_.size(), 2);
+        } else {
+          out.push_back(static_cast<char>(0xdd));
+          push_be(out, arr_.size(), 4);
         }
         for (const auto& v : arr_) v.encode(out);
         break;
       case Type::MapT:
         if (map_.size() < 16) {
           out.push_back(static_cast<char>(0x80 | map_.size()));
-        } else {
+        } else if (map_.size() < (1u << 16)) {
           out.push_back(static_cast<char>(0xde));
           push_be(out, map_.size(), 2);
+        } else {
+          out.push_back(static_cast<char>(0xdf));
+          push_be(out, map_.size(), 4);
         }
         for (const auto& kv : map_) {
           Value(kv.first).encode(out);

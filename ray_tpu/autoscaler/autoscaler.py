@@ -184,8 +184,17 @@ class StandardAutoscaler:
             if not idle:
                 self._last_busy[hid] = now
                 continue
-            if now - self._last_busy.setdefault(hid, now) \
-                    > cfg.idle_timeout_s and \
+            last = self._last_busy.setdefault(hid, now)
+            if last > now:
+                # Launch grace still pending, but the node has already
+                # joined the view and reports idle — boot is over, so the
+                # normal idle clock applies from here. (The grace's job is
+                # only to protect the create->join window, during which
+                # _node_is_idle returns False anyway; keeping the full
+                # grace would let an over-launched never-used node linger
+                # grace+idle_timeout after the burst that spawned it.)
+                self._last_busy[hid] = last = now
+            if now - last > cfg.idle_timeout_s and \
                     len(self.provider.non_terminated_nodes()) > cfg.min_workers:
                 logger.info("autoscaler: terminating idle node")
                 self.provider.terminate_node(handle)

@@ -39,6 +39,10 @@ def _parse_bool(v) -> bool:
 
 # --- Core runtime -----------------------------------------------------------
 _flag("raylet_heartbeat_period_ms", int, 1000, "Raylet -> GCS resource report period")
+_flag("resource_delta_min_interval_ms", int, 50,
+      "Coalescing window for streamed resource deltas (ray_syncer "
+      "equivalent); 0 disables streaming and falls back to "
+      "heartbeat-only reports")
 _flag("runtime_env_cache_bytes", int, 1 << 30,
       "LRU byte cap for runtime_env packages in the GCS KV")
 _flag("runtime_env_eviction_grace_s", float, 300.0,

@@ -125,6 +125,8 @@ class GcsServer:
         self.metrics: Dict[str, Dict[str, Any]] = {}
         # Per-node queued-but-unsatisfiable resource shapes (autoscaler feed)
         self.node_demand: Dict[NodeID, List[Dict[str, float]]] = {}
+        # Last streamed resource-delta version per node (stale-drop).
+        self._node_resource_versions: Dict[NodeID, int] = {}
         # Explicit autoscaler.request_resources() bundles
         self.resource_requests: List[Dict[str, float]] = []
 
@@ -278,6 +280,37 @@ class GcsServer:
             self._broadcast_resource_view()
         return {"registered": True}
 
+    def handle_resource_delta(self, conn: Connection, data: Dict[str, Any]):
+        """Streamed per-node availability update (reference Ray Syncer,
+        `ray_syncer.proto`): applied immediately and re-published as a
+        DELTA on the RESOURCES channel, so peers' cluster views refresh in
+        ~the delta interval instead of a heartbeat period. Heartbeats
+        remain the periodic full-view anti-entropy."""
+        node_id: NodeID = data["node_id"]
+        version = data.get("version", 0)
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return {"registered": False}
+            last = self._node_resource_versions.get(node_id, 0)
+            if version and version < last:
+                return {"registered": True, "stale": True}
+            self._node_resource_versions[node_id] = version
+            info.resources_available = data["resources_available"]
+            info.resources_total = data.get("resources_total",
+                                            info.resources_total)
+            entry = {
+                "address": info.address,
+                "total": dict(info.resources_total),
+                "available": dict(info.resources_available),
+                "alive": info.state == "ALIVE",
+                "labels": dict(info.labels),
+                "version": version,
+            }
+        self.pubsub.publish(CH_RESOURCES, b"*",
+                            {"delta": {node_id.hex(): entry}})
+        return {"registered": True}
+
     def handle_drain_node(self, conn: Connection, data: Dict[str, Any]):
         self._mark_node_dead(data["node_id"], reason="drained")
         return {}
@@ -325,6 +358,7 @@ class GcsServer:
                 return
             info.state = "DEAD"
             self.node_demand.pop(node_id, None)
+            self._node_resource_versions.pop(node_id, None)
             client = self._raylet_clients.pop(node_id, None)
         if client:
             client.close()

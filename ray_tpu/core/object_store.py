@@ -25,8 +25,6 @@ from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, List, Optional
 
-import numpy as np
-
 from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ObjectID
@@ -226,6 +224,8 @@ class SegmentPool:
 
     @staticmethod
     def _prefault(shm: shared_memory.SharedMemory, size: int):
+        import numpy as np  # deferred: keeps worker cold-start numpy-free
+
         from ray_tpu._native import get_lib
 
         lib = get_lib()
@@ -414,6 +414,13 @@ class SharedMemoryStore:
         with self._lock:
             e = self._objects.get(object_id)
             return e is not None and e.sealed
+
+    def local_size(self, object_id: ObjectID) -> int:
+        """Sealed local object's byte size (0 when absent) — feeds the
+        scheduler's data-locality scoring without a GCS round trip."""
+        with self._lock:
+            e = self._objects.get(object_id)
+            return e.size if e is not None and e.sealed else 0
 
     def pin(self, object_id: ObjectID):
         with self._lock:

@@ -54,19 +54,37 @@ class ResourceManager:
         self._lock = threading.Lock()
         self.total: Dict[str, float] = dict(total)
         self.available: Dict[str, float] = dict(total)
+        # Streaming gossip hook (reference ray_syncer.proto: raylets STREAM
+        # resource deltas instead of waiting for the heartbeat period):
+        # called outside the lock after any ledger change; the raylet wires
+        # it to a coalescing delta-push loop.
+        self.on_change = None
+
+    def _changed(self):
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — gossip is best-effort
+                pass
 
     def try_acquire(self, request: Dict[str, float]) -> bool:
         with self._lock:
             if all(self.available.get(r, 0.0) + 1e-9 >= amt for r, amt in request.items()):
                 for r, amt in request.items():
                     self.available[r] = self.available.get(r, 0.0) - amt
-                return True
-            return False
+                ok = True
+            else:
+                ok = False
+        if ok:
+            self._changed()
+        return ok
 
     def release(self, request: Dict[str, float]):
         with self._lock:
             for r, amt in request.items():
                 self.available[r] = self.available.get(r, 0.0) + amt
+        self._changed()
 
     def feasible(self, request: Dict[str, float]) -> bool:
         with self._lock:
@@ -77,6 +95,7 @@ class ResourceManager:
             for r, amt in resources.items():
                 self.total[r] = self.total.get(r, 0.0) + amt
                 self.available[r] = self.available.get(r, 0.0) + amt
+        self._changed()
 
     def remove_resources(self, resources: Dict[str, float]):
         with self._lock:
@@ -86,6 +105,7 @@ class ResourceManager:
                 if abs(self.total[r]) < 1e-9:
                     self.total.pop(r, None)
                     self.available.pop(r, None)
+        self._changed()
 
     def set_total(self, name: str, capacity: float) -> None:
         """Atomically set one resource's TOTAL capacity (dynamic custom
@@ -102,6 +122,7 @@ class ResourceManager:
                     and abs(self.available[name]) < 1e-9:
                 self.total.pop(name, None)
                 self.available.pop(name, None)
+        self._changed()
 
     def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
         with self._lock:
@@ -371,7 +392,10 @@ class Raylet:
         )
         cpus = int(resources.get(CPU, 1) or 1)
         self.pool = WorkerPool(self, max_workers=max(4, cpus * 4))
-        self._spawn_parallelism = max(1, min(2, cpus // 2))
+        # CPU workers no longer pay the site-level jax import at spawn
+        # (~0.3s, was ~2s — see spawn_worker), so wider spawn bursts stop
+        # convoying; still capped to keep small hosts responsive.
+        self._spawn_parallelism = max(1, min(4, cpus))
         self.labels = labels or {}
         self._lock = threading.RLock()
         self._queue: deque[QueuedTask] = deque()
@@ -397,6 +421,10 @@ class Raylet:
             maxlen=GLOBAL_CONFIG.task_events_max_buffer // 10)
         self._stopped = threading.Event()
         self._dispatch_event = threading.Event()
+        # Streaming resource gossip (see _resource_sync_loop).
+        self._resources_dirty = threading.Event()
+        self._resource_version = 0
+        self._peer_resource_versions: Dict[str, int] = {}
         # GCS client with pubsub push handling; reconnects (and re-registers
         # this node + its subscriptions) after a GCS restart — the raylet
         # half of GCS fault tolerance.
@@ -428,11 +456,20 @@ class Raylet:
             is_head=self.is_head,
         )
         self._register_with_gcs(self.gcs)
-        for name, target in [
+        loops = [
             ("raylet-dispatch", self._dispatch_loop),
             ("raylet-heartbeat", self._heartbeat_loop),
             ("raylet-reaper", self._reaper_loop),
-        ]:
+        ]
+        if GLOBAL_CONFIG.resource_delta_min_interval_ms > 0:
+            # Streaming gossip (reference Ray Syncer): push availability
+            # deltas the moment the ledger changes (coalesced) instead of
+            # waiting out the heartbeat period — remote schedulers see
+            # capacity open up in ~the delta interval, which is what makes
+            # spillback decisions fresh under bursty load.
+            self.resources.on_change = self._mark_resources_dirty
+            loops.append(("raylet-resource-sync", self._resource_sync_loop))
+        for name, target in loops:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -475,6 +512,34 @@ class Raylet:
                 if not qt.deps_remaining and qt.spec.resources:
                     shapes.append(dict(qt.spec.resources))
             return shapes
+
+    def _mark_resources_dirty(self):
+        self._resources_dirty.set()
+
+    def _resource_sync_loop(self):
+        """Streamed availability deltas to the GCS (reference
+        `ray_syncer.proto` RaySyncer streams; heartbeats remain the
+        periodic anti-entropy full report). Coalesces bursts: at most one
+        delta per resource_delta_min_interval_ms."""
+        interval = GLOBAL_CONFIG.resource_delta_min_interval_ms / 1000.0
+        while not self._stopped.is_set():
+            if not self._resources_dirty.wait(timeout=1.0):
+                continue
+            if self._stopped.is_set():
+                return
+            time.sleep(interval)  # coalesce the burst behind one delta
+            self._resources_dirty.clear()
+            total, avail = self.resources.snapshot()
+            self._resource_version += 1
+            try:
+                self.gcs.call_async(
+                    "resource_delta",
+                    {"node_id": self.node_id,
+                     "resources_available": avail,
+                     "resources_total": total,
+                     "version": self._resource_version})
+            except Exception:  # noqa: BLE001 — heartbeat is the backstop
+                pass
 
     def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
@@ -528,7 +593,29 @@ class Raylet:
             return
         channel = data["channel"]
         if channel == "RESOURCES":
-            self._cluster_view = data["message"]
+            msg = data["message"]
+            if "delta" in msg:
+                # Streamed per-node delta: merge, dropping stale versions
+                # (deltas and full views race; versions are per-node
+                # monotonic). Heartbeat full views are the anti-entropy.
+                view = dict(self._cluster_view)
+                for node_hex, entry in msg["delta"].items():
+                    ver = entry.get("version", 0)
+                    if ver and ver < self._peer_resource_versions.get(
+                            node_hex, 0):
+                        continue
+                    if ver:
+                        self._peer_resource_versions[node_hex] = ver
+                    view[node_hex] = entry
+                self._cluster_view = view
+            else:
+                self._cluster_view = msg
+                # Full view is the anti-entropy: drop version state for
+                # nodes that left the cluster (autoscaler churn would
+                # otherwise grow this dict one entry per dead node).
+                self._peer_resource_versions = {
+                    k: v for k, v in self._peer_resource_versions.items()
+                    if k in msg}
             # New capacity may have appeared (autoscaler launch): queued
             # tasks this node can never run get handed back to their
             # submitters for re-routing (reference task spilling).
@@ -695,12 +782,18 @@ class Raylet:
         local = view.get(my_hex)
         # Data locality (reference `lease_policy.h:56` LocalityAwareLeasePolicy):
         # a task consuming large resident objects runs where the bytes are
-        # instead of pulling them across the network.
+        # instead of pulling them across the network. Locality outranks
+        # INSTANTANEOUS availability: with streamed resource gossip the
+        # view is fresh enough to see a node busy for the few ms a cached
+        # lease or finishing task still holds its CPU, and bouncing the
+        # task off-data to "ready" nodes costs a multi-MB pull — feasible
+        # is enough, the data node queues it for the next free worker.
         best_data = self._best_data_node(spec)
+        if best_data == my_hex and local is not None and feasible(local):
+            return my_hex  # the bytes are HERE: keep it, don't bounce
         if best_data is not None and best_data != my_hex:
             entry = view.get(best_data)
-            if entry is not None and entry.get("alive") and feasible(entry) \
-                    and available_now(entry):
+            if entry is not None and entry.get("alive") and feasible(entry):
                 return best_data
         if local is not None and feasible(local) and available_now(local):
             return my_hex
@@ -730,7 +823,12 @@ class Raylet:
         if not deps:
             return None
         if all(self.store.contains(d) for d in deps):
-            return None  # everything local: plain placement wins, no RPC
+            # Everything resident here: no RPC needed. Large bytes anchor
+            # the task to this node (see _choose_node); small ones don't.
+            if sum(self.store.local_size(d)
+                   for d in deps) >= self._LOCALITY_MIN_BYTES:
+                return self.node_id.hex()
+            return None
         try:
             entries = self.gcs.call("object_locations_batch",
                                     {"object_ids": deps}, timeout=5)["entries"]

@@ -620,10 +620,14 @@ def main():
         streamer.install()
 
     def _term(signum, frame):
-        try:
-            runtime._flush_task_events()  # last <=1s of buffered events
-        except Exception:  # noqa: BLE001 — exit must not be blocked
-            pass
+        # Drain buffered task events on a SEPARATE thread with a bounded
+        # join: the handler runs on the main thread, which may be holding
+        # _event_lock (mid-buffer) or the RPC send lock (mid-call) right
+        # now — flushing inline would self-deadlock and the worker would
+        # never exit.
+        t = threading.Thread(target=runtime._flush_task_events, daemon=True)
+        t.start()
+        t.join(timeout=0.5)
         os._exit(0)
 
     def _cancel(signum, frame):

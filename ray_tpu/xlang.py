@@ -71,6 +71,13 @@ def _check_xlang_value(value: Any):
             f"serializable (msgpack plain data only): {e}") from None
 
 
+def _value_response(value: Any) -> bytes:
+    """Encode {"value": value} reusing the validation pack — a large
+    result is serialized once, not once to check and again to respond.
+    Layout: fixmap(1) + fixstr(5) "value" + <packed value>."""
+    return b"\x81\xa5value" + _check_xlang_value(value)
+
+
 class XlangGateway:
     """Raw-msgpack handlers bound to a driver runtime."""
 
@@ -153,8 +160,7 @@ class XlangGateway:
         req = _unpack(payload)
         oid = ObjectID.from_hex(req["id"])
         value = self._runtime.get([oid], timeout=req.get("timeout"))[0]
-        _check_xlang_value(value)
-        return _pack({"value": value})
+        return _value_response(value)
 
     def call(self, conn, payload: bytes) -> bytes:
         import ray_tpu
@@ -169,8 +175,7 @@ class XlangGateway:
             return _pack({"id": ref.hex()})
         value = self._runtime.get([ref.object_id],
                                   timeout=req.get("timeout", 60))[0]
-        _check_xlang_value(value)
-        return _pack({"value": value})
+        return _value_response(value)
 
     def actor_call(self, conn, payload: bytes) -> bytes:
         import ray_tpu
@@ -183,8 +188,7 @@ class XlangGateway:
                             **(req.get("kwargs") or {}))
         value = self._runtime.get([ref.object_id],
                                   timeout=req.get("timeout", 60))[0]
-        _check_xlang_value(value)
-        return _pack({"value": value})
+        return _value_response(value)
 
 
 def start_gateway(host: str = "127.0.0.1", port: int = 0) -> str:

@@ -1,0 +1,160 @@
+"""Race-detection harness for the threaded control plane (SURVEY §5.2).
+
+Unit layer: the lock-order witness flags ABBA inversions (even when the
+interleaving never actually deadlocks) and stays quiet on consistent
+orders. Integration layer: a subprocess runs a real cluster workload —
+tasks, actors, waits, puts — with the witness installed before cluster
+creation and asserts NO lock-order cycles exist among the control
+plane's locks. This is the moral equivalent of the reference's TSAN CI
+configs for `src/ray` (bazel --config=tsan): ordering bugs surface from
+a single pass, not from winning a rare interleaving.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ray_tpu.util import lock_witness
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def witness():
+    lock_witness.install()
+    lock_witness.reset()
+    yield lock_witness
+    lock_witness.reset()
+    lock_witness.uninstall()
+
+
+def test_witness_flags_abba_inversion(witness):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+    rep = witness.report()
+    assert rep.cycles, "ABBA inversion must be reported"
+    assert "lock-order inversion" in rep.cycles[0]
+
+
+def test_witness_quiet_on_consistent_order(witness):
+    a = threading.Lock()
+    b = threading.Lock()
+    c = threading.Lock()
+
+    def ordered():
+        with a:
+            with b:
+                with c:
+                    pass
+
+    threads = [threading.Thread(target=ordered) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert witness.report().cycles == []
+
+
+def test_witness_three_lock_cycle(witness):
+    a, b, c = threading.Lock(), threading.Lock(), threading.Lock()
+    for first, second in [(a, b), (b, c), (c, a)]:
+        def run(x=first, y=second):
+            with x:
+                with y:
+                    pass
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    assert witness.report().cycles, "A->B->C->A cycle must be reported"
+
+
+def test_witness_rlock_and_condition(witness):
+    lock = threading.RLock()
+    cond = threading.Condition(lock)
+    done = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    with cond:
+        cond.notify_all()
+    t.join()
+    assert done == [True]
+    assert witness.report().cycles == []
+
+
+_CLUSTER_WORKLOAD = """
+import sys
+sys.path.insert(0, {repo!r})
+from ray_tpu.util import lock_witness
+lock_witness.install(watchdog_s=60.0)
+
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def sq(x):
+    return x * x
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self):
+        self.total = 0
+    def add(self, v):
+        self.total += v
+        return self.total
+
+refs = [sq.remote(i) for i in range(40)]
+ready, rest = ray_tpu.wait(refs, num_returns=10, timeout=60)
+assert len(ready) == 10
+vals = ray_tpu.get(refs)
+acc = Acc.remote()
+outs = ray_tpu.get([acc.add.remote(v) for v in vals[:10]])
+big = ray_tpu.put(list(range(100000)))
+assert len(ray_tpu.get(big)) == 100000
+ray_tpu.shutdown()
+
+rep = lock_witness.report()
+print("LOCKS", rep.locks_tracked, "EDGES", rep.edges)
+for c in rep.cycles:
+    print("CYCLE", c)
+print("WITNESS DONE", len(rep.cycles))
+"""
+
+
+def test_control_plane_has_no_lock_order_cycles():
+    """Run a real cluster workload under the witness in a fresh
+    interpreter (patching must precede lock creation)."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _CLUSTER_WORKLOAD.format(repo=REPO)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, RAY_TPU_LOG_LEVEL="WARNING"))
+    assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-2000:])
+    assert "WITNESS DONE 0" in proc.stdout, proc.stdout[-2000:]

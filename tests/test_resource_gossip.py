@@ -1,0 +1,112 @@
+"""Streaming resource gossip (SURVEY §2.1 N12, reference Ray Syncer).
+
+Raylets push availability deltas the moment their ledger changes
+(coalesced to resource_delta_min_interval_ms); the GCS re-publishes them
+as per-node DELTA messages on the RESOURCES channel. Peers' cluster
+views must therefore refresh in ~the delta interval even when the
+heartbeat period (the anti-entropy full report) is far longer."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
+
+
+@pytest.fixture()
+def slow_heartbeat_cluster():
+    """Two raylets with a heartbeat so slow that any view freshness must
+    come from streamed deltas."""
+    from ray_tpu.cluster_utils import Cluster
+
+    old_hb = GLOBAL_CONFIG.raylet_heartbeat_period_ms
+    GLOBAL_CONFIG.raylet_heartbeat_period_ms = 30_000
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1, resources={"pool": 2})
+    cluster.wait_for_nodes()
+    cluster.connect()
+    try:
+        yield cluster
+    finally:
+        GLOBAL_CONFIG.raylet_heartbeat_period_ms = old_hb
+        cluster.shutdown()
+
+
+def _pool_entry(raylet):
+    for entry in raylet._cluster_view.values():
+        if entry.get("total", {}).get("pool"):
+            return entry
+    return None
+
+
+def test_deltas_propagate_faster_than_heartbeat(slow_heartbeat_cluster):
+    cluster = slow_heartbeat_cluster
+    observer = cluster.raylets[0]  # the first raylet's view
+    # Initial view arrives via registration broadcast.
+    deadline = time.monotonic() + 10
+    while _pool_entry(observer) is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    entry = _pool_entry(observer)
+    assert entry is not None, "pool node never appeared in peer view"
+    assert entry["available"].get("pool") == 2.0
+
+    @ray_tpu.remote(resources={"pool": 2}, num_cpus=0)
+    def hold(sec):
+        time.sleep(sec)
+        return "done"
+
+    ref = hold.remote(4.0)
+    # Occupancy must show up in the PEER raylet's view well inside the
+    # 30s heartbeat period — only a streamed delta can deliver it.
+    deadline = time.monotonic() + 5
+    seen_busy = False
+    while time.monotonic() < deadline:
+        entry = _pool_entry(observer)
+        if entry and entry["available"].get("pool", 2.0) < 2.0:
+            seen_busy = True
+            break
+        time.sleep(0.05)
+    assert seen_busy, "resource occupancy never gossiped to the peer"
+
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    # And the release gossips back just as fast (lease return + delta).
+    lease_slack = GLOBAL_CONFIG.direct_lease_idle_s + 3
+    deadline = time.monotonic() + lease_slack
+    recovered = False
+    while time.monotonic() < deadline:
+        entry = _pool_entry(observer)
+        if entry and entry["available"].get("pool") == 2.0:
+            recovered = True
+            break
+        time.sleep(0.05)
+    assert recovered, "resource release never gossiped to the peer"
+
+
+def test_stale_delta_versions_dropped(slow_heartbeat_cluster):
+    """Out-of-order deltas must not regress a node's entry."""
+    cluster = slow_heartbeat_cluster
+    rt = ray_tpu._global_runtime
+    pool_raylet = [r for r in cluster.raylets
+                   if r.resources.total.get("pool")][0]
+    node_hex = pool_raylet.node_id.hex()
+    gcs = rt.gcs
+    cur = pool_raylet._resource_version
+
+    # A fresh delta lands...
+    gcs.call("resource_delta", {
+        "node_id": pool_raylet.node_id,
+        "resources_available": {"CPU": 1.0, "pool": 1.5},
+        "resources_total": dict(pool_raylet.resources.total),
+        "version": cur + 100})
+    # ...then a stale one (older version) must be ignored.
+    resp = gcs.call("resource_delta", {
+        "node_id": pool_raylet.node_id,
+        "resources_available": {"CPU": 1.0, "pool": 0.0},
+        "resources_total": dict(pool_raylet.resources.total),
+        "version": cur + 99})
+    assert resp.get("stale") is True
+    view = gcs.call("get_resource_view", None)
+    assert view[node_hex]["available"]["pool"] == 1.5

@@ -188,12 +188,25 @@ def test_sharded_group_split():
     np.testing.assert_array_equal(parts[1]["x"], tm["x"][:, 2:])
 
 
-def test_remote_sharded_group_raises_without_global_view(ray_start_regular):
-    """mode='remote' num_learners>1 needs real multi-host device
-    aggregation; on this CPU platform the guard must fail loudly instead
-    of silently training N independent learners."""
+def test_remote_sharded_group_trains_multiprocess(ray_start_regular):
+    """mode='remote' num_learners=2: two learner ACTORS (separate OS
+    processes) form a jax.distributed group and run one SPMD dp-sharded
+    update — the multi-host path (reference learner_group.py:114-126
+    N-worker scaling), exercisable on CPU since workers stopped loading
+    the host's accelerator plugin. The sharded update's loss must agree
+    with a single local learner on the same batch (same global batch, dp
+    gradient psum) — a guard against N silently-independent learners."""
     from ray_tpu.rllib.learner import LearnerGroup
 
-    with pytest.raises(Exception, match="global device view"):
-        LearnerGroup(lambda **kw: _make_ppo_learner(**kw),
-                     mode="remote", num_learners=2)
+    rng = np.random.default_rng(0)
+    batch = _ppo_batch(rng, 64)
+    group = LearnerGroup(lambda **kw: _make_ppo_learner(**kw),
+                         mode="remote", num_learners=2)
+    try:
+        out = group.update(batch)
+        assert np.isfinite(out["total_loss"])
+        single = _make_ppo_learner(num_devices=1).update(batch)
+        assert abs(out["total_loss"] - single["total_loss"]) < 0.05, \
+            (out["total_loss"], single["total_loss"])
+    finally:
+        group.shutdown()
