@@ -166,6 +166,19 @@ def _gpt2_train_loop(config):
     })
 
 
+def _has_tpu() -> bool:
+    """Does the connected cluster advertise TPU chips? (Workers only see
+    a chip through an explicit TPU grant — see raylet.py spawn_worker.)"""
+    import ray_tpu
+
+    try:
+        return any(n["Resources"].get("TPU", 0) > 0 for n in ray_tpu.nodes())
+    except Exception:  # noqa: BLE001 — not connected yet
+        from ray_tpu.core.node import detect_tpu_chips
+
+        return detect_tpu_chips() > 0
+
+
 def _peak_flops(device_kind: str) -> float:
     kind = device_kind.lower()
     table = [
@@ -182,6 +195,7 @@ def bench_gpt2_train(quick: bool, use_flash: bool = True) -> dict:
     from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
     from ray_tpu.train.backend import JaxConfig
 
+    has_tpu = _has_tpu()
     trainer = JaxTrainer(
         _gpt2_train_loop,
         train_loop_config={"quick": quick,
@@ -192,7 +206,10 @@ def bench_gpt2_train(quick: bool, use_flash: bool = True) -> dict:
                            "seq_len": 256 if quick else 1024,
                            "steps": 5 if quick else 10},
         jax_config=JaxConfig(distributed=False),
-        scaling_config=ScalingConfig(num_workers=1),
+        # The chip must be REQUESTED: workers without a TPU grant are
+        # pinned to CPU jax (chip isolation, raylet.py spawn_worker).
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=has_tpu,
+                                     tpus_per_worker=1 if has_tpu else 0),
         run_config=RunConfig(name=f"bench_{int(time.time())}"),
     )
     result = trainer.fit()
@@ -213,6 +230,7 @@ def bench_gpt2_long(quick: bool, steps: int = 6,
     from ray_tpu.train.backend import JaxConfig
 
     cached_probe = bool(cached_probe_bs)
+    has_tpu = _has_tpu()
     out: dict = {}
     for bs in ((cached_probe_bs,) if cached_probe
                else (2,) if quick else (4, 2, 1)):
@@ -227,7 +245,9 @@ def bench_gpt2_long(quick: bool, steps: int = 6,
                                else steps,
                                "skip_attn_bench": True},
             jax_config=JaxConfig(distributed=False),
-            scaling_config=ScalingConfig(num_workers=1),
+            scaling_config=ScalingConfig(
+                num_workers=1, use_tpu=has_tpu,
+                tpus_per_worker=1 if has_tpu else 0),
             run_config=RunConfig(name=f"bench_long_{int(time.time())}"),
         )
         result = trainer.fit()
@@ -721,8 +741,12 @@ def bench_serve(quick: bool) -> dict:
         serve.delete("Echo")
 
     n_requests = 32 if quick else 128
-    handle = serve.run(GPT2Sampler.options(
-        num_replicas=1, max_concurrent_queries=64).bind("tiny", 128, 8))
+    # The sampler replica runs its jitted decode on the chip when one is
+    # advertised (replicas without a TPU grant are pinned to CPU jax).
+    sampler_opts = {"num_replicas": 1, "max_concurrent_queries": 64}
+    if _has_tpu():
+        sampler_opts["ray_actor_options"] = {"num_tpus": 1}
+    handle = serve.run(GPT2Sampler.options(**sampler_opts).bind("tiny", 128, 8))
     try:
         # Warm the jit cache.
         ray_tpu.get(handle.remote({"ids": [1, 2, 3], "max_new_tokens": 2}))

@@ -273,8 +273,17 @@ class GcsServer:
                 # during an outage: make the raylet re-register itself.
                 return {"registered": False}
             info.last_heartbeat = time.time()
-            info.resources_available = data["resources_available"]
-            info.resources_total = data.get("resources_total", info.resources_total)
+            # A heartbeat's availability snapshot races the raylet's own
+            # streamed deltas: it was taken at send time, so if a fresher
+            # versioned delta already landed, applying the snapshot would
+            # silently revert it (and no corrective delta comes until the
+            # ledger next changes). The version decides.
+            version = data.get("resource_version", 0)
+            if version >= self._node_resource_versions.get(node_id, 0):
+                self._node_resource_versions[node_id] = version
+                info.resources_available = data["resources_available"]
+                info.resources_total = data.get("resources_total",
+                                                info.resources_total)
             self.node_demand[node_id] = data.get("pending_demand", [])
         if data.get("broadcast", True):
             self._broadcast_resource_view()
@@ -299,17 +308,24 @@ class GcsServer:
             info.resources_available = data["resources_available"]
             info.resources_total = data.get("resources_total",
                                             info.resources_total)
-            entry = {
-                "address": info.address,
-                "total": dict(info.resources_total),
-                "available": dict(info.resources_available),
-                "alive": info.state == "ALIVE",
-                "labels": dict(info.labels),
-                "version": version,
-            }
+            entry = self._view_entry_locked(node_id, info)
         self.pubsub.publish(CH_RESOURCES, b"*",
                             {"delta": {node_id.hex(): entry}})
         return {"registered": True}
+
+    def _view_entry_locked(self, node_id, info) -> Dict[str, Any]:
+        """ONE builder for per-node view entries — the delta path and the
+        full view must stay shape-compatible (peers' merge replaces whole
+        entries, so a field present in one but not the other would vanish
+        depending on which message arrived last)."""
+        return {
+            "address": info.address,
+            "total": dict(info.resources_total),
+            "available": dict(info.resources_available),
+            "alive": info.state == "ALIVE",
+            "labels": dict(info.labels),
+            "version": self._node_resource_versions.get(node_id, 0),
+        }
 
     def handle_drain_node(self, conn: Connection, data: Dict[str, Any]):
         self._mark_node_dead(data["node_id"], reason="drained")
@@ -324,16 +340,8 @@ class GcsServer:
 
     def _resource_view(self) -> Dict[str, Any]:
         with self._lock:
-            return {
-                n.node_id.hex(): {
-                    "address": n.address,
-                    "total": dict(n.resources_total),
-                    "available": dict(n.resources_available),
-                    "alive": n.state == "ALIVE",
-                    "labels": dict(n.labels),
-                }
-                for n in self.nodes.values()
-            }
+            return {n.node_id.hex(): self._view_entry_locked(n.node_id, n)
+                    for n in self.nodes.values()}
 
     def _broadcast_resource_view(self):
         self.pubsub.publish(CH_RESOURCES, b"*", self._resource_view())

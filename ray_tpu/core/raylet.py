@@ -550,6 +550,7 @@ class Raylet:
                     "heartbeat",
                     {"node_id": self.node_id, "resources_available": avail,
                      "resources_total": total,
+                     "resource_version": self._resource_version,
                      "pending_demand": self._pending_demand()},
                     timeout=5,
                 )

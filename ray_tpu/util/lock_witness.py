@@ -131,10 +131,17 @@ def _record_acquire(lock_id: int):
                 _cycles.append(msg)
 
 
+_wid_counter = iter(range(1, 1 << 62))
+
+
 class _WitnessBase:
     def __init__(self, inner):
         self._inner = inner
-        self._wid = id(self)
+        # Monotonic key, NOT id(self): CPython reuses freed addresses, so
+        # an id-keyed graph would let a new lock inherit a dead lock's
+        # edges and report phantom inversions between locks that never
+        # coexisted.
+        self._wid = next(_wid_counter)
         with _state_lock:
             _lock_sites[self._wid] = _caller_site(3)
 

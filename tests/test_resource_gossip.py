@@ -21,7 +21,11 @@ def slow_heartbeat_cluster():
     from ray_tpu.cluster_utils import Cluster
 
     old_hb = GLOBAL_CONFIG.raylet_heartbeat_period_ms
+    old_thresh = GLOBAL_CONFIG.health_check_failure_threshold
     GLOBAL_CONFIG.raylet_heartbeat_period_ms = 30_000
+    # Health checks ride their own channel but the death verdict must not
+    # outpace the stretched heartbeat on a slow CI box.
+    GLOBAL_CONFIG.health_check_failure_threshold = 60
     ray_tpu.shutdown()
     cluster = Cluster()
     cluster.add_node(num_cpus=1)
@@ -32,6 +36,7 @@ def slow_heartbeat_cluster():
         yield cluster
     finally:
         GLOBAL_CONFIG.raylet_heartbeat_period_ms = old_hb
+        GLOBAL_CONFIG.health_check_failure_threshold = old_thresh
         cluster.shutdown()
 
 
