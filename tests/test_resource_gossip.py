@@ -90,6 +90,45 @@ def test_deltas_propagate_faster_than_heartbeat(slow_heartbeat_cluster):
     assert recovered, "resource release never gossiped to the peer"
 
 
+def test_stale_heartbeat_cannot_clobber_fresher_delta(slow_heartbeat_cluster):
+    """An in-flight heartbeat snapshot (taken before a delta) must not
+    revert the delta it races — the version decides (gcs.py
+    handle_heartbeat)."""
+    cluster = slow_heartbeat_cluster
+    rt = ray_tpu._global_runtime
+    pool_raylet = [r for r in cluster.raylets
+                   if r.resources.total.get("pool")][0]
+    node_hex = pool_raylet.node_id.hex()
+    gcs = rt.gcs
+    cur = pool_raylet._resource_version
+
+    gcs.call("resource_delta", {
+        "node_id": pool_raylet.node_id,
+        "resources_available": {"CPU": 1.0, "pool": 0.5},
+        "resources_total": dict(pool_raylet.resources.total),
+        "version": cur + 10})
+    # The racing heartbeat carries an OLDER version and a stale snapshot.
+    resp = gcs.call("heartbeat", {
+        "node_id": pool_raylet.node_id,
+        "resources_available": {"CPU": 1.0, "pool": 2.0},
+        "resources_total": dict(pool_raylet.resources.total),
+        "resource_version": cur + 9,
+        "pending_demand": []})
+    assert resp["registered"]
+    view = gcs.call("get_resource_view", None)
+    assert view[node_hex]["available"]["pool"] == 0.5, \
+        "stale heartbeat reverted a fresher delta"
+    # A heartbeat at/above the delta version applies normally.
+    gcs.call("heartbeat", {
+        "node_id": pool_raylet.node_id,
+        "resources_available": {"CPU": 1.0, "pool": 2.0},
+        "resources_total": dict(pool_raylet.resources.total),
+        "resource_version": cur + 10,
+        "pending_demand": []})
+    view = gcs.call("get_resource_view", None)
+    assert view[node_hex]["available"]["pool"] == 2.0
+
+
 def test_stale_delta_versions_dropped(slow_heartbeat_cluster):
     """Out-of-order deltas must not regress a node's entry."""
     cluster = slow_heartbeat_cluster
