@@ -37,6 +37,7 @@ from ray_tpu.serve.handle import DeploymentHandle, _drop_process_router
 logger = logging.getLogger(__name__)
 
 _PROXY_NAME = "SERVE_PROXY"
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
 class Application:
@@ -184,19 +185,26 @@ def start(http_host: str = "127.0.0.1", http_port: int = 8000,
         _ensure_proxy(http_host, http_port)
 
 
-def _ensure_proxy(host: str, port: int) -> int:
+def _ensure_proxy_actor(name: str, cls, host: str, port: int) -> int:
+    """Get-or-create a detached proxy actor and wait for its bound port —
+    one implementation for the HTTP and gRPC front doors."""
     import ray_tpu
     from ray_tpu.serve.controller import SERVE_NAMESPACE
-    from ray_tpu.serve.proxy import HTTPProxy
 
     try:
-        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        proxy = ray_tpu.get_actor(name, namespace=SERVE_NAMESPACE)
     except Exception:  # noqa: BLE001
-        proxy = ray_tpu.remote(HTTPProxy).options(
-            name=_PROXY_NAME, namespace=SERVE_NAMESPACE,
+        proxy = ray_tpu.remote(cls).options(
+            name=name, namespace=SERVE_NAMESPACE,
             lifetime="detached", max_concurrency=256, num_cpus=0.1,
         ).remote(host, port)
     return ray_tpu.get(proxy.ready.remote(), timeout=60.0)
+
+
+def _ensure_proxy(host: str, port: int) -> int:
+    from ray_tpu.serve.proxy import HTTPProxy
+
+    return _ensure_proxy_actor(_PROXY_NAME, HTTPProxy, host, port)
 
 
 def _graph_order(root: Application) -> list:
@@ -354,6 +362,20 @@ def http_port() -> int:
     return _ensure_proxy("127.0.0.1", 0)
 
 
+def grpc_port() -> int:
+    """The bound port of the local gRPC proxy (starts it if needed).
+    Requests route as `/ray_tpu.serve/<Deployment>` with raw-bytes
+    request/response (msgpack-decodable bodies are decoded for the
+    deployment callable) — see serve/grpc_proxy.py."""
+    return _ensure_grpc_proxy("127.0.0.1", 0)
+
+
+def _ensure_grpc_proxy(host: str, port: int) -> int:
+    from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+    return _ensure_proxy_actor(_GRPC_PROXY_NAME, GrpcProxy, host, port)
+
+
 def delete(name: str, timeout_s: float = 30.0) -> None:
     import ray_tpu
 
@@ -370,15 +392,16 @@ def shutdown() -> None:
     )
 
     _drop_process_router()
-    try:
-        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+    for name in (_PROXY_NAME, _GRPC_PROXY_NAME):
         try:
-            ray_tpu.get(proxy.stop.remote(), timeout=5.0)
+            proxy = ray_tpu.get_actor(name, namespace=SERVE_NAMESPACE)
+            try:
+                ray_tpu.get(proxy.stop.remote(), timeout=5.0)
+            except Exception:  # noqa: BLE001
+                pass
+            ray_tpu.kill(proxy)
         except Exception:  # noqa: BLE001
             pass
-        ray_tpu.kill(proxy)
-    except Exception:  # noqa: BLE001
-        pass
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                        namespace=SERVE_NAMESPACE)

@@ -1,0 +1,149 @@
+"""gRPC ingress for Serve deployments.
+
+Equivalent of the reference's gRPC proxy (`serve/_private/proxy.py`
+gRPCProxy / `grpc_util.py`): a `grpc.aio` server whose requests route to
+deployment replicas through the same ReplicaDispatcher (light lane +
+heavy fallback) the HTTP proxy uses.
+
+Protocol: generic RPC handlers, no protoc step. The fully-qualified
+method is `/ray_tpu.serve/<DeploymentName>`; request and response bodies
+are raw bytes. A msgpack-decodable request is decoded and handed to the
+deployment callable as a Python value (and a non-bytes result is
+msgpack-encoded back); opaque bytes pass through untouched in both
+directions, so any serialization the caller prefers — protobuf included
+— rides as bytes. Deployment errors surface as StatusCode.INTERNAL with
+the exception text; unknown deployments as NOT_FOUND. Unary only (HTTP
+owns streaming responses).
+
+Clients need no stubs either:
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/ray_tpu.serve/Echo")   # bytes in/out
+    out = msgpack.unpackb(call(msgpack.packb({"x": 1})))
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ray_tpu.serve"
+
+
+class GrpcProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._router = None
+
+    async def ready(self) -> int:
+        """Start the gRPC server; returns the bound port."""
+        if self._server is not None:
+            return self._port
+        import grpc
+
+        import ray_tpu
+        from ray_tpu.serve.controller import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+        from ray_tpu.serve.proxy import ReplicaDispatcher
+        from ray_tpu.serve.router import Router
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
+        self._runtime = ray_tpu._global_runtime
+        self._router = Router(controller)
+        self._dispatcher = ReplicaDispatcher(self._router, self._runtime)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._router._ensure_started)
+
+        proxy = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                # '/ray_tpu.serve/<Deployment>' -> unary bytes handler.
+                parts = call_details.method.lstrip("/").split("/")
+                if len(parts) != 2 or parts[0] != SERVICE:
+                    return None
+                deployment = parts[1]
+
+                async def unary(request: bytes, context):
+                    return await proxy._handle(deployment, request, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,   # raw bytes both ways
+                    response_serializer=None)
+
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((_Handler(),))
+        bound = server.add_insecure_port(f"{self._host}:{self._port}")
+        if bound == 0:
+            # grpc reports bind failure as port 0, not an exception — a
+            # silently-"ready" proxy on port 0 would strand every caller.
+            raise RuntimeError(
+                f"grpc proxy failed to bind {self._host}:{self._port}")
+        self._port = bound
+        await server.start()
+        self._server = server
+        logger.info("serve grpc proxy listening on %s:%d",
+                    self._host, self._port)
+        return self._port
+
+    async def _handle(self, deployment: str, request: bytes, context):
+        import grpc
+        import msgpack
+
+        with self._router._lock:
+            known = deployment in self._router._table
+        if not known:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no deployment named {deployment!r}")
+        try:
+            payload = msgpack.unpackb(bytes(request), raw=False,
+                                      strict_map_key=False)
+        except Exception:  # noqa: BLE001 — opaque bytes pass through
+            payload = bytes(request)
+        loop = asyncio.get_running_loop()
+        try:
+            result = await self._dispatcher.dispatch(
+                loop, deployment, "__call__", (payload,))
+        except asyncio.TimeoutError:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                "request timed out after 60s")
+        except Exception as e:  # noqa: BLE001 — user code error (a user
+            # KeyError included: unknown deployments were pre-checked
+            # above, so mapping KeyError to NOT_FOUND here would
+            # misclassify application errors)
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
+        if isinstance(result, dict) and (
+                result.get("__serve_stream__") or result.get("__serve_http__")):
+            # Generator/ASGI results need the HTTP proxy's stream pump;
+            # leaking the internal sentinel would hand the client a
+            # meaningless stream id while the replica's queue idles full.
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "streaming/ASGI deployments are not servable over the "
+                "unary gRPC ingress — use the HTTP proxy")
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            return bytes(result)
+        try:
+            return msgpack.packb(result, use_bin_type=True)
+        except Exception as e:  # noqa: BLE001
+            await context.abort(
+                grpc.StatusCode.INTERNAL,
+                f"result of type {type(result).__name__} is not "
+                f"msgpack-serializable: {e}")
+
+    async def stop(self):
+        if self._router is not None:
+            self._router.stop()
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None

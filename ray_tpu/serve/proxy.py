@@ -52,12 +52,8 @@ class HTTPProxy:
         # deployment -> is it ASGI? (unknown = True: send full headers
         # until the first response reveals the shape)
         self._asgi_deployments: dict = {}
-        # replica_id -> RpcClient for the light request/response lane
-        # (invalidated on any transport error; pruned against the routing
-        # table when its version changes — see _dispatch).
-        self._light_clients: dict = {}
-        self._light_version = -2  # != router's initial -1: prune on first use
         self._router = Router(controller)
+        self._dispatcher = ReplicaDispatcher(self._router, self._runtime)
         # First table fetch is blocking — keep it off the event loop.
         await asyncio.get_running_loop().run_in_executor(
             None, self._router._ensure_started)
@@ -122,129 +118,8 @@ class HTTPProxy:
                                    dispatch_version)
 
     async def _dispatch(self, loop, deployment: str, http_req: dict):
-        """Route one request to a replica. Light lane first: admission via
-        router.reserve(), then `actor_call_light` on the replica's direct
-        server — the result rides the RPC response, skipping the whole
-        actor-task path (TaskSpec + ObjectRef + reply push), worth ~2x on
-        trivial payloads. Any light-lane transport problem (replica
-        restarting, stale connection, saturation) falls back to the full
-        actor-call path, which owns retries and backpressure."""
-        from ray_tpu.core import serialization
-
-        version = self._router._version
-        if version != self._light_version:
-            # Prune clients for replicas that left the table (scale-down /
-            # redeploy): without this a long-lived proxy leaks one client
-            # per dead replica under autoscaling churn.
-            self._light_version = version
-            with self._router._lock:
-                live = {rid for entry in self._router._table.values()
-                        for rid, _ in entry.get("replicas", ())}
-            for rid in list(self._light_clients):
-                if rid not in live:
-                    self._light_clients.pop(rid, None)
-        choice = self._router.reserve(deployment)
-        if choice is not None:
-            replica_id, handle = choice
-            # Slot ownership: exactly one of (this coroutine, the late
-            # callback) releases. On timeout the REPLICA IS STILL RUNNING
-            # the request, so the slot transfers to the callback and is
-            # only freed when the reply (or connection loss) arrives —
-            # releasing early would let admission control dispatch on top
-            # of an overloaded replica. pop-from-dict decides the owner.
-            slot = {"owned": True}
-            slot_lock = threading.Lock()
-
-            def _release_once():
-                with slot_lock:
-                    owned, slot["owned"] = slot["owned"], False
-                if owned:
-                    self._router.release(replica_id)
-
-            sent = False
-            try:
-                client = self._light_clients.get(replica_id)
-                if client is None:
-                    client = await loop.run_in_executor(
-                        None, lambda: self._runtime._actor_client(
-                            handle._actor_id).client)
-                    self._light_clients[replica_id] = client
-                fut = loop.create_future()
-
-                def _complete(f, env, payload):
-                    if not f.done():
-                        f.set_result((env, payload))
-
-                def cb(env, payload):
-                    # Reply (or connection loss) arrived: the replica is
-                    # done with this request — free the slot regardless of
-                    # whether the waiter is still listening (it may have
-                    # timed out; a timed-out request keeps its slot until
-                    # here precisely because the replica was still busy).
-                    try:
-                        loop.call_soon_threadsafe(_complete, fut, env,
-                                                  bytes(payload or b""))
-                    finally:
-                        _release_once()
-
-                client.call_async(
-                    "actor_call_light",
-                    {"m": "handle_http",
-                     "a": serialization.serialize_to_bytes((http_req,))},
-                    cb)
-                sent = True
-                env, payload = await asyncio.wait_for(fut, timeout=60.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                if not sent:
-                    _release_once()  # cancelled pre-send: cb never fires
-                raise  # otherwise cb releases when the replica finishes
-            except Exception:  # noqa: BLE001 — dead/stale connection
-                self._light_clients.pop(replica_id, None)
-                if sent:
-                    # call_async raised after a possible partial send, and
-                    # the client delivered (or will deliver) the loss to
-                    # cb, which releases the slot. The request MAY have
-                    # executed — re-dispatching would double-run
-                    # non-idempotent work.
-                    raise
-                _release_once()  # cb never registered: we still own it
-                return await self._dispatch_heavy(loop, deployment, http_req)
-            if env.get("_lost"):
-                # Connection died after delivery: ambiguous whether the
-                # replica executed the request. Surface the failure —
-                # at-most-once, like the heavy actor path — instead of
-                # blindly re-executing.
-                self._light_clients.pop(replica_id, None)
-                raise ConnectionError(
-                    f"replica {replica_id} connection lost mid-request")
-            if env.get("e"):
-                # Pre-execution failure (actor still initializing, direct
-                # server up before the instance): provably not executed,
-                # safe to fall back to the heavy path, which queues and
-                # retries properly.
-                self._light_clients.pop(replica_id, None)
-                return await self._dispatch_heavy(loop, deployment, http_req)
-            data = serialization.loads(payload)
-            if data.get("err") is not None:
-                raise serialization.deserialize_exception(data["err"])
-            return serialization.deserialize(data["r"])
-        return await self._dispatch_heavy(loop, deployment, http_req)
-
-    async def _dispatch_heavy(self, loop, deployment: str, http_req: dict):
-        """Full actor-call path (blocking admission control on a thread;
-        result via the runtime's future registry)."""
-        import functools
-
-        ref = self._router.try_assign(deployment, "__serve_http__",
-                                      (http_req,), {})
-        if ref is None:
-            ref = await loop.run_in_executor(
-                None, functools.partial(
-                    self._router.assign, deployment, "__serve_http__",
-                    (http_req,), {}, timeout_s=30.0))
-        return await asyncio.wait_for(
-            asyncio.wrap_future(self._runtime.get_future(ref)),
-            timeout=60.0)
+        return await self._dispatcher.dispatch(loop, deployment,
+                                               "__serve_http__", (http_req,))
 
     @staticmethod
     def _strip_prefix(path: str, prefix: str) -> str:
@@ -363,3 +238,159 @@ class HTTPProxy:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+
+class ReplicaDispatcher:
+    """Routes one call to a replica of a deployment; shared by the HTTP
+    and gRPC proxies. Light lane first: admission via router.reserve(),
+    then `actor_call_light` on the replica's direct server — the result
+    rides the RPC response, skipping the whole actor-task path (TaskSpec
+    + ObjectRef + reply push), worth ~2x on trivial payloads. Any
+    light-lane transport problem (replica restarting, stale connection,
+    saturation) falls back to the full actor-call path, which owns
+    retries and backpressure.
+
+    `method` follows the router convention: the "__serve_http__" sentinel
+    targets the replica's HTTP entry point; anything else is a user
+    method routed through the replica's handle_request."""
+
+    def __init__(self, router, runtime):
+        self._router = router
+        self._runtime = runtime
+        # replica_id -> RpcClient for the light request/response lane
+        # (invalidated on any transport error; pruned against the routing
+        # table when its version changes).
+        self._light_clients: dict = {}
+        self._light_version = -2  # != router's initial -1: prune on first use
+
+    @staticmethod
+    def _light_call(method: str, args: tuple) -> dict:
+        """actor_call_light payload for a router-convention call. The
+        light lane invokes the replica wrapper's methods directly:
+        handle_http for the HTTP sentinel, handle_request for user
+        methods (both async on the replica's actor loop)."""
+        from ray_tpu.core import serialization
+
+        if method == "__serve_http__":
+            return {"m": "handle_http",
+                    "a": serialization.serialize_to_bytes(args)}
+        return {"m": "handle_request",
+                "a": serialization.serialize_to_bytes((method, args, {}))}
+
+    async def dispatch(self, loop, deployment: str, method: str,
+                       args: tuple):
+        from ray_tpu.core import serialization
+
+        version = self._router._version
+        if version != self._light_version:
+            # Prune clients for replicas that left the table (scale-down /
+            # redeploy): without this a long-lived proxy leaks one client
+            # per dead replica under autoscaling churn.
+            self._light_version = version
+            with self._router._lock:
+                live = {rid for entry in self._router._table.values()
+                        for rid, _ in entry.get("replicas", ())}
+            for rid in list(self._light_clients):
+                if rid not in live:
+                    self._light_clients.pop(rid, None)
+        choice = self._router.reserve(deployment)
+        if choice is not None:
+            replica_id, handle = choice
+            # Slot ownership: exactly one of (this coroutine, the late
+            # callback) releases. On timeout the REPLICA IS STILL RUNNING
+            # the request, so the slot transfers to the callback and is
+            # only freed when the reply (or connection loss) arrives —
+            # releasing early would let admission control dispatch on top
+            # of an overloaded replica. pop-from-dict decides the owner.
+            slot = {"owned": True}
+            slot_lock = threading.Lock()
+
+            def _release_once():
+                with slot_lock:
+                    owned, slot["owned"] = slot["owned"], False
+                if owned:
+                    self._router.release(replica_id)
+
+            sent = False
+            try:
+                client = self._light_clients.get(replica_id)
+                if client is None:
+                    client = await loop.run_in_executor(
+                        None, lambda: self._runtime._actor_client(
+                            handle._actor_id).client)
+                    self._light_clients[replica_id] = client
+                fut = loop.create_future()
+
+                def _complete(f, env, payload):
+                    if not f.done():
+                        f.set_result((env, payload))
+
+                def cb(env, payload):
+                    # Reply (or connection loss) arrived: the replica is
+                    # done with this request — free the slot regardless of
+                    # whether the waiter is still listening (it may have
+                    # timed out; a timed-out request keeps its slot until
+                    # here precisely because the replica was still busy).
+                    try:
+                        loop.call_soon_threadsafe(_complete, fut, env,
+                                                  bytes(payload or b""))
+                    finally:
+                        _release_once()
+
+                client.call_async("actor_call_light",
+                                  self._light_call(method, args), cb)
+                sent = True
+                env, payload = await asyncio.wait_for(fut, timeout=60.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                if not sent:
+                    _release_once()  # cancelled pre-send: cb never fires
+                raise  # otherwise cb releases when the replica finishes
+            except Exception:  # noqa: BLE001 — dead/stale connection
+                self._light_clients.pop(replica_id, None)
+                if sent:
+                    # call_async raised after a possible partial send, and
+                    # the client delivered (or will deliver) the loss to
+                    # cb, which releases the slot. The request MAY have
+                    # executed — re-dispatching would double-run
+                    # non-idempotent work.
+                    raise
+                _release_once()  # cb never registered: we still own it
+                return await self._dispatch_heavy(loop, deployment, method,
+                                                  args)
+            if env.get("_lost"):
+                # Connection died after delivery: ambiguous whether the
+                # replica executed the request. Surface the failure —
+                # at-most-once, like the heavy actor path — instead of
+                # blindly re-executing.
+                self._light_clients.pop(replica_id, None)
+                raise ConnectionError(
+                    f"replica {replica_id} connection lost mid-request")
+            if env.get("e"):
+                # Pre-execution failure (actor still initializing, direct
+                # server up before the instance): provably not executed,
+                # safe to fall back to the heavy path, which queues and
+                # retries properly.
+                self._light_clients.pop(replica_id, None)
+                return await self._dispatch_heavy(loop, deployment, method,
+                                                  args)
+            data = serialization.loads(payload)
+            if data.get("err") is not None:
+                raise serialization.deserialize_exception(data["err"])
+            return serialization.deserialize(data["r"])
+        return await self._dispatch_heavy(loop, deployment, method, args)
+
+    async def _dispatch_heavy(self, loop, deployment: str, method: str,
+                              args: tuple):
+        """Full actor-call path (blocking admission control on a thread;
+        result via the runtime's future registry)."""
+        import functools
+
+        ref = self._router.try_assign(deployment, method, args, {})
+        if ref is None:
+            ref = await loop.run_in_executor(
+                None, functools.partial(
+                    self._router.assign, deployment, method,
+                    args, {}, timeout_s=30.0))
+        return await asyncio.wait_for(
+            asyncio.wrap_future(self._runtime.get_future(ref)),
+            timeout=60.0)
