@@ -434,6 +434,6 @@ def deploy_config(config, *, timeout_s: float = 60.0):
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "batch", "build", "delete", "deploy_config",
-    "deployment", "get_deployment_handle", "http_port", "ingress", "run",
-    "shutdown", "start", "status",
+    "deployment", "get_deployment_handle", "grpc_port", "http_port",
+    "ingress", "run", "shutdown", "start", "status",
 ]

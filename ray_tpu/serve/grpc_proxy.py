@@ -33,14 +33,23 @@ SERVICE = "ray_tpu.serve"
 
 
 class GrpcProxy:
+    UNKNOWN_GRACE_S = 5.0  # deploy-in-progress grace, mirrors Router's
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
         self._port = port
         self._server = None
         self._router = None
+        self._ready_lock = None
 
     async def ready(self) -> int:
         """Start the gRPC server; returns the bound port."""
+        if self._ready_lock is None:  # created pre-await: no interleave yet
+            self._ready_lock = asyncio.Lock()
+        async with self._ready_lock:
+            return await self._ready_locked()
+
+    async def _ready_locked(self) -> int:
         if self._server is not None:
             return self._port
         import grpc
@@ -98,12 +107,20 @@ class GrpcProxy:
         import grpc
         import msgpack
 
-        with self._router._lock:
-            known = deployment in self._router._table
-        if not known:
-            await context.abort(
-                grpc.StatusCode.NOT_FOUND,
-                f"no deployment named {deployment!r}")
+        deadline = asyncio.get_running_loop().time() + self.UNKNOWN_GRACE_S
+        while True:
+            with self._router._lock:
+                known = deployment in self._router._table
+            if known:
+                break
+            # Deploy-in-progress grace (Router.assign's UNKNOWN_GRACE_S):
+            # a request fired right after serve.run can beat the proxy
+            # router's long-poll table refresh.
+            if asyncio.get_running_loop().time() >= deadline:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"no deployment named {deployment!r}")
+            await asyncio.sleep(0.1)
         try:
             payload = msgpack.unpackb(bytes(request), raw=False,
                                       strict_map_key=False)
@@ -127,6 +144,18 @@ class GrpcProxy:
             # Generator/ASGI results need the HTTP proxy's stream pump;
             # leaking the internal sentinel would hand the client a
             # meaningless stream id while the replica's queue idles full.
+            sid = (result.get("__serve_stream__")
+                   or result.get("stream"))
+            if sid:
+                # Release the replica-side pump/queue NOW, not at the
+                # 120s idle reap — each abandoned call otherwise strands
+                # a full queue and a running generator.
+                handle = self._router.replica_for_stream(deployment, sid)
+                if handle is not None:
+                    try:
+                        handle.stream_cancel.remote(sid)
+                    except Exception:  # noqa: BLE001 — reaper is backstop
+                        pass
             await context.abort(
                 grpc.StatusCode.UNIMPLEMENTED,
                 "streaming/ASGI deployments are not servable over the "

@@ -32,9 +32,18 @@ class HTTPProxy:
         self._port = port
         self._runner = None
         self._router = None
+        self._ready_lock = None
 
     async def ready(self) -> int:
-        """Start the server; returns the bound port."""
+        """Start the server; returns the bound port. Serialized: two
+        concurrent first calls racing the awaits in the body would start
+        two servers and leak a Router thread pair."""
+        if self._ready_lock is None:  # created pre-await: no interleave yet
+            self._ready_lock = asyncio.Lock()
+        async with self._ready_lock:
+            return await self._ready_locked()
+
+    async def _ready_locked(self) -> int:
         if self._runner is not None:
             return self._port
         from aiohttp import web

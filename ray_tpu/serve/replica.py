@@ -247,6 +247,19 @@ class Replica:
                 if task is not None and not task.done():
                     task.cancel()
 
+    async def stream_cancel(self, sid: str) -> bool:
+        """Abandon a registered stream: cancel its pump task and drop the
+        queue now instead of letting them idle until the reaper (a caller
+        that cannot consume the stream — e.g. the unary gRPC ingress —
+        must not strand a full queue + running generator per request)."""
+        rec = self._streams.pop(sid, None)
+        if rec is None:
+            return False
+        task = rec[1]
+        if task is not None and not task.done():
+            task.cancel()
+        return True
+
     async def stream_next(self, sid: str, max_items: int = 64,
                           timeout_s: float = 30.0) -> Dict[str, Any]:
         """Pull the next batch of items from a registered stream."""
