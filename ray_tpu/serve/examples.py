@@ -86,27 +86,35 @@ class GPT2Sampler(_SamplerMetrics):
 
         max_pos = self._max_seq - 1
 
-        def decode(params, ids, lengths, budgets, n_steps):
-            # The WHOLE decode loop is one compiled program (lax.fori_loop):
-            # the masking/append glue between forwards must not run as
-            # eager ops — on a relay-attached chip each eager dispatch
-            # costs ~ms, which made per-step glue 20x the forward itself.
-            def body(step, carry):
-                ids, lengths = carry
+        def decode(params, ids, lengths, budgets):
+            # The WHOLE decode loop is one compiled program: the
+            # masking/append glue between forwards must not run as eager
+            # ops — on a relay-attached chip each eager dispatch costs
+            # ~ms, which made per-step glue 20x the forward itself. A
+            # while_loop with a TRACED bound (max budget) gives exactly
+            # one XLA compilation for every batch shape and exactly
+            # max-budget forwards — no static step count to recompile on,
+            # no masked-out padding passes.
+            import jax.lax as lax
+
+            def cond(carry):
+                step, _, _ = carry
+                return step < jnp.max(budgets)
+
+            def body(carry):
+                step, ids, lengths = carry
                 nxt = next_token(params, ids, lengths)
                 active = (step < budgets) & (lengths < max_pos)
                 appended = ids.at[jnp.arange(ids.shape[0]), lengths].set(nxt)
                 ids = jnp.where(active[:, None], appended, ids)
                 lengths = jnp.where(active, lengths + 1, lengths)
-                return ids, lengths
+                return step + 1, ids, lengths
 
-            import jax.lax as lax
+            _, ids, lengths = lax.while_loop(
+                cond, body, (jnp.int32(0), ids, lengths))
+            return ids, lengths
 
-            return lax.fori_loop(0, n_steps, body, (ids, lengths))
-
-        # n_steps static: one compilation per distinct decode budget
-        # (requests sharing max_new_tokens share the executable).
-        self._decode = jax.jit(decode, static_argnums=(4,))
+        self._decode = jax.jit(decode)
 
     @serve.batch(max_batch_size=SAMPLER_BATCH, batch_wait_timeout_s=0.02)
     async def __call__(self, requests: List[Dict[str, Any]]):
@@ -129,17 +137,8 @@ class GPT2Sampler(_SamplerMetrics):
         ids = jnp.asarray(ids)
         lengths = jnp.asarray(lengths)
         full_budgets = jnp.asarray(full_budgets)
-        # n_steps is a STATIC jit arg: bucket it to a power of two so the
-        # decode program compiles O(log max_seq) times total, not once per
-        # distinct per-batch max budget (prompt-length clamping makes
-        # those vary even when every client asks for the same
-        # max_new_tokens). Extra steps are no-ops under the step<budgets
-        # mask.
-        n_steps = 1
-        while n_steps < int(budgets.max()):
-            n_steps *= 2
         ids, lengths = self._decode(self._params, ids, lengths,
-                                    full_budgets, n_steps)
+                                    full_budgets)
         out_ids = np.asarray(ids)
         out_lens = np.asarray(lengths)
         return [{"ids": out_ids[i, : out_lens[i]].tolist()}

@@ -33,8 +33,6 @@ SERVICE = "ray_tpu.serve"
 
 
 class GrpcProxy:
-    UNKNOWN_GRACE_S = 5.0  # deploy-in-progress grace, mirrors Router's
-
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
         self._port = port
@@ -65,10 +63,12 @@ class GrpcProxy:
         controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                        namespace=SERVE_NAMESPACE)
         self._runtime = ray_tpu._global_runtime
-        self._router = Router(controller)
-        self._dispatcher = ReplicaDispatcher(self._router, self._runtime)
+        # Router state is only adopted after the server binds: a failed
+        # bind must leave the actor retryable without leaking a started
+        # Router thread pair per attempt.
+        router = Router(controller)
         await asyncio.get_running_loop().run_in_executor(
-            None, self._router._ensure_started)
+            None, router._ensure_started)
 
         proxy = self
 
@@ -88,16 +88,25 @@ class GrpcProxy:
                     request_deserializer=None,   # raw bytes both ways
                     response_serializer=None)
 
-        server = grpc.aio.server()
-        server.add_generic_rpc_handlers((_Handler(),))
-        bound = server.add_insecure_port(f"{self._host}:{self._port}")
-        if bound == 0:
-            # grpc reports bind failure as port 0, not an exception — a
-            # silently-"ready" proxy on port 0 would strand every caller.
-            raise RuntimeError(
-                f"grpc proxy failed to bind {self._host}:{self._port}")
+        try:
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((_Handler(),))
+            bound = server.add_insecure_port(f"{self._host}:{self._port}")
+            if bound == 0:
+                # grpc reports bind failure as port 0, not an exception —
+                # a silently-"ready" proxy on port 0 would strand every
+                # caller.
+                raise RuntimeError(
+                    f"grpc proxy failed to bind {self._host}:{self._port}")
+            # Handlers read these; they must exist before serving starts.
+            self._router = router
+            self._dispatcher = ReplicaDispatcher(router, self._runtime)
+            await server.start()
+        except BaseException:
+            router.stop()
+            self._router = None
+            raise
         self._port = bound
-        await server.start()
         self._server = server
         logger.info("serve grpc proxy listening on %s:%d",
                     self._host, self._port)
@@ -107,20 +116,42 @@ class GrpcProxy:
         import grpc
         import msgpack
 
-        deadline = asyncio.get_running_loop().time() + self.UNKNOWN_GRACE_S
-        while True:
-            with self._router._lock:
-                known = deployment in self._router._table
-            if known:
-                break
-            # Deploy-in-progress grace (Router.assign's UNKNOWN_GRACE_S):
-            # a request fired right after serve.run can beat the proxy
-            # router's long-poll table refresh.
-            if asyncio.get_running_loop().time() >= deadline:
+        with self._router._lock:
+            known = deployment in self._router._table
+        if not known:
+            # A request fired right after serve.run can beat the proxy
+            # router's long-poll refresh. One authoritative controller
+            # fetch decides immediately: genuinely-unknown names get
+            # NOT_FOUND now (no multi-second stall per typo/retry), while
+            # an in-flight deploy waits out the router's own grace
+            # (Router.UNKNOWN_GRACE_S) for the local table to catch up.
+            import ray_tpu
+
+            loop = asyncio.get_running_loop()
+            try:
+                _, table = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get(
+                        self._router._controller.listen_for_change.remote(
+                            -1, 0), timeout=10))
+                authoritative = deployment in table
+            except Exception:  # noqa: BLE001 — controller busy: fall back
+                authoritative = True  # to the grace poll below
+            if not authoritative:
                 await context.abort(
                     grpc.StatusCode.NOT_FOUND,
                     f"no deployment named {deployment!r}")
-            await asyncio.sleep(0.1)
+            from ray_tpu.serve.router import Router
+
+            deadline = loop.time() + Router.UNKNOWN_GRACE_S
+            while True:
+                with self._router._lock:
+                    if deployment in self._router._table:
+                        break
+                if loop.time() >= deadline:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"no deployment named {deployment!r}")
+                await asyncio.sleep(0.1)
         try:
             payload = msgpack.unpackb(bytes(request), raw=False,
                                       strict_map_key=False)

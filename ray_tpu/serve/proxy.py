@@ -61,21 +61,30 @@ class HTTPProxy:
         # deployment -> is it ASGI? (unknown = True: send full headers
         # until the first response reveals the shape)
         self._asgi_deployments: dict = {}
-        self._router = Router(controller)
-        self._dispatcher = ReplicaDispatcher(self._router, self._runtime)
-        # First table fetch is blocking — keep it off the event loop.
-        await asyncio.get_running_loop().run_in_executor(
-            None, self._router._ensure_started)
-
-        app = web.Application()
-        app.router.add_route("*", "/{tail:.*}", self._handle)
-        self._runner = web.AppRunner(app, access_log=None)
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, self._host, self._port)
-        await site.start()
+        # Nothing below may assign self state until the server is actually
+        # listening: a failed start (port in use) must leave the actor
+        # retryable, not "ready" with no server — and must not leak a
+        # started Router thread pair per attempt.
+        router = Router(controller)
+        try:
+            # First table fetch is blocking — keep it off the event loop.
+            await asyncio.get_running_loop().run_in_executor(
+                None, router._ensure_started)
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", self._handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            await site.start()
+        except BaseException:
+            router.stop()
+            raise
+        self._router = router
+        self._dispatcher = ReplicaDispatcher(router, self._runtime)
+        self._runner = runner
         # Port 0 = ephemeral: recover the real one.
         if self._port == 0:
-            self._port = self._runner.addresses[0][1]
+            self._port = runner.addresses[0][1]
         logger.info("serve proxy listening on %s:%d", self._host, self._port)
         return self._port
 
@@ -217,17 +226,28 @@ class HTTPProxy:
         if handle is None:
             logger.warning("stream %s: replica left the table", sid)
             return False
-        while True:
-            ref = handle.stream_next.remote(sid)
-            batch = await asyncio.wrap_future(
-                self._runtime.get_future(ref))
-            for item in batch.get("items") or []:
-                await write(item)
-            if batch.get("error"):
-                logger.warning("stream %s failed: %s", sid, batch["error"])
-                return False
-            if batch.get("done"):
-                return True
+        try:
+            while True:
+                ref = handle.stream_next.remote(sid)
+                batch = await asyncio.wrap_future(
+                    self._runtime.get_future(ref))
+                for item in batch.get("items") or []:
+                    await write(item)
+                if batch.get("error"):
+                    logger.warning("stream %s failed: %s", sid,
+                                   batch["error"])
+                    return False
+                if batch.get("done"):
+                    return True
+        except BaseException:
+            # Client disconnect (write failed) or handler cancellation:
+            # release the replica-side pump/queue NOW instead of letting
+            # the generator idle against a full queue until the 120s reap.
+            try:
+                handle.stream_cancel.remote(sid)
+            except Exception:  # noqa: BLE001 — reaper is the backstop
+                pass
+            raise
 
     def _match(self, path: str) -> Optional[str]:
         with self._router._lock:

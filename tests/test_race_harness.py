@@ -158,3 +158,57 @@ def test_control_plane_has_no_lock_order_cycles():
         env=dict(os.environ, RAY_TPU_LOG_LEVEL="WARNING"))
     assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-2000:])
     assert "WITNESS DONE 0" in proc.stdout, proc.stdout[-2000:]
+
+
+_SERVE_WORKLOAD = """
+import sys
+sys.path.insert(0, {repo!r})
+from ray_tpu.util import lock_witness
+lock_witness.install(watchdog_s=60.0)
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4)
+
+@serve.deployment(num_replicas=2, max_concurrent_queries=8)
+class Echo:
+    def __call__(self, payload):
+        return {{"echo": payload}}
+
+handle = serve.run(Echo.bind())
+# Handle path (router reserve/release + reaper) and HTTP path (proxy
+# light lane + slot ownership) concurrently exercise the serve control
+# plane's lock interplay.
+refs = [handle.remote(i) for i in range(60)]
+port = serve.http_port()
+for i in range(20):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{{port}}/Echo", data=json.dumps(i).encode(),
+        headers={{"Content-Type": "application/json"}})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert json.loads(resp.read()) == {{"result": {{"echo": i}}}}
+assert len(ray_tpu.get(refs)) == 60
+serve.shutdown()
+ray_tpu.shutdown()
+
+rep = lock_witness.report()
+for c in rep.cycles:
+    print("CYCLE", c)
+print("WITNESS DONE", len(rep.cycles))
+"""
+
+
+def test_serve_control_plane_has_no_lock_order_cycles():
+    """The serve stack (controller reconcile, router admission, proxy
+    slot ownership, replica streams) under the witness — its lock
+    interplay is the densest in the control plane."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _SERVE_WORKLOAD.format(repo=REPO)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, RAY_TPU_LOG_LEVEL="WARNING"))
+    assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-2000:])
+    assert "WITNESS DONE 0" in proc.stdout, proc.stdout[-2000:]
