@@ -634,15 +634,34 @@ def _envelope_main(n_tasks: int, n_actors: int, n_pgs: int, n_refs: int,
                 node_id=nid, soft=True)).remote(0) for nid in nodes],
             timeout=600)
         t0 = _time.perf_counter()
-        reads = [checksum.options(
+        reads = {checksum.options(
             scheduling_strategy=NodeAffinitySchedulingStrategy(
-                node_id=nid, soft=True)).remote(big) for nid in nodes]
-        sums = ray_tpu.get(reads, timeout=600)
+                node_id=nid, soft=True)).remote(big): nid for nid in nodes}
+        # Per-node completion breakdown: with the multi-source transfer
+        # plane the stragglers should finish close behind the first
+        # completion (they drain from earlier pullers), not at N x its
+        # time (everyone convoying on the seed node).
+        pending = list(reads)
+        node_done_s = {}
+        read_deadline = _time.perf_counter() + 600
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1, timeout=30)
+            now = _time.perf_counter() - t0
+            for ref in done:
+                node_done_s[reads[ref][:12]] = round(now, 4)
+            # wait() returns ([], pending) on timeout rather than raising:
+            # bound the loop so a wedged broadcast records an error instead
+            # of hanging the whole bench.
+            if not done and _time.perf_counter() > read_deadline:
+                raise TimeoutError(
+                    f"broadcast reads stuck; completed {node_done_s}")
+        sums = ray_tpu.get(list(reads), timeout=600)
         dt = _time.perf_counter() - t0
         assert all(abs(s - expect) < 1e-6 * max(1.0, abs(expect))
                    for s in sums)
         out["envelope_broadcast_mb"] = broadcast_mb
         out["envelope_broadcast_nodes"] = len(nodes)
+        out["envelope_broadcast_node_s"] = node_done_s
         out["envelope_broadcast_gb_s"] = (
             arr.nbytes * len(nodes) / dt / 1e9)
     finally:
@@ -684,6 +703,91 @@ def bench_envelope(quick: bool) -> dict:
 # --------------------------------------------------------------------------- #
 # Serve: batched GPT-2 sampler behind HTTP under concurrent load
 # --------------------------------------------------------------------------- #
+
+
+def _pull_micro_main(obj_mb: int, delay_ms: float) -> dict:
+    """Raylet-level pull-pipelining microbench (runs in a subprocess):
+    one seeded object pulled node-to-node at window=1 (stop-and-wait) vs
+    the configured window, with an injected per-chunk-RPC latency, plus a
+    no-delay pull measuring raw transfer bandwidth."""
+    import time as _time
+
+    import numpy as _np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.ids import ObjectID
+
+    chunk = 1 << 20
+    GLOBAL_CONFIG._overrides["object_transfer_chunk_bytes"] = chunk
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    out: dict = {}
+    try:
+        seed, p1, p2 = cluster.raylets
+        size = obj_mb << 20
+
+        def seed_obj(tag: int) -> ObjectID:
+            oid = ObjectID.from_random()
+            payload = _np.random.default_rng(tag).integers(
+                0, 255, size=size, dtype=_np.uint8).tobytes()
+            seed.store.put_serialized(oid, [payload])
+            seed.gcs.call("object_location_add",
+                          {"object_id": oid, "node_id": seed.node_id,
+                           "size": seed.store.local_size(oid)}, timeout=10)
+            return oid
+
+        def pull(raylet, oid, window):
+            GLOBAL_CONFIG._overrides["object_transfer_window"] = window
+            entry = raylet.gcs.call("object_locations_get",
+                                    {"object_id": oid}, timeout=10)
+            t0 = _time.perf_counter()
+            assert raylet._pull_object_pipelined(oid, entry)
+            return _time.perf_counter() - t0
+
+        p1._chunk_fetch_delay_s = delay_ms / 1000.0
+        w1 = pull(p1, seed_obj(1), window=1)
+        p2._chunk_fetch_delay_s = delay_ms / 1000.0
+        w4 = pull(p2, seed_obj(2), window=4)
+        p1._chunk_fetch_delay_s = 0.0
+        raw = pull(p1, seed_obj(3), window=4)
+        out["pull_obj_mb"] = obj_mb
+        out["pull_rpc_delay_ms"] = delay_ms
+        out["pull_window1_s"] = round(w1, 4)
+        out["pull_window4_s"] = round(w4, 4)
+        out["pull_pipeline_speedup"] = round(w1 / w4, 3)
+        out["pull_raw_gb_s"] = round(size / raw / 1e9, 3)
+    finally:
+        cluster.shutdown()
+    return out
+
+
+def bench_pull_pipelining(quick: bool) -> dict:
+    """Subprocess-isolated pull microbench (its fake cluster must not
+    touch the bench's own runtime)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    obj_mb, delay_ms = (32, 5.0) if quick else (128, 5.0)
+    code = ("import bench, json; "
+            f"print('PULL_RESULT ' + json.dumps(bench._pull_micro_main"
+            f"({obj_mb}, {delay_ms})))")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=os.path.dirname(os.path.abspath(__file__)),
+                          env=env)
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("PULL_RESULT "):
+            return _json.loads(line[len("PULL_RESULT "):])
+    raise RuntimeError(
+        f"pull microbench failed (rc={proc.returncode}): "
+        f"{(proc.stderr or '')[-500:]}")
 
 
 def bench_serve(quick: bool) -> dict:
@@ -866,6 +970,10 @@ def main(out=None):
             extra.update(bench_envelope(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["envelope_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_pull_pipelining(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["pull_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

@@ -91,7 +91,24 @@ _flag("gcs_storage_path", str, "", "Persistence path for the file storage backen
 _flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
 _flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creating task after object loss")
 _flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")
-_flag("object_transfer_chunk_bytes", int, 16 * 1024 * 1024, "Node-to-node object transfer chunk size")
+_flag("object_transfer_chunk_bytes", int, 16 * 1024 * 1024,
+      "Node-to-node object transfer chunk size: a pulled object moves as "
+      "ceil(size/chunk) independent chunk RPCs into a pre-created store "
+      "buffer, so a 1 GiB object never materializes as one RPC frame")
+_flag("object_transfer_window", int, 4,
+      "Chunk requests kept in flight per pull (pipelined across the "
+      "advertised locations). 1 restores stop-and-wait; >1 hides per-chunk "
+      "RTT and stripes chunks across every node holding a copy")
+_flag("object_transfer_max_peers", int, 8,
+      "Cap on simultaneous source nodes a single pull stripes across")
+_flag("object_transfer_sender_concurrency", int, 4,
+      "Distinct simultaneous pullers a raylet serves chunks to before "
+      "answering 'busy' with redirect hints (nodes that already completed "
+      "pulls of the object), so N-way broadcasts form a tree instead of "
+      "convoying on the seed node's NIC; 0 disables the fairness gate")
+_flag("object_transfer_refetch_location_chunks", int, 8,
+      "Re-query the object directory for new locations every N completed "
+      "chunks during a pull (late-joining sources get picked up mid-pull)")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")

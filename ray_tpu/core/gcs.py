@@ -302,7 +302,11 @@ class GcsServer:
             if info is None or info.state == "DEAD":
                 return {"registered": False}
             last = self._node_resource_versions.get(node_id, 0)
-            if version and version < last:
+            # Strictly monotonic per node: an equal or older version is a
+            # replay/reorder, and a version-0 delta (sender predating the
+            # versioning, or a bug) must not RESET the guard — storing 0
+            # would let the next stale delta through.
+            if version <= last:
                 return {"registered": True, "stale": True}
             self._node_resource_versions[node_id] = version
             info.resources_available = data["resources_available"]
@@ -376,6 +380,7 @@ class GcsServer:
         with self._lock:
             for oid, entry in list(self.objects.items()):
                 entry["nodes"].discard(node_id)
+                entry.get("partial", set()).discard(node_id)
             affected = [a for a in self.actors.values() if a.node_id == node_id
                         and a.state in (ActorState.ALIVE, ActorState.PENDING_CREATION,
                                         ActorState.RESTARTING)]
@@ -564,12 +569,20 @@ class GcsServer:
     # ------------------------------------------------------- object directory
 
     def handle_object_location_add(self, conn: Connection, data: Dict[str, Any]):
+        """Register a location. With ``partial=True`` the node is mid-pull:
+        it holds SOME chunks and can serve the ones it has (chunk-aware
+        answers let concurrent pullers drain from each other instead of
+        convoying on the seed node). A later full add promotes it."""
         oid: ObjectID = data["object_id"]
         with self._lock:
             entry = self.objects.setdefault(
                 oid, {"nodes": set(), "size": 0, "inline": None, "owner": None})
             if data.get("node_id") is not None:
-                entry["nodes"].add(data["node_id"])
+                if data.get("partial"):
+                    entry.setdefault("partial", set()).add(data["node_id"])
+                else:
+                    entry["nodes"].add(data["node_id"])
+                    entry.setdefault("partial", set()).discard(data["node_id"])
             entry["size"] = data.get("size", entry["size"])
             if data.get("inline") is not None:
                 entry["inline"] = data["inline"]
@@ -583,7 +596,9 @@ class GcsServer:
         with self._lock:
             entry = self.objects.get(oid)
             if entry:
-                entry["nodes"].discard(data["node_id"])
+                entry.get("partial", set()).discard(data["node_id"])
+                if not data.get("partial"):  # partial=True: abandoned pull only
+                    entry["nodes"].discard(data["node_id"])
         return {}
 
     def handle_object_locations_get(self, conn: Connection, data: Dict[str, Any]):
@@ -616,6 +631,10 @@ class GcsServer:
             return {
                 "known": True,
                 "nodes": [n for n in entry["nodes"]],
+                # Mid-pull holders: they serve the chunks they already have
+                # and answer "missing" for the rest — extra stripe sources
+                # for concurrent pullers, never the sole trigger of a pull.
+                "partial_nodes": [n for n in entry.get("partial", ())],
                 "size": entry["size"],
                 "inline": entry["inline"],
                 "owner": entry["owner"],

@@ -445,12 +445,20 @@ class SharedMemoryStore:
                 return
             self._used -= entry.size
             if entry.shm is not None:
+                # Close and unlink independently: close() raises BufferError
+                # while zero-copy exports of the segment are still alive
+                # (e.g. a chunk send draining), but the NAME must still be
+                # unlinked — a leaked name would make any later create()
+                # of the same object fail forever with FileExistsError.
                 try:
                     entry.shm.close()
-                    if not skip_unlink:
-                        entry.shm.unlink()
                 except Exception:
                     pass
+                if not skip_unlink:
+                    try:
+                        entry.shm.unlink()
+                    except Exception:
+                        pass
             if entry.spilled_path:
                 path, entry.spilled_path = entry.spilled_path, None
                 entry.pending_spill = None  # uploader sees the tombstone
@@ -599,6 +607,11 @@ class SharedMemoryStore:
                 "used_bytes": self._used,
                 "capacity_bytes": self.capacity,
                 "num_spilled": sum(1 for e in self._objects.values() if e.spilled_path),
+                # Unsealed buffers belong to in-flight creates/pulls; a
+                # steady-state nonzero value means a failed pull leaked its
+                # buffer (the transfer tests assert this drains to 0).
+                "num_unsealed": sum(
+                    1 for e in self._objects.values() if not e.sealed),
             }
 
     def shutdown(self):

@@ -22,6 +22,8 @@ from __future__ import annotations
 import logging
 import math
 import os
+import random
+import struct
 import subprocess
 import sys
 import threading
@@ -30,12 +32,20 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+import msgpack
+
 from ray_tpu.core import serialization
 from ray_tpu.core.common import CPU, TPU, NodeInfo, TaskSpec
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import ObjectStoreFullError, SharedMemoryStore
-from ray_tpu.core.rpc import Connection, ReconnectingClient, RpcClient, RpcServer
+from ray_tpu.core.rpc import (
+    DEFERRED,
+    Connection,
+    ReconnectingClient,
+    RpcClient,
+    RpcServer,
+)
 from ray_tpu.exceptions import RaySystemError
 
 logger = logging.getLogger(__name__)
@@ -351,6 +361,130 @@ class WorkerPool:
 # --------------------------------------------------------------------------- #
 
 
+# --------------------------------------------------------------------------- #
+# Object transfer plane
+# --------------------------------------------------------------------------- #
+#
+# Wire format of the raw `pull_object_chunk` method (raw-bytes RPC framing,
+# no pickle on either side):
+#   request payload:  msgpack {o: oid bytes, f: offset, l: length,
+#                              p: puller node hex}
+#   response payload: [4B LE meta length][msgpack meta][chunk bytes]
+#     meta: {st: "ok"|"busy"|"missing", s: object size,
+#            alt: [node hex, ...] redirect hints, gone: bool}
+# The chunk bytes part of an "ok" reply is a memoryview slice of the sealed
+# (or in-progress) store segment — the vectored send path writes it to the
+# socket without an intermediate copy.
+
+_CHUNK_META_HDR = struct.Struct("<I")
+
+
+def _pack_chunk_reply(meta: Dict[str, Any], chunk=b"") -> list:
+    m = msgpack.packb(meta)
+    return [_CHUNK_META_HDR.pack(len(m)), m, chunk]
+
+
+def _unpack_chunk_reply(raw: bytes) -> Tuple[Dict[str, Any], memoryview]:
+    (mlen,) = _CHUNK_META_HDR.unpack_from(raw, 0)
+    meta = msgpack.unpackb(raw[4: 4 + mlen])
+    return meta, memoryview(raw)[4 + mlen:]
+
+
+class _ActivePull:
+    """Receiver-side state of one in-progress multi-source pull.
+
+    Doubles as the chunk-availability index that lets this node SERVE the
+    chunks it has already received while the pull is still running — the
+    swarm half of the broadcast plane (a node advertises itself as a
+    `partial` location the moment its buffer exists)."""
+
+    __slots__ = ("buf", "size", "chunk_bytes", "lock", "done")
+
+    def __init__(self, buf: memoryview, size: int, chunk_bytes: int):
+        self.buf = buf
+        self.size = size
+        self.chunk_bytes = chunk_bytes
+        self.lock = threading.Lock()
+        self.done: Set[int] = set()
+
+    def mark_done(self, idx: int):
+        with self.lock:
+            self.done.add(idx)
+
+    def covers(self, offset: int, length: int) -> bool:
+        """True when every chunk overlapping [offset, offset+length) has
+        fully landed (the requester's chunk size may differ from ours)."""
+        if offset >= self.size:
+            return False
+        end = min(offset + max(length, 1), self.size)
+        first = offset // self.chunk_bytes
+        last = (end - 1) // self.chunk_bytes
+        with self.lock:
+            return all(i in self.done for i in range(first, last + 1))
+
+
+class _PeerSet:
+    """Thread-safe rotating set of source addresses for one pull."""
+
+    # A dropped peer may be re-added (by a directory refresh or redirect
+    # hint) after this cool-down — one transient RPC failure must not
+    # blacklist a node for the lifetime of a long pull, or a sole
+    # surviving holder could become permanently unreachable.
+    DROP_COOLDOWN_S = 5.0
+
+    def __init__(self, max_peers: int):
+        self._lock = threading.Lock()
+        self._addrs: List[str] = []
+        self._dead: Dict[str, float] = {}  # addr -> drop time
+        self._rr = 0
+        self._max = max_peers
+        self._last_refresh = 0.0
+
+    def add(self, addr: Optional[str]) -> bool:
+        if not addr:
+            return False
+        with self._lock:
+            dropped = self._dead.get(addr)
+            if dropped is not None:
+                if time.monotonic() - dropped < self.DROP_COOLDOWN_S:
+                    return False
+                del self._dead[addr]
+            if addr in self._addrs or len(self._addrs) >= self._max:
+                return False
+            self._addrs.append(addr)
+            return True
+
+    def drop(self, addr: str):
+        with self._lock:
+            self._dead[addr] = time.monotonic()
+            if addr in self._addrs:
+                self._addrs.remove(addr)
+
+    def next(self) -> Optional[str]:
+        with self._lock:
+            if not self._addrs:
+                return None
+            self._rr += 1
+            return self._addrs[self._rr % len(self._addrs)]
+
+    def snapshot(self) -> List[str]:
+        with self._lock:
+            return list(self._addrs)
+
+    def may_refresh(self, min_interval_s: float = 0.05) -> bool:
+        """Rate-limits directory re-queries across this pull's workers."""
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_refresh < min_interval_s:
+                return False
+            self._last_refresh = now
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._addrs)
+
+
 @dataclass
 class QueuedTask:
     spec: TaskSpec
@@ -408,6 +542,33 @@ class Raylet:
         self._pending_actor_creates: Dict[ActorID, Dict[str, Any]] = {}
         self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}  # (pgid, idx) -> record
         self._pulls_inflight: Set[ObjectID] = set()
+        # Transfer plane: in-progress pulls (chunk-availability index — this
+        # node serves the chunks it already has), sender-side fairness
+        # ledger, and a test/bench hook injecting per-chunk-RPC latency.
+        self._active_pulls: Dict[ObjectID, _ActivePull] = {}
+        self._outbound_lock = threading.Lock()
+        # (oid bytes, puller hex) -> last chunk ts; and -> [distinct
+        # offsets served (offset -> bytes; retries count once), last ts]
+        # for the coverage ledger (ts drives TTL/eviction so a crashed
+        # puller's entry can't exempt it from the gate forever).
+        self._outbound_last_seen: Dict[Tuple[bytes, str], float] = {}
+        self._outbound_chunks: Dict[
+            Tuple[bytes, str], List[Any]] = {}  # [Dict[int, int], float]
+        # oid bytes -> {holder hex: ts} — redirect hints, TTL-expired so a
+        # holder that later evicts the object stops being advertised.
+        self._completed_pullers: Dict[bytes, Dict[str, float]] = {}
+        self._chunk_serve_delay_s = 0.0   # sender occupancy per chunk
+        self._chunk_fetch_delay_s = 0.0   # per-RPC RTT on the pull side
+        # Sealed replicas whose directory announcement failed (GCS outage
+        # mid-pull): re-announced by the heartbeat loop, otherwise the
+        # node would stay listed as a stale `partial` location forever.
+        self._unannounced_objects: Dict[ObjectID, int] = {}
+        # Aborted pulls whose partial-location deregistration is pending:
+        # drained by the heartbeat loop, since a lost fire-and-forget
+        # remove would advertise this node as a partial holder forever
+        # (and keep later pulls of a lost object from fast-aborting).
+        self._stale_partials: Set[ObjectID] = set()
+        self.server.register_raw("pull_object_chunk", self._serve_chunk_raw)
         # Local clients blocked on an object (event-driven get: the raylet
         # pushes object_ready/object_unavailable instead of clients polling).
         self._object_waiters: Dict[ObjectID, List[Connection]] = defaultdict(list)
@@ -545,12 +706,18 @@ class Raylet:
         period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
         while not self._stopped.wait(period):
             try:
+                # Version BEFORE snapshot: a resource delta racing this
+                # heartbeat snapshots after its version bump, so whichever
+                # state is fresher always carries the strictly newer
+                # version — snapshotting first could pair an old snapshot
+                # with the delta's new version and silently revert it.
+                version = self._resource_version
                 total, avail = self.resources.snapshot()
                 resp = self.gcs.call(
                     "heartbeat",
                     {"node_id": self.node_id, "resources_available": avail,
                      "resources_total": total,
-                     "resource_version": self._resource_version,
+                     "resource_version": version,
                      "pending_demand": self._pending_demand()},
                     timeout=5,
                 )
@@ -558,6 +725,41 @@ class Raylet:
                     # A GCS that restarted without persisted node state (or
                     # that marked us dead during the outage): re-announce.
                     self._register_with_gcs(self.gcs)
+                with self._lock:
+                    unannounced = list(self._unannounced_objects.items())
+                    self._unannounced_objects.clear()
+                for i, (oid, size) in enumerate(unannounced):
+                    if not self.store.contains(oid):
+                        continue
+                    try:
+                        self.gcs.call(
+                            "object_location_add",
+                            {"object_id": oid, "node_id": self.node_id,
+                             "size": size}, timeout=5)
+                    except Exception:  # noqa: BLE001 — retry next beat
+                        # First failure: re-queue the REST and stop — N
+                        # sequential 5s timeouts against a flaky GCS would
+                        # stall this thread past the node-death threshold.
+                        with self._lock:
+                            for o, s in unannounced[i:]:
+                                self._unannounced_objects[o] = s
+                        break
+                with self._lock:
+                    stale = list(self._stale_partials)
+                for oid in stale:
+                    if self.store.contains(oid):
+                        with self._lock:
+                            self._stale_partials.discard(oid)
+                        continue  # re-pulled since: now a real location
+                    try:
+                        self.gcs.call(
+                            "object_location_remove",
+                            {"object_id": oid, "node_id": self.node_id,
+                             "partial": True}, timeout=5)
+                        with self._lock:
+                            self._stale_partials.discard(oid)
+                    except Exception:  # noqa: BLE001 — retry next beat,
+                        break          # same stall rationale as above
                 with self._lock:
                     events = list(self._task_event_buffer)
                     self._task_event_buffer.clear()
@@ -789,12 +991,21 @@ class Raylet:
         # lease or finishing task still holds its CPU, and bouncing the
         # task off-data to "ready" nodes costs a multi-MB pull — feasible
         # is enough, the data node queues it for the next free worker.
+        # Feasible-only locality needs the streamed-gossip freshness
+        # argument above; with gossip disabled (heartbeat-only views, up
+        # to a full period stale) a merely-feasible data node may be
+        # saturated for seconds, so fall back to requiring available-now.
+        gossip_on = GLOBAL_CONFIG.resource_delta_min_interval_ms > 0
+
+        def locality_ok(entry):
+            return feasible(entry) and (gossip_on or available_now(entry))
+
         best_data = self._best_data_node(spec)
-        if best_data == my_hex and local is not None and feasible(local):
+        if best_data == my_hex and local is not None and locality_ok(local):
             return my_hex  # the bytes are HERE: keep it, don't bounce
         if best_data is not None and best_data != my_hex:
             entry = view.get(best_data)
-            if entry is not None and entry.get("alive") and feasible(entry):
+            if entry is not None and entry.get("alive") and locality_ok(entry):
                 return best_data
         if local is not None and feasible(local) and available_now(local):
             return my_hex
@@ -1379,56 +1590,82 @@ class Raylet:
                 self._on_object_local(oid)
                 return
             my_hex = self.node_id.hex()
-            for node_id in entry.get("nodes", []):
-                if node_id.hex() == my_hex:
-                    with self._lock:
-                        self._pulls_inflight.discard(oid)
-                    self._on_object_local(oid)
-                    return
-                addr = self._cluster_view.get(node_id.hex(), {}).get("address")
-                if addr is None:
-                    try:
-                        addr = next(n["RayletAddress"] for n in self.gcs.call("get_nodes")
-                                    if n["NodeID"] == node_id.hex() and n["Alive"])
-                    except StopIteration:
-                        continue
-                try:
-                    if self._pull_from_peer(oid, addr):
-                        self.gcs.call("object_location_add",
-                                      {"object_id": oid, "node_id": self.node_id,
-                                       "size": entry.get("size", 0)}, timeout=10)
-                        with self._lock:
-                            self._pulls_inflight.discard(oid)
-                        self._on_object_local(oid)
-                        return
-                except Exception:
-                    logger.warning("pull of %s from %s failed", oid, addr, exc_info=True)
-            # Every advertised location failed (or there were none): wake
-            # blocked owners so they can reconstruct instead of hanging.
+            if any(n.hex() == my_hex for n in entry.get("nodes", [])):
+                with self._lock:
+                    self._pulls_inflight.discard(oid)
+                self._on_object_local(oid)
+                return
+            ok = False
+            try:
+                ok = self._pull_object_pipelined(oid, entry)
+            except Exception:  # noqa: BLE001 — includes ObjectStoreFullError
+                logger.warning("pull of %s failed", oid, exc_info=True)
             with self._lock:
                 self._pulls_inflight.discard(oid)
-            self._notify_object_waiters(oid, "object_unavailable")
+            if ok:
+                self._on_object_local(oid)
+            else:
+                # Every advertised location failed (or there were none):
+                # wake blocked owners so they can reconstruct, not hang.
+                self._notify_object_waiters(oid, "object_unavailable")
         except Exception:
             with self._lock:
                 self._pulls_inflight.discard(oid)
             logger.exception("pull worker failed for %s", oid)
 
-    def _pull_from_peer(self, oid: ObjectID, addr: str) -> bool:
-        """Stream one object from a peer raylet in bounded chunks.
+    def _pull_object_pipelined(self, oid: ObjectID, entry: Dict[str, Any]) -> bool:
+        """Windowed, multi-source chunk fetch into a pre-created buffer.
 
-        The reference moves objects as flow-controlled chunk streams
-        (`object_manager.h:206`, `object_buffer_pool.h`) so a 1 GiB object
-        never materializes as a single RPC frame on either side; same here:
-        per-chunk RPCs into a pre-created store buffer.
+        The reference moves objects as flow-controlled chunk streams with
+        multiple chunks in flight (`object_manager.h:206`,
+        `object_buffer_pool.h`); same here, plus location-aware striping:
+        `object_transfer_window` chunk requests stay pipelined at all
+        times, spread round-robin across EVERY advertised location (full
+        and partial), and the location set refreshes as the pull runs so
+        peers that finish their own pulls become sources mid-transfer.
         """
-        chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
-        peer = self._peer(addr)
-        first = peer.call("pull_object",
-                          {"object_id": oid, "offset": 0, "length": chunk},
-                          timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
-        if first.get("data") is None:
-            return False
-        size = first.get("size", len(first["data"]))
+        chunk_bytes = max(1, GLOBAL_CONFIG.object_transfer_chunk_bytes)
+        window = max(1, GLOBAL_CONFIG.object_transfer_window)
+        my_hex = self.node_id.hex()
+        peers = _PeerSet(max(1, GLOBAL_CONFIG.object_transfer_max_peers))
+        self._add_entry_peers(peers, entry, my_hex)
+
+        size = int(entry.get("size") or 0)
+        first_data: Optional[memoryview] = None
+        if size <= 0:
+            # Directory entry without a size: learn it from chunk 0.
+            # Busy senders are retried with backoff (consuming their
+            # redirect hints) — a busy seed must delay discovery, not
+            # fail the pull outright.
+            probe_deadline = time.monotonic() + 5.0
+            while size <= 0 and time.monotonic() < probe_deadline:
+                progress = False
+                for addr in peers.snapshot():
+                    try:
+                        meta, data, _ = self._fetch_chunk(addr, oid, 0,
+                                                          chunk_bytes)
+                    except Exception:  # noqa: BLE001
+                        peers.drop(addr)
+                        continue
+                    st = meta.get("st")
+                    if st == "ok":
+                        size = int(meta["s"])
+                        first_data = data
+                        break
+                    if st == "busy":
+                        progress = True  # alive sender: worth retrying
+                        for alt in meta.get("alt") or ():
+                            peers.add(self._addr_for_node(alt))
+                    elif meta.get("s"):
+                        size = int(meta["s"])  # partial holder knows size
+                        break
+                if size <= 0:
+                    if not progress and not self._refresh_pull_peers(
+                            oid, peers, my_hex):
+                        break
+                    time.sleep(0.05)
+            if size <= 0:
+                return False
         if self.store.contains(oid):
             return True
         try:
@@ -1439,31 +1676,265 @@ class Raylet:
             with self._lock:
                 self._pull_errors[oid] = str(e)
             raise
+        state = _ActivePull(buf, size, chunk_bytes)
+        with self._lock:
+            self._active_pulls[oid] = state
         ok = False
         try:
             from ray_tpu._native import copy_at
 
-            data = first["data"]
-            copy_at(buf, 0, data[:size] if len(data) > size else data)
-            pos = min(len(data), size)
-            while pos < size:
-                resp = peer.call(
-                    "pull_object",
-                    {"object_id": oid, "offset": pos, "length": chunk},
-                    timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
-                data = resp.get("data")
-                if not data:
-                    return False
-                copy_at(buf, pos, data)
-                pos += len(data)
-            self.store.seal(oid)
-            ok = True
-            with self._lock:
-                self._pull_errors.pop(oid, None)
-            return True
+            if first_data is not None:
+                n = min(len(first_data), size)
+                copy_at(buf, 0, first_data[:n])
+                state.mark_done(0)
+            # Advertise the in-progress copy: later pullers stripe their
+            # reads across us for the chunks we already hold, turning an
+            # N-node broadcast into a tree instead of N unicasts from the
+            # seed (the directory returns us under `partial_nodes`).
+            try:
+                self.gcs.call_async(
+                    "object_location_add",
+                    {"object_id": oid, "node_id": self.node_id,
+                     "size": size, "partial": True})
+            except Exception:  # noqa: BLE001 — advisory
+                pass
+            nchunks = max(1, -(-size // chunk_bytes))
+            work = [i for i in range(nchunks) if i not in state.done]
+            # Random chunk order per puller (BitTorrent's rarest-first
+            # rationale): concurrent pullers fetching 0..N in lockstep
+            # would hold identical prefixes and have nothing to trade —
+            # disjoint early chunk sets are what make the partial-holder
+            # swarm actually drain load off the seed.
+            random.shuffle(work)
+            plan = {
+                "lock": threading.Lock(),
+                "work": deque(work),
+                "completed": len(state.done),
+                "last_progress": time.monotonic(),
+                "abort": None,
+            }
+            # Stall-based abort, not a fixed bandwidth floor: as long as
+            # chunks keep landing the pull may take as long as it takes
+            # (a healthy 10 MB/s WAN link must not be declared dead);
+            # only rpc_call_timeout_s with zero progress aborts.
+            stall_s = GLOBAL_CONFIG.rpc_call_timeout_s
+            n_workers = min(window, max(1, len(plan["work"])))
+            threads = [
+                threading.Thread(
+                    target=self._pull_chunk_worker,
+                    args=(oid, state, peers, plan, stall_s),
+                    name=f"pull-{oid.hex()[:8]}-{i}", daemon=True)
+                for i in range(n_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if plan["abort"] is not None:
+                logger.warning("pull of %s aborted: %s", oid, plan["abort"])
+            ok = plan["abort"] is None and len(state.done) >= nchunks
+            if ok:
+                self.store.seal(oid)
+                with self._lock:
+                    self._pull_errors.pop(oid, None)
+                try:
+                    self.gcs.call("object_location_add",
+                                  {"object_id": oid, "node_id": self.node_id,
+                                   "size": size}, timeout=10)
+                except Exception:  # noqa: BLE001 — heartbeat re-announces
+                    with self._lock:
+                        self._unannounced_objects[oid] = size
+            return ok
         finally:
+            with self._lock:
+                self._active_pulls.pop(oid, None)
             if not ok:
+                try:
+                    # Drop our export first so delete() can close+unlink the
+                    # segment cleanly (workers have all joined by here).
+                    buf.release()
+                except Exception:  # noqa: BLE001
+                    pass
                 self.store.delete(oid)  # never leave an unsealed buffer
+                # Prompt best-effort deregistration; the heartbeat loop
+                # retries until a remove definitely landed.
+                with self._lock:
+                    self._stale_partials.add(oid)
+                try:
+                    self.gcs.call(
+                        "object_location_remove",
+                        {"object_id": oid, "node_id": self.node_id,
+                         "partial": True}, timeout=5)
+                    with self._lock:
+                        self._stale_partials.discard(oid)
+                except Exception:  # noqa: BLE001 — heartbeat retries
+                    pass
+
+    def _pull_chunk_worker(self, oid: ObjectID, state: _ActivePull,
+                           peers: _PeerSet, plan: Dict[str, Any],
+                           stall_s: float):
+        """One window slot: keeps exactly one chunk request in flight,
+        drawing indices from the shared work queue until drained/abort.
+        W slots over one peer connection = W pipelined requests (message
+        ids multiplex), so per-chunk RTT no longer serializes the pull."""
+        from ray_tpu._native import copy_at
+
+        refetch_every = max(
+            1, GLOBAL_CONFIG.object_transfer_refetch_location_chunks)
+        my_hex = self.node_id.hex()
+        while True:
+            with plan["lock"]:
+                if plan["abort"] is not None or not plan["work"]:
+                    return
+                idx = plan["work"].popleft()
+            offset = idx * state.chunk_bytes
+            length = min(state.chunk_bytes, state.size - offset)
+            attempts = 0
+            while True:
+                with plan["lock"]:
+                    stalled = (time.monotonic() - plan["last_progress"]
+                               > stall_s)
+                if stalled:
+                    with plan["lock"]:
+                        plan["abort"] = (
+                            f"no progress for {stall_s:.0f}s "
+                            f"(stuck on chunk {idx})")
+                    return
+                addr = peers.next()
+                if addr is None:
+                    if not self._refresh_pull_peers(oid, peers, my_hex):
+                        # A FRESH directory answer with zero locations:
+                        # the object is gone, not merely cooling down.
+                        with plan["lock"]:
+                            plan["abort"] = "no live locations remain"
+                        return
+                    if len(peers) == 0:
+                        # Sources exist but are in drop-cooldown (or the
+                        # directory is catching up): wait them out rather
+                        # than failing a pull whose sole holder had one
+                        # transient RPC error. The deadline bounds this.
+                        time.sleep(0.1)
+                    continue
+                try:
+                    meta, data, sunk = self._fetch_chunk(
+                        addr, oid, offset, length,
+                        sink=state.buf[offset: offset + length])
+                except Exception:  # noqa: BLE001 — peer died mid-pull
+                    peers.drop(addr)
+                    self._refresh_pull_peers(oid, peers, my_hex)
+                    continue
+                st = meta.get("st")
+                if st == "ok" and (sunk == length or len(data) == length):
+                    if not sunk:
+                        copy_at(state.buf, offset, data)
+                    state.mark_done(idx)
+                    with plan["lock"]:
+                        plan["completed"] += 1
+                        completed = plan["completed"]
+                        plan["last_progress"] = time.monotonic()
+                    if completed % refetch_every == 0:
+                        # Pick up sources that appeared mid-pull.
+                        self._refresh_pull_peers(oid, peers, my_hex)
+                    break
+                if st == "busy":
+                    # Sender sheds us: try the hinted holders first.
+                    for alt in meta.get("alt") or ():
+                        peers.add(self._addr_for_node(alt))
+                elif meta.get("gone"):
+                    # Peer no longer has ANY copy (evicted/deleted).
+                    peers.drop(addr)
+                # else "missing": a partial source that simply lacks this
+                # chunk yet — keep it for the chunks it does have.
+                attempts += 1
+                if attempts % max(1, len(peers) or 1) == 0:
+                    self._refresh_pull_peers(oid, peers, my_hex)
+                    time.sleep(0.02)  # every source busy/missing: back off
+
+    def _fetch_chunk(self, addr: str, oid: ObjectID, offset: int,
+                     length: int, sink: Optional[memoryview] = None,
+                     ) -> Tuple[Dict[str, Any], memoryview, int]:
+        """One chunk RPC. With `sink` (the chunk's slice of the store
+        buffer) a matching reply is received DIRECTLY into it — zero-copy
+        on the receive side; `sunk` reports the bytes landed there.
+        Returns (meta, spilled chunk bytes if not sunk, sunk)."""
+        if self._chunk_fetch_delay_s:
+            # Test/bench hook modeling per-RPC propagation latency: window
+            # slots sleep concurrently, so window>1 hides it exactly the
+            # way pipelining hides real RTT.
+            time.sleep(self._chunk_fetch_delay_s)
+        peer = self._peer(addr)
+        req = msgpack.packb({"o": oid.binary(), "f": offset, "l": length,
+                             "p": self.node_id.hex()})
+        if sink is not None:
+            raw, sunk = peer.call_raw_into(
+                "pull_object_chunk", req, sink,
+                timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
+        else:
+            raw = peer.call_raw("pull_object_chunk", req,
+                                timeout=GLOBAL_CONFIG.rpc_call_timeout_s)
+            sunk = 0
+        meta, data = _unpack_chunk_reply(raw)
+        return meta, data, sunk
+
+    def _addr_for_node(self, node_hex: str,
+                       nodes: Optional[List[Dict[str, Any]]] = None,
+                       ) -> Optional[str]:
+        """Raylet address of a node: gossiped view first, GCS fallback.
+        `nodes` is an optional pre-fetched get_nodes() answer so batch
+        resolution pays one directory round trip, not one per node."""
+        addr = self._cluster_view.get(node_hex, {}).get("address")
+        if addr:
+            return addr
+        if nodes is None:
+            try:
+                nodes = self.gcs.call("get_nodes")
+            except Exception:  # noqa: BLE001 — resolution is best-effort
+                return None
+        return next((n["RayletAddress"] for n in nodes
+                     if n["NodeID"] == node_hex and n["Alive"]), None)
+
+    def _add_entry_peers(self, peers: _PeerSet, entry: Dict[str, Any],
+                         my_hex: str) -> int:
+        """Resolve a directory entry's locations (full + partial) to raylet
+        addresses and add them as stripe sources. Returns the number of
+        advertised non-self locations (whether or not each add succeeded —
+        a cooling-down peer still counts as an advertised source)."""
+        hexes: List[str] = []
+        for n in list(entry.get("nodes") or ()) + \
+                list(entry.get("partial_nodes") or ()):
+            h = n.hex() if hasattr(n, "hex") else str(n)
+            if h != my_hex:
+                hexes.append(h)
+        nodes_cache = None
+        for h in hexes:
+            addr = self._cluster_view.get(h, {}).get("address")
+            if addr is None:
+                if nodes_cache is None:
+                    try:
+                        nodes_cache = self.gcs.call("get_nodes")
+                    except Exception:  # noqa: BLE001
+                        nodes_cache = []
+                addr = self._addr_for_node(h, nodes_cache)
+            peers.add(addr)
+        return len(hexes)
+
+    def _refresh_pull_peers(self, oid: ObjectID, peers: _PeerSet,
+                            my_hex: str) -> bool:
+        """Re-query the directory for locations that appeared since the
+        pull started (rate-limited across this pull's workers). Returns
+        False only when a FRESH directory answer advertises no location at
+        all — a peer in drop-cooldown or a failed/rate-limited query is
+        'undecided' (True), and the pull deadline bounds how long workers
+        keep waiting on undecided sources."""
+        if not peers.may_refresh():
+            return True  # rate-limited: undecided
+        try:
+            entry = self.gcs.call("object_locations_get",
+                                  {"object_id": oid}, timeout=5)
+        except Exception:  # noqa: BLE001 — GCS unreachable: undecided
+            return True
+        advertised = self._add_entry_peers(peers, entry, my_hex)
+        return len(peers) > 0 or advertised > 0
 
     def _peer(self, address: str) -> RpcClient:
         with self._lock:
@@ -1473,8 +1944,158 @@ class Raylet:
                 self._peer_clients[address] = client
             return client
 
+    # A puller with no chunk served for this long no longer counts against
+    # the sender-side concurrency gate (its transfer finished or died).
+    _OUTBOUND_ACTIVE_S = 2.0
+    # Redirect hints expire: a node that pulled the object from us may
+    # have evicted it since, and shedding pullers to a non-holder wedges
+    # them between a busy seed and a dead-end hint.
+    _HINT_TTL_S = 30.0
+    # Coverage-ledger entries idle this long belong to dead/finished
+    # transfers — pruned so they can't exempt a restarted puller from
+    # the gate or pin the ledger at its size cap.
+    _COVERAGE_TTL_S = 60.0
+
+    def _admit_puller(self, oid: ObjectID,
+                      puller: Optional[str]) -> Optional[List[str]]:
+        """Sender-side fairness: None admits the request; a list of
+        redirect hints (node hexes that already pulled the full object
+        from us) means 'busy'. A puller mid-transfer is always admitted,
+        the gate is per object, and a new puller is only shed when there
+        IS an alternative holder to hint at — shedding with nowhere to go
+        would fail pulls of an object whose sole copy lives here. So N
+        simultaneous pullers self-organize into a tree instead of
+        convoying on one NIC, and a lone source still serves everyone."""
+        limit = GLOBAL_CONFIG.object_transfer_sender_concurrency
+        if not limit or not puller:
+            return None
+        oid_b = oid.binary()
+        now = time.monotonic()
+        with self._outbound_lock:
+            for k, ts in list(self._outbound_last_seen.items()):
+                if now - ts > self._OUTBOUND_ACTIVE_S:
+                    del self._outbound_last_seen[k]
+            for k, rec in list(self._outbound_chunks.items()):
+                if now - rec[1] > self._COVERAGE_TTL_S:
+                    del self._outbound_chunks[k]
+            key = (oid_b, puller)
+            active = sum(1 for (o, p) in self._outbound_last_seen
+                         if o == oid_b and p != puller)
+            alts = self._fresh_hints_locked(oid_b, puller, now)
+            # _outbound_chunks membership exempts SLOW mid-transfer
+            # pullers whose per-chunk cadence exceeds the activity
+            # window — "mid-transfer is always admitted" must hold on a
+            # trickling WAN link too, not just on fast LANs.
+            if (key in self._outbound_last_seen
+                    or key in self._outbound_chunks
+                    or active < limit or not alts):
+                self._outbound_last_seen[key] = now
+                return None
+            return alts
+
+    def _fresh_hints_locked(self, oid_b: bytes, puller: str,
+                            now: float) -> List[str]:
+        """Non-expired redirect hints for an object (caller holds
+        _outbound_lock); expired holders are pruned in place."""
+        holders = self._completed_pullers.get(oid_b)
+        if not holders:
+            return []
+        for h, ts in list(holders.items()):
+            if now - ts > self._HINT_TTL_S:
+                del holders[h]
+        if not holders:
+            self._completed_pullers.pop(oid_b, None)
+            return []
+        return [h for h in holders if h != puller]
+
+    def _record_outbound(self, oid: ObjectID, puller: Optional[str],
+                         offset: int, nbytes: int, size: int):
+        """Per-puller coverage bookkeeping feeding the fairness gate's
+        redirect hints. With the gate disabled nothing ever reads these
+        tables — and nothing prunes them — so record nothing."""
+        if not puller or not GLOBAL_CONFIG.object_transfer_sender_concurrency:
+            return
+        key = (oid.binary(), puller)
+        now = time.monotonic()
+        with self._outbound_lock:
+            self._outbound_last_seen[key] = now
+            if len(self._outbound_chunks) >= 1024 and \
+                    key not in self._outbound_chunks:
+                # Evict the LEAST-RECENTLY-ACTIVE entry, not the oldest
+                # insertion — a live trickling puller must keep its
+                # coverage record under sustained many-object load.
+                self._outbound_chunks.pop(min(
+                    self._outbound_chunks,
+                    key=lambda k: self._outbound_chunks[k][1]))
+            rec = self._outbound_chunks.setdefault(key, [{}, now])
+            offsets = rec[0]
+            rec[1] = now
+            offsets[offset] = max(offsets.get(offset, 0), nbytes)
+            # Distinct-coverage completion: a re-served chunk counts once,
+            # so retries can't mark a partial puller as a full holder.
+            if sum(offsets.values()) >= size:
+                self._outbound_chunks.pop(key, None)
+                if len(self._completed_pullers) >= 256:
+                    self._completed_pullers.pop(
+                        next(iter(self._completed_pullers)))
+                holders = self._completed_pullers.setdefault(
+                    oid.binary(), {})
+                if len(holders) < 16 or puller in holders:
+                    holders[puller] = time.monotonic()
+
+    def _serve_chunk_raw(self, conn: Connection, payload: bytes):
+        """Raw-RPC chunk server (`pull_object_chunk`): serves a slice of a
+        sealed object — or of an in-progress pull whose covering chunks
+        already landed — as a memoryview of the store segment. The reply
+        is sent inside the handler (DEFERRED) so the segment stays pinned
+        for exactly the duration of the vectored zero-copy write."""
+        req = msgpack.unpackb(payload)
+        oid = ObjectID(req["o"])
+        offset = int(req["f"])
+        length = int(req["l"])
+        puller = req.get("p")
+        if self._chunk_serve_delay_s:
+            time.sleep(self._chunk_serve_delay_s)  # test/bench RTT hook
+        alts = self._admit_puller(oid, puller)
+        if alts is not None:
+            return _pack_chunk_reply({"st": "busy", "alt": alts})
+        msg_id = conn.current_msg_id
+        self.store.pin(oid)
+        try:
+            buf = self.store.get_buffer(oid)
+            size = len(buf) if buf is not None else 0
+            if buf is None:
+                state = self._active_pulls.get(oid)
+                if state is not None and state.covers(offset, length):
+                    buf, size = state.buf, state.size
+                elif state is not None:
+                    return _pack_chunk_reply({"st": "missing", "s": state.size})
+                else:
+                    # Re-check the store: our own pull may have sealed (and
+                    # popped _active_pulls) between the two lookups — a
+                    # spurious `gone` would permanently blacklist us in
+                    # the requester's peer set.
+                    buf = self.store.get_buffer(oid)
+                    if buf is None:
+                        return _pack_chunk_reply({"st": "missing",
+                                                  "gone": True})
+                    size = len(buf)
+            if offset >= size:
+                return _pack_chunk_reply({"st": "missing", "s": size})
+            end = min(offset + length, size) if length else size
+            self._record_outbound(oid, puller, offset, end - offset, size)
+            conn.reply_raw(msg_id, "pull_object_chunk",
+                           _pack_chunk_reply({"st": "ok", "s": size},
+                                             buf[offset:end]))
+            return DEFERRED
+        finally:
+            self.store.unpin(oid)
+
     def handle_pull_object(self, conn: Connection, data: Dict[str, Any]):
-        """Serve one chunk (or, without offset, the whole object)."""
+        """Legacy pickled transfer surface: one chunk (or, without offset,
+        the whole object). The pipelined puller speaks the raw
+        `pull_object_chunk` method instead; this stays for debug tooling
+        and mixed-version peers."""
         oid: ObjectID = data["object_id"]
         buf = self.store.get_buffer(oid)
         if buf is None:

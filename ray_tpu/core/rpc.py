@@ -39,30 +39,73 @@ class ConnectionLost(RaySystemError):
     pass
 
 
-def _send_msg(sock: socket.socket, envelope: dict, payload: bytes, lock: threading.Lock):
+def _as_view(p) -> memoryview:
+    v = p if isinstance(p, memoryview) else memoryview(p)
+    if v.format != "B" or v.ndim != 1:
+        v = v.cast("B") if v.contiguous else memoryview(bytes(v))
+    return v
+
+
+def _sendall_vectored(sock: socket.socket, views: list):
+    """sendall over a list of buffers without concatenating them (one
+    gather syscall per iteration; partial sends trim the head view)."""
+    views = [v for v in views if v.nbytes]
+    while views:
+        sent = sock.sendmsg(views)
+        while sent:
+            if sent >= views[0].nbytes:
+                sent -= views[0].nbytes
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+# Below this, one sendall of a joined frame beats the sendmsg setup cost.
+_VECTOR_MIN_BYTES = 64 * 1024
+
+
+def _send_msg(sock: socket.socket, envelope: dict, payload, lock: threading.Lock):
+    """Frame and send one message. `payload` is bytes, a memoryview, or a
+    list of buffer parts — large parts are sent with a vectored gather
+    write, so chunk payloads (memoryview slices of sealed store segments)
+    reach the socket without an intermediate copy."""
     env = msgpack.packb(envelope)
-    frame = _HDR.pack(len(env) + 4 + len(payload)) + _HDR.pack(len(env)) + env + payload
+    parts = payload if isinstance(payload, (list, tuple)) else (payload,)
+    views = [_as_view(p) for p in parts]
+    plen = sum(v.nbytes for v in views)
+    hdr = _HDR.pack(len(env) + 4 + plen) + _HDR.pack(len(env)) + env
     with lock:
-        sock.sendall(frame)
+        if plen < _VECTOR_MIN_BYTES:
+            sock.sendall(hdr + b"".join(views))
+        else:
+            _sendall_vectored(sock, [memoryview(hdr), *views])
+
+
+def _recv_into_exact(sock: socket.socket, view: memoryview):
+    """Fill `view` completely from the socket (single-copy receive)."""
+    pos = 0
+    n = view.nbytes
+    while pos < n:
+        r = sock.recv_into(view[pos:])
+        if r == 0:
+            raise ConnectionLost("peer closed connection")
+        pos += r
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n > 0:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            raise ConnectionLost("peer closed connection")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+    buf = bytearray(n)
+    _recv_into_exact(sock, memoryview(buf))
+    return bytes(buf)
 
 
 def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
     (total,) = _HDR.unpack(_recv_exact(sock, 4))
-    body = _recv_exact(sock, total)
+    body = memoryview(bytearray(total))
+    _recv_into_exact(sock, body)
     (elen,) = _HDR.unpack(body[:4])
     envelope = msgpack.unpackb(body[4 : 4 + elen])
-    return envelope, body[4 + elen :]
+    return envelope, bytes(body[4 + elen :])
 
 
 # Handler return sentinel: the response will be sent later by the handler
@@ -97,6 +140,18 @@ class Connection:
             payload = serialization.dumps_ctrl(data)
         try:
             _send_msg(self.sock, env, payload, self.send_lock)
+        except OSError as e:
+            self.alive = False
+            raise ConnectionLost(str(e))
+
+    def reply_raw(self, msg_id: int, method: str, payload):
+        """Send a raw-bytes response for a DEFERRED raw request. `payload`
+        may be a list of buffer parts (vectored, zero-copy) — used by the
+        object transfer plane so a handler can hold a pin on the store
+        segment for exactly the duration of the send."""
+        try:
+            _send_msg(self.sock, {"i": msg_id, "k": "resp", "m": method},
+                      payload, self.send_lock)
         except OSError as e:
             self.alive = False
             raise ConnectionLost(str(e))
@@ -215,6 +270,8 @@ class RpcServer:
                     if raw is not None:
                         conn.current_msg_id = envelope["i"]
                         out = raw(conn, payload)
+                        if out is DEFERRED:
+                            continue  # handler replied via conn.reply_raw()
                         _send_msg(conn.sock, resp_env, out, conn.send_lock)
                         continue
                     if handler is None:
@@ -336,16 +393,81 @@ class RpcClient:
             except Exception:
                 logger.exception("%s push handler failed", self._name)
 
+    # Frames at or below this read as one recv (the control-plane common
+    # case); larger frames parse the envelope first so chunk payloads can
+    # stream straight into a registered sink buffer.
+    _INLINE_FRAME_MAX = 64 * 1024
+
+    def _peek_slot(self, envelope: dict) -> Optional[dict]:
+        if envelope["k"] != "resp":
+            return None
+        with self._pending_lock:
+            return self._pending.get(envelope["i"])
+
+    def _read_one(self) -> Tuple[dict, bytes]:
+        """Read one message — large-frame payloads land directly in a
+        response's registered sink buffer when one matches (the zero-copy
+        receive half of the transfer plane: chunk bytes go into the
+        pre-created store segment with no intermediate buffers).
+
+        The pending slot is only PEEKED here, never popped: if the
+        connection dies mid-payload, the caller's slot must still be in
+        _pending so the reader's drain delivers ConnectionLost (a popped
+        slot would strand the caller until TimeoutError, skipping
+        ReconnectingClient's re-dial path)."""
+        (total,) = _HDR.unpack(_recv_exact(self._sock, 4))
+        if total <= self._INLINE_FRAME_MAX:
+            body = memoryview(bytearray(total))
+            _recv_into_exact(self._sock, body)
+            (elen,) = _HDR.unpack(body[:4])
+            envelope = msgpack.unpackb(body[4: 4 + elen])
+            payload = bytes(body[4 + elen:])
+            slot = self._peek_slot(envelope)
+            sink = slot.get("sink") if slot is not None else None
+            if sink is not None and not envelope.get("e") and len(payload) > 4:
+                # Tiny chunk (single-recv frame): honor the sink contract
+                # with an explicit copy so callers see a uniform API.
+                (mlen,) = _HDR.unpack(payload[:4])
+                rest = len(payload) - 4 - mlen
+                if rest == sink.nbytes and rest > 0:
+                    sink[:] = memoryview(payload)[4 + mlen:]
+                    slot["sunk"] = rest
+                    payload = payload[: 4 + mlen]
+            return envelope, payload
+        (elen,) = _HDR.unpack(_recv_exact(self._sock, 4))
+        envelope = msgpack.unpackb(_recv_exact(self._sock, elen))
+        plen = total - 4 - elen
+        slot = self._peek_slot(envelope)
+        sink = slot.get("sink") if slot is not None else None
+        if sink is not None and not envelope.get("e") and plen > 4:
+            # Sink framing: [4B meta len][meta][chunk]. When the chunk part
+            # is exactly the sink's size, it is received in place and the
+            # returned payload carries only the meta prefix.
+            hdr = _recv_exact(self._sock, 4)
+            (mlen,) = _HDR.unpack(hdr)
+            meta = _recv_exact(self._sock, min(mlen, plen - 4))
+            rest = plen - 4 - len(meta)
+            if rest == sink.nbytes:
+                _recv_into_exact(self._sock, sink)
+                slot["sunk"] = rest
+                return envelope, hdr + meta
+            return envelope, hdr + meta + _recv_exact(self._sock, rest)
+        return envelope, _recv_exact(self._sock, plen) if plen else b""
+
     def _read_loop(self):
         reason = "reader exited"
         try:
             while not self._closed.is_set():
-                envelope, payload = _recv_msg(self._sock)
+                envelope, payload = self._read_one()
                 kind = envelope["k"]
                 if kind == "resp":
                     with self._pending_lock:
                         slot = self._pending.pop(envelope["i"], None)
                     if slot is not None:
+                        # Drop the sink export NOW: this frame parks in
+                        # recv until the next message, and a lingering
+                        # memoryview would block the segment's close.
+                        slot.pop("sink", None)
                         cb = slot.get("cb")
                         if cb is not None:
                             # Async-call completion: runs ON the reader
@@ -374,6 +496,7 @@ class RpcClient:
                 pending = list(self._pending.values())
                 self._pending.clear()
             for slot in pending:
+                slot.pop("sink", None)
                 cb = slot.get("cb")
                 if cb is not None:
                     try:
@@ -432,14 +555,21 @@ class RpcClient:
                 return
             raise ConnectionLost(str(e))
 
-    def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
+    def _call_framed(self, method: str, payload,
+                     timeout: Optional[float],
+                     sink: Optional[memoryview] = None) -> Tuple[bytes, int]:
+        """Send one request payload (bytes or buffer parts) and block for
+        the raw response payload. Shared by call()/call_raw(). With
+        `sink`, a response whose chunk part matches the sink's size is
+        received directly into it; returns (payload, bytes_sunk)."""
         if self._closed.is_set():
             raise ConnectionLost(f"{self._name}: connection to {self.address} is closed")
         msg_id = next(self._msg_counter)
         slot = {"event": threading.Event()}
+        if sink is not None:
+            slot["sink"] = sink
         with self._pending_lock:
             self._pending[msg_id] = slot
-        payload = serialization.dumps_ctrl(data)
         try:
             _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method}, payload, self._send_lock)
         except OSError as e:
@@ -457,7 +587,31 @@ class RpcClient:
                 f"{self._name}: connection lost during RPC '{method}'")
         if env.get("e"):
             raise RaySystemError(f"RPC '{method}' failed remotely: {env['e']}")
-        return serialization.loads(slot["payload"]) if slot["payload"] else None
+        return slot["payload"], slot.get("sunk", 0)
+
+    def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
+        payload, _ = self._call_framed(method, serialization.dumps_ctrl(data), timeout)
+        return serialization.loads(payload) if payload else None
+
+    def call_raw(self, method: str, payload,
+                 timeout: Optional[float] = None) -> bytes:
+        """Raw-bytes RPC against a `register_raw` server handler: the
+        request payload (bytes or a list of buffer parts) travels verbatim
+        — no pickle on either side — and the handler's raw reply bytes are
+        returned. Safe to call concurrently from many threads: message ids
+        multiplex the in-flight requests, which is how the transfer plane
+        keeps a window of chunk fetches pipelined on one connection."""
+        out, _ = self._call_framed(method, payload, timeout)
+        return out
+
+    def call_raw_into(self, method: str, payload, sink: memoryview,
+                      timeout: Optional[float] = None) -> Tuple[bytes, int]:
+        """call_raw whose response chunk part is received DIRECTLY into
+        `sink` (a writable memoryview) when its size matches — the
+        receive-side half of zero-copy transfer. Returns (meta payload,
+        bytes written into sink); 0 means the reply didn't match the sink
+        (busy/missing/short) and any chunk bytes are in the payload."""
+        return self._call_framed(method, payload, timeout, sink=sink)
 
     def close(self):
         self._closed.set()

@@ -29,6 +29,51 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+# Above this size the opcode walk itself could cost milliseconds on the
+# submit hot path (many-opcode object graphs); callers pick the safe
+# answer for oversized payloads instead of scanning.
+_REFS_MAIN_SCAN_MAX = 256 * 1024
+
+
+def _refs_main(payload: bytes) -> bool:
+    """Does this pickle reference the __main__ MODULE (a by-reference
+    global, unresolvable on a peer) — as opposed to merely containing the
+    byte literal inside an embedded data blob (pre-serialized function
+    bytes ride inside TaskSpecs on every submit, and a substring hit
+    there must NOT force the 2.5x cloudpickle fallback)? The substring
+    scan is the cheap gate (no hit, no cost); on a hit, a pickletools
+    opcode walk looks for a standalone '__main__' string — module refs
+    surface as GLOBAL/unicode opcodes, while blob content stays inside a
+    single bytes-opcode argument. Errs toward cloudpickle on anything
+    unexpected. Payloads over the scan cap skip the walk and report True
+    unscanned: oversized hits are rare (function blobs that big are
+    unusual, args travel separately), and paying the cloudpickle fallback
+    there is safe — assuming 'blob content' would silently reopen the
+    peer-side AttributeError this guard exists to prevent.
+
+    Known tradeoff: the walk re-runs per message even for an identical
+    embedded blob (no memoization — control payloads are fresh bytes each
+    time, so a verdict cache would have to hash the payload, which costs
+    about as much as the walk it saves). Bounded by the scan cap."""
+    if b"__main__" not in payload:
+        return False
+    if len(payload) > _REFS_MAIN_SCAN_MAX:
+        return True
+    try:
+        import pickletools
+
+        for op, arg, _pos in pickletools.genops(payload):
+            name = op.name
+            if name == "GLOBAL":
+                if isinstance(arg, str) and arg.startswith("__main__"):
+                    return True
+            elif "UNICODE" in name and arg == "__main__":
+                return True
+        return False
+    except Exception:  # noqa: BLE001 — be safe, capture by value
+        return True
+
+
 def serialize(value: Any) -> List[memoryview | bytes]:
     """Serialize to a list of buffers (header + pickle + OOB buffers).
 
@@ -49,7 +94,7 @@ def serialize(value: Any) -> List[memoryview | bytes]:
     # plain dump outright and fall back the same way.
     try:
         payload = pickle.dumps(value, protocol=5, buffer_callback=callback)
-        if b"__main__" in payload:
+        if _refs_main(payload):
             raise ValueError("by-reference __main__ pickle")
     except Exception:  # noqa: BLE001 — retry by value
         oob.clear()
@@ -135,11 +180,17 @@ def dumps_ctrl(value: Any) -> bytes:
     only when plain pickle cannot (closures, locals). Safe because control
     messages carry framework types and PRE-SERIALIZED user blobs only —
     user functions/classes/args all flow as bytes produced by dumps()/
-    serialize() upstream, never as live objects."""
+    serialize() upstream, never as live objects. Same `__main__` guard as
+    serialize(): plain pickle captures driver-script types BY REFERENCE,
+    which dumps fine here and explodes peer-side with an AttributeError
+    nobody can act on — fall back to cloudpickle's by-value capture."""
     try:
-        return pickle.dumps(value, protocol=5)
-    except Exception:  # noqa: BLE001 — closure/local type in the envelope
+        payload = pickle.dumps(value, protocol=5)
+        if _refs_main(payload):
+            raise ValueError("by-reference __main__ pickle")
+    except Exception:  # noqa: BLE001 — closure/local/__main__ in envelope
         return cloudpickle.dumps(value)
+    return payload
 
 
 def loads(data: bytes) -> Any:

@@ -4,9 +4,10 @@ Stub-free protocol: unary bytes on `/ray_tpu.serve/<Deployment>`,
 msgpack-decodable bodies decoded for the deployment callable, routed
 through the same ReplicaDispatcher light lane as HTTP."""
 
-import grpc
-import msgpack
 import pytest
+
+grpc = pytest.importorskip("grpc")
+msgpack = pytest.importorskip("msgpack")
 
 import ray_tpu
 from ray_tpu import serve
