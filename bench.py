@@ -790,6 +790,119 @@ def bench_pull_pipelining(quick: bool) -> dict:
         f"{(proc.stderr or '')[-500:]}")
 
 
+def _collective_micro_main(payload_mb: int, world: int,
+                           link_mb_s: float) -> dict:
+    """Host-collective allreduce bandwidth microbench (runs in a
+    subprocess): rank actors pinned one per simulated node, star
+    (rendezvous actor, the legacy path) vs ring (`ray_tpu.collective`
+    over the transfer plane), under a modeled per-host link bandwidth
+    (`raylet._chunk_serve_bw_bps` serializes each node's chunk egress —
+    sleeps, not spins, so the modeled network dominates, the regime the
+    ring plane targets). The star funnels O(world x bytes) through the
+    hub's link; the ring moves 2(W-1)/W x bytes per link."""
+    import time as _time
+
+    import numpy as _np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG._overrides.update({
+        "object_transfer_chunk_bytes": 2 << 20,
+        "object_transfer_refetch_location_chunks": 2,
+        "collective_stall_timeout_s": 180.0,
+        "rpc_connect_timeout_s": 2.0,
+    })
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    for _ in range(world - 1):
+        cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    class Rank:
+        def __init__(self, rank, world_size, group_name, backend):
+            from ray_tpu.util.collective import init_collective_group
+
+            self.group = init_collective_group(
+                world_size, rank, group_name=group_name, backend=backend)
+
+        def allreduce_size(self, n_bytes):
+            # Payloads are created rank-locally, like real gradients.
+            x = _np.full(max(1, n_bytes // 4), float(self.group.rank + 1),
+                         dtype=_np.float32)
+            t0 = _time.perf_counter()
+            self.group.allreduce(x)
+            return _time.perf_counter() - t0
+
+    actor_cls = ray_tpu.remote(Rank)
+    out: dict = {"collective_payload_mb": payload_mb,
+                 "collective_world": world,
+                 "collective_link_mb_s": link_mb_s}
+    try:
+        for backend in ("star", "ring"):
+            ranks = [actor_cls.options(num_cpus=1).remote(
+                r, world, f"bench_{backend}", backend) for r in range(world)]
+            ray_tpu.get([a.allreduce_size.remote(1024) for a in ranks],
+                        timeout=120)  # spawn + join outside the timed window
+            for raylet in cluster.raylets:
+                raylet._chunk_serve_bw_bps = link_mb_s * 1e6
+            try:
+                t0 = _time.perf_counter()
+                ray_tpu.get(
+                    [a.allreduce_size.remote(payload_mb << 20)
+                     for a in ranks], timeout=600)
+                dt = _time.perf_counter() - t0
+            finally:
+                for raylet in cluster.raylets:
+                    raylet._chunk_serve_bw_bps = 0.0
+                for a in ranks:
+                    ray_tpu.kill(a)
+            out[f"collective_{backend}_s"] = round(dt, 3)
+            out[f"collective_{backend}_gb_s"] = round(
+                (payload_mb << 20) / dt / 1e9, 4)
+    finally:
+        cluster.shutdown()
+    out["collective_ring_speedup"] = round(
+        out["collective_star_s"] / out["collective_ring_s"], 3)
+    return out
+
+
+def bench_collective(quick: bool) -> dict:
+    """Subprocess-isolated star-vs-ring allreduce bench (its fake cluster
+    must not touch the bench's own runtime). Full mode adds a second
+    payload/world point."""
+    import json as _json
+    import subprocess
+    import sys
+
+    points = [(64, 4)] if quick else [(64, 4), (8, 2)]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    out: dict = {}
+    for payload_mb, world in points:
+        code = ("import bench, json; "
+                f"print('COLL_RESULT ' + json.dumps(bench._collective_micro_main"
+                f"({payload_mb}, {world}, 25.0)))")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=900,
+                              cwd=os.path.dirname(os.path.abspath(__file__)),
+                              env=env)
+        point = None
+        for line in (proc.stdout or "").splitlines():
+            if line.startswith("COLL_RESULT "):
+                point = _json.loads(line[len("COLL_RESULT "):])
+        if point is None:
+            raise RuntimeError(
+                f"collective microbench failed (rc={proc.returncode}): "
+                f"{(proc.stderr or '')[-500:]}")
+        suffix = "" if (payload_mb, world) == points[0] \
+            else f"_{payload_mb}mb_w{world}"
+        out.update({k + suffix: v for k, v in point.items()})
+    return out
+
+
 def bench_serve(quick: bool) -> dict:
     import concurrent.futures
     import json as _json
@@ -974,6 +1087,10 @@ def main(out=None):
             extra.update(bench_pull_pipelining(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["pull_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_collective(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["collective_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

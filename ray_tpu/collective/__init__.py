@@ -1,0 +1,82 @@
+"""ray_tpu.collective: host-RAM collectives over the transfer plane.
+
+Public surface mirrors the reference's `ray.util.collective`
+(init_collective_group / allreduce / allgather / broadcast /
+reducescatter / barrier / destroy_collective_group), backed by the
+GCS-registered group control plane and the pipelined object-transfer
+data plane. See docs/COLLECTIVE.md for algorithms, chunking, failure
+semantics and flags. `ray_tpu.util.collective` is a thin compatibility
+shim over this package.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.collective.buffer import PackedTree, tree_flatten, tree_index, tree_unflatten  # noqa: F401
+from ray_tpu.collective.group import (  # noqa: F401
+    CollectiveGroup,
+    RayletTransport,
+    RuntimeTransport,
+)
+from ray_tpu.exceptions import CollectiveError  # noqa: F401
+
+_groups: Dict[str, CollectiveGroup] = {}
+_lock = threading.Lock()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default",
+                          transport=None,
+                          stall_timeout_s: Optional[float] = None
+                          ) -> CollectiveGroup:
+    """Create-or-attach this process as `rank` of a named group.
+
+    The first caller creates the GCS group record; every later attach
+    must present the same world_size (ValueError otherwise — a stale
+    record can never silently skew an op). Raises CollectiveError when
+    attaching to a group broken by a member death.
+    """
+    group = CollectiveGroup(group_name, world_size, rank,
+                            transport=transport,
+                            stall_timeout_s=stall_timeout_s)
+    with _lock:
+        _groups[group_name] = group
+    return group
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    with _lock:
+        group = _groups.get(group_name)
+    if group is None:
+        raise ValueError(f"collective group '{group_name}' not initialized "
+                         "in this process")
+    return group
+
+
+def allreduce(value: Any, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(value, op)
+
+
+def allgather(value: Any, group_name: str = "default") -> List[Any]:
+    return get_group(group_name).allgather(value)
+
+
+def broadcast(value: Any, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(value, src_rank)
+
+
+def reducescatter(value: Any, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(value, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    get_group(group_name).barrier()
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()

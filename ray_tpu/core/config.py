@@ -109,6 +109,17 @@ _flag("object_transfer_sender_concurrency", int, 4,
 _flag("object_transfer_refetch_location_chunks", int, 8,
       "Re-query the object directory for new locations every N completed "
       "chunks during a pull (late-joining sources get picked up mid-pull)")
+_flag("collective_stall_timeout_s", float, 60.0,
+      "Host-collective abort horizon: an op waiting on a peer contribution "
+      "this long with no progress raises CollectiveError instead of "
+      "hanging (member death is detected by the GCS and aborts sooner)")
+_flag("collective_inline_max_bytes", int, 64 * 1024,
+      "Collective payloads at or below this size ride the GCS mailbox "
+      "inline instead of the object-transfer plane")
+_flag("collective_ring_min_bytes", int, 256 * 1024,
+      "Flat buffers below this total size allreduce via direct fan-in "
+      "(latency-bound regime); at or above, the bandwidth-optimal ring "
+      "reduce-scatter/all-gather runs over the transfer plane")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")

@@ -35,7 +35,7 @@ from ray_tpu.core.common import (
 )
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
-from ray_tpu.core.rpc import Connection, RpcClient, RpcServer
+from ray_tpu.core.rpc import DEFERRED, Connection, RpcClient, RpcServer
 from ray_tpu.exceptions import RaySystemError
 
 logger = logging.getLogger(__name__)
@@ -129,6 +129,12 @@ class GcsServer:
         self._node_resource_versions: Dict[NodeID, int] = {}
         # Explicit autoscaler.request_resources() bundles
         self.resource_requests: List[Dict[str, float]] = []
+        # Host-collective groups (reference `util/collective` GroupManager,
+        # centralized): name -> membership + refcounted mailbox + barriers.
+        # Ephemeral by design — never persisted (members fate-share with
+        # their GCS connection, so a restarted GCS means dead groups).
+        self.collectives: Dict[str, Dict[str, Any]] = {}
+        self._collective_epoch = 0
 
         # Raylet clients for GCS-initiated RPCs (actor creation, 2PC, deletes)
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
@@ -386,6 +392,16 @@ class GcsServer:
                                         ActorState.RESTARTING)]
         for actor in affected:
             self._on_actor_failure(actor, f"node {node_id.hex()[:12]} died: {reason}")
+        # Collective members registered from the dead node (heartbeat
+        # timeout path — their own GCS connections may still look alive).
+        with self._lock:
+            hits = [(rec["name"], rec["epoch"], r)
+                    for rec in self.collectives.values()
+                    for r, m in rec["members"].items()
+                    if m.get("node") == node_id.hex() and r not in rec["dead"]]
+        for name, epoch, rank in hits:
+            self._collective_mark_dead(
+                name, epoch, rank, f"node {node_id.hex()[:12]} died: {reason}")
         self._broadcast_resource_view()
 
     # -------------------------------------------------------- job management
@@ -450,6 +466,12 @@ class GcsServer:
             # that are still perfectly alive.
             return
         self.pubsub.drop_connection(conn)
+        # Collective members fate-share with their GCS connection: a
+        # killed worker/raylet process aborts its groups' in-flight ops
+        # now, not at a 300s client timeout.
+        for name, epoch, rank in list(conn.meta.get("collective_members", ())):
+            self._collective_mark_dead(name, epoch, rank,
+                                       "member connection lost")
         job_id = conn.meta.get("job_id")
         if job_id is not None:
             self._finish_job(job_id)
@@ -470,6 +492,234 @@ class GcsServer:
     def handle_publish(self, conn: Connection, data: Dict[str, Any]):
         self.pubsub.publish(data["channel"], data.get("key", b"*"), data["message"])
         return {}
+
+    # ---------------------------------------------------- host collectives
+    #
+    # Control plane of `ray_tpu.collective`: named groups (world_size
+    # validated on every attach, epoch bumped per incarnation), a
+    # refcounted mailbox for rank-to-rank handoff of small values and
+    # object ids (the bulk bytes ride the object transfer plane, never
+    # this table), and event-driven barriers. Take/barrier calls park via
+    # DEFERRED until fulfilled; a member death (its GCS connection drops,
+    # or its node is marked dead) immediately fails every parked call with
+    # the dead-rank map, so surviving ranks abort instead of hanging.
+
+    def _collective_rec_locked(self, name: str, epoch: int):
+        rec = self.collectives.get(name)
+        if rec is None or rec["epoch"] != epoch:
+            return None
+        return rec
+
+    @staticmethod
+    def _collective_new_slot() -> Dict[str, Any]:
+        return {"value": None, "consumers": 0, "waiters": [], "posted": False}
+
+    def _collective_reply(self, conn: Connection, msg_id: int, method: str,
+                          data: Dict[str, Any]):
+        try:
+            conn.reply(msg_id, method, data)
+        except Exception:  # noqa: BLE001 — waiter's conn died; its loss
+            pass           # is handled by its own disconnect path
+
+    def _collective_drain_waiters_locked(self, rec) -> List[tuple]:
+        """Collect (conn, msg_id, method) for every parked take/barrier of
+        a group and clear the parked state (caller replies outside the
+        lock)."""
+        out = []
+        for slot in rec["mailbox"].values():
+            out.extend((c, m, "collective_take") for c, m in slot["waiters"])
+            slot["waiters"] = []
+        for st in rec["barriers"].values():
+            out.extend((c, m, "collective_barrier") for c, m in st["waiters"])
+        rec["barriers"].clear()
+        return out
+
+    def handle_collective_join(self, conn: Connection, data: Dict[str, Any]):
+        """Create-or-attach: the first joiner creates the group record;
+        later joiners must present the SAME world_size (a stale record
+        with a different world_size is a hard error, never a hang) and a
+        free rank. Membership fate-shares with this connection."""
+        name, world = data["name"], int(data["world_size"])
+        rank = int(data["rank"])
+        if world <= 0 or not 0 <= rank < world:
+            return {"status": "bad_rank", "world_size": world}
+        with self._lock:
+            rec = self.collectives.get(name)
+            if rec is None:
+                self._collective_epoch += 1
+                rec = self.collectives[name] = {
+                    "name": name, "epoch": self._collective_epoch,
+                    "world_size": world, "members": {}, "dead": {},
+                    "mailbox": {}, "barriers": {},
+                }
+            if rec["world_size"] != world:
+                return {"status": "mismatch", "expected": rec["world_size"],
+                        "epoch": rec["epoch"]}
+            if rec["dead"]:
+                return {"status": "dead", "dead": dict(rec["dead"]),
+                        "epoch": rec["epoch"]}
+            member = rec["members"].get(rank)
+            if member is not None and member["conn"] is not conn:
+                return {"status": "rank_taken", "epoch": rec["epoch"]}
+            rec["members"][rank] = {"node": data.get("node_id"), "conn": conn}
+            conn.meta.setdefault("collective_members", set()).add(
+                (name, rec["epoch"], rank))
+            return {"status": "ok", "epoch": rec["epoch"],
+                    "world_size": rec["world_size"]}
+
+    def handle_collective_leave(self, conn: Connection, data: Dict[str, Any]):
+        """Graceful departure (teardown): removes the member WITHOUT
+        breaking the group — peers still draining their last op are not
+        aborted the way a death would."""
+        with self._lock:
+            rec = self._collective_rec_locked(data["name"], data["epoch"])
+            rank = int(data["rank"])
+            if rec is not None:
+                rec["members"].pop(rank, None)
+                if not rec["members"] and not rec["dead"]:
+                    # Last member left cleanly: GC the record so repeated
+                    # experiments don't accumulate group shells.
+                    self.collectives.pop(data["name"], None)
+            meta = conn.meta.get("collective_members")
+            if meta is not None:
+                meta.discard((data["name"], data["epoch"], rank))
+        return {"status": "ok"}
+
+    def handle_collective_get(self, conn: Connection, data: Dict[str, Any]):
+        with self._lock:
+            rec = self.collectives.get(data["name"])
+            if rec is None:
+                return {"known": False}
+            return {"known": True, "epoch": rec["epoch"],
+                    "world_size": rec["world_size"],
+                    "members": sorted(rec["members"]),
+                    "dead": dict(rec["dead"]),
+                    "mailbox_keys": len(rec["mailbox"]),
+                    "mailbox": [
+                        (k, s["posted"], s["consumers"], len(s["waiters"]))
+                        for k, s in rec["mailbox"].items()],
+                    "pending_barriers": len(rec["barriers"])}
+
+    def handle_collective_post(self, conn: Connection, data: Dict[str, Any]):
+        """Publish one mailbox value for `consumers` takers. The slot is
+        refcounted: each take decrements, and the slot is deleted when
+        drained — long-lived groups never accumulate consumed entries."""
+        with self._lock:
+            rec = self._collective_rec_locked(data["name"], data["epoch"])
+            if rec is None:
+                return {"status": "destroyed"}
+            if rec["dead"]:
+                return {"status": "dead", "dead": dict(rec["dead"])}
+            key = data["key"]
+            slot = rec["mailbox"].setdefault(key, self._collective_new_slot())
+            if slot["posted"]:
+                return {"status": "error",
+                        "error": f"duplicate collective post for {key!r}"}
+            slot["value"] = data["value"]
+            slot["consumers"] = int(data.get("consumers", 1))
+            slot["posted"] = True
+            replies = []
+            while slot["waiters"] and slot["consumers"] > 0:
+                replies.append(slot["waiters"].pop(0))
+                slot["consumers"] -= 1
+            if slot["consumers"] <= 0 and not slot["waiters"]:
+                del rec["mailbox"][key]
+            value = slot["value"]
+        for wconn, msg_id in replies:
+            self._collective_reply(wconn, msg_id, "collective_take",
+                                   {"status": "ok", "value": value})
+        return {"status": "ok"}
+
+    def handle_collective_take(self, conn: Connection, data: Dict[str, Any]):
+        """Consume one unit of a mailbox value; parks (DEFERRED) until the
+        post arrives, the group breaks, or the caller's own RPC timeout —
+        the client-side stall timeout — fires."""
+        with self._lock:
+            rec = self._collective_rec_locked(data["name"], data["epoch"])
+            if rec is None:
+                return {"status": "destroyed"}
+            if rec["dead"]:
+                return {"status": "dead", "dead": dict(rec["dead"])}
+            key = data["key"]
+            slot = rec["mailbox"].get(key)
+            if slot is not None and slot["posted"] and slot["consumers"] > 0:
+                slot["consumers"] -= 1
+                value = slot["value"]
+                if slot["consumers"] <= 0 and not slot["waiters"]:
+                    del rec["mailbox"][key]
+                return {"status": "ok", "value": value}
+            if slot is None:
+                slot = rec["mailbox"][key] = self._collective_new_slot()
+            slot["waiters"].append((conn, conn.current_msg_id))
+        return DEFERRED
+
+    def handle_collective_barrier(self, conn: Connection, data: Dict[str, Any]):
+        """Event-driven barrier, reusable across rounds: per-seq state is
+        created on first arrival and deleted when the last rank releases
+        it, so repeated barriers on one group cost nothing persistent."""
+        with self._lock:
+            rec = self._collective_rec_locked(data["name"], data["epoch"])
+            if rec is None:
+                return {"status": "destroyed"}
+            if rec["dead"]:
+                return {"status": "dead", "dead": dict(rec["dead"])}
+            seq = data["seq"]
+            st = rec["barriers"].setdefault(seq, {"arrived": set(),
+                                                  "waiters": []})
+            st["arrived"].add(int(data["rank"]))
+            if len(st["arrived"]) < rec["world_size"]:
+                st["waiters"].append((conn, conn.current_msg_id))
+                return DEFERRED
+            waiters = st["waiters"]
+            del rec["barriers"][seq]
+        for wconn, msg_id in waiters:
+            self._collective_reply(wconn, msg_id, "collective_barrier",
+                                   {"status": "ok"})
+        return {"status": "ok"}
+
+    def handle_collective_destroy(self, conn: Connection, data: Dict[str, Any]):
+        """With if_broken=True, only destroys a group that has dead
+        members — the self-heal path for a name poisoned by a crashed
+        previous run. With an epoch, only that incarnation is destroyed.
+        Both guards make a straggling destroy race-safe against a peer
+        that already recreated the name (the fresh group is left alone)."""
+        with self._lock:
+            rec = self.collectives.get(data["name"])
+            if rec is not None and (
+                    (data.get("if_broken") and not rec["dead"])
+                    or (data.get("epoch") is not None
+                        and rec["epoch"] != data["epoch"])):
+                return {"status": "ok", "destroyed": False}
+            rec = self.collectives.pop(data["name"], None)
+            waiters = self._collective_drain_waiters_locked(rec) if rec else []
+        for wconn, msg_id, method in waiters:
+            self._collective_reply(wconn, msg_id, method,
+                                   {"status": "destroyed"})
+        return {"status": "ok"}
+
+    def _collective_mark_dead(self, name: str, epoch: int, rank: int,
+                              reason: str):
+        """A member died: record it, fail every parked take/barrier of the
+        group with the rank-attributed dead map, and drop now-unservable
+        mailbox state. Subsequent calls against the group answer 'dead'
+        until it is destroyed and re-created (fresh epoch)."""
+        with self._lock:
+            rec = self._collective_rec_locked(name, epoch)
+            if rec is None or rank in rec["dead"]:
+                return
+            rec["dead"][rank] = reason
+            dead = dict(rec["dead"])
+            waiters = self._collective_drain_waiters_locked(rec)
+            # No take against a broken group ever succeeds again: posted
+            # slots are garbage now, not later.
+            rec["mailbox"].clear()
+            if len(rec["dead"]) >= rec["world_size"]:
+                self.collectives.pop(name, None)
+        logger.warning("collective group '%s': rank %d died (%s)",
+                       name, rank, reason)
+        for wconn, msg_id, method in waiters:
+            self._collective_reply(wconn, msg_id, method,
+                                   {"status": "dead", "dead": dead})
 
     # --------------------------------------------------------------- KV store
 

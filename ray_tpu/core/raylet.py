@@ -559,6 +559,13 @@ class Raylet:
         self._completed_pullers: Dict[bytes, Dict[str, float]] = {}
         self._chunk_serve_delay_s = 0.0   # sender occupancy per chunk
         self._chunk_fetch_delay_s = 0.0   # per-RPC RTT on the pull side
+        # Test/bench link model: when set, ALL chunk egress from this node
+        # serializes through one token (a NIC) at this many bytes/s —
+        # sleeps, never spins, so the modeled network dominates instead of
+        # CPU contention. Models per-host DCN capacity for topology
+        # benchmarks (star vs ring collectives); 0 disables.
+        self._chunk_serve_bw_bps = 0.0
+        self._link_lock = threading.Lock()
         # Sealed replicas whose directory announcement failed (GCS outage
         # mid-pull): re-announced by the heartbeat loop, otherwise the
         # node would stay listed as a stale `partial` location forever.
@@ -2083,6 +2090,11 @@ class Raylet:
             if offset >= size:
                 return _pack_chunk_reply({"st": "missing", "s": size})
             end = min(offset + length, size) if length else size
+            if self._chunk_serve_bw_bps:
+                # Serialized per-node egress: concurrent transfers share
+                # the one modeled link instead of sleeping in parallel.
+                with self._link_lock:
+                    time.sleep((end - offset) / self._chunk_serve_bw_bps)
             self._record_outbound(oid, puller, offset, end - offset, size)
             conn.reply_raw(msg_id, "pull_object_chunk",
                            _pack_chunk_reply({"st": "ok", "s": size},

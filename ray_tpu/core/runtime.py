@@ -356,6 +356,61 @@ class CoreRuntime:
                              {"object_id": oid, "size": size,
                               "owner": self.worker_id.hex()})
 
+    # ------------------------------------------- raw objects (collective)
+
+    def put_raw(self, parts) -> ObjectID:
+        """Seal raw bytes as an object with NO serialization framing.
+
+        The segment content is exactly the caller's bytes, so peers pull
+        it over the chunked transfer plane and land it with zero
+        encode/decode cost — the host-collective plane's data path. Only
+        readable back via :meth:`get_raw` (a normal ``get`` would try to
+        unpickle the payload)."""
+        if not isinstance(parts, (list, tuple)):
+            parts = [parts]
+        views = [p if isinstance(p, memoryview) else memoryview(p)
+                 for p in parts]
+        size = sum(v.nbytes for v in views)
+        with self._lock:
+            self._put_counter += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_counter)
+            self._owned_puts.add(oid.binary())
+        self._write_segment(oid, views, size, reusable=True)
+        self.raylet.call("object_sealed",
+                         {"object_id": oid, "size": size,
+                          "owner": self.worker_id.hex()})
+        return oid
+
+    def get_raw(self, oid: ObjectID,
+                timeout: Optional[float] = None) -> memoryview:
+        """Raw segment view of a :meth:`put_raw` object, pulled to this
+        node via the transfer plane when remote. The view aliases the
+        shared segment — consume it before the object is freed."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        buf = self.store.get_buffer(oid)
+        if buf is not None:
+            return buf
+        status, data = self._fetch_via_raylet(oid, deadline)
+        if status == "local":
+            buf = self.store.get_buffer(oid)
+            if buf is not None:
+                return buf
+        elif status == "inline":
+            return memoryview(data)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise GetTimeoutError(f"Timed out getting raw object {oid}")
+        raise ObjectLostError(oid)
+
+    def free_raw(self, oids: Sequence[ObjectID]) -> None:
+        """Owner-side free of put_raw objects (no ObjectRef is ever minted
+        for them, so the refcount path doesn't apply); batched through the
+        normal directory free."""
+        with self._lock:
+            for oid in oids:
+                self._owned_puts.discard(oid.binary())
+        for oid in oids:
+            self.free_ref(oid)
+
     def _register_container_refs(self, container: ObjectID, captured):
         """A put/return value embeds ObjectRefs: register the inner ids as
         borrows held by the CONTAINER itself (synthetic borrower

@@ -123,3 +123,21 @@ class PlacementGroupUnschedulableError(RayTpuError):
 
 class RaySystemError(RayTpuError):
     """Internal framework failure (control plane / store)."""
+
+
+class CollectiveError(RayTpuError):
+    """A host-collective operation aborted.
+
+    Raised on every surviving rank when a group member dies mid-operation
+    (``dead_ranks`` maps rank -> reason), when the group was destroyed
+    under the caller, or when an operation stalls past
+    ``collective_stall_timeout_s`` with no progress.
+    """
+
+    def __init__(self, message: str, dead_ranks=None, group_name=None):
+        self.dead_ranks = dict(dead_ranks or {})
+        self.group_name = group_name
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0], self.dead_ranks, self.group_name))

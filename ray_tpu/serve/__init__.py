@@ -61,7 +61,8 @@ class Deployment:
                 max_concurrent_queries: Optional[int] = None,
                 autoscaling_config: Optional[AutoscalingConfig] = None,
                 route_prefix: Optional[str] = None,
-                ray_actor_options: Optional[Dict[str, Any]] = None
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                user_config: Any = None
                 ) -> "Deployment":
         cfg = _dc_replace(self.config)
         if num_replicas is not None:
@@ -74,6 +75,8 @@ class Deployment:
             cfg.route_prefix = route_prefix
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if user_config is not None:
+            cfg.user_config = user_config
         return Deployment(self._target, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -92,7 +95,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 8,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                route_prefix: Optional[str] = None,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               user_config: Any = None):
     """`@serve.deployment` on a class or function."""
 
     def wrap(target):
@@ -102,6 +106,7 @@ def deployment(_target=None, *, name: Optional[str] = None,
             autoscaling=autoscaling_config,
             route_prefix=route_prefix,
             ray_actor_options=dict(ray_actor_options or {}),
+            user_config=user_config,
         )
         return Deployment(target, name or target.__name__, cfg)
 

@@ -45,6 +45,13 @@ class DeploymentConfig:
     health_check_period_s: float = 1.0
     graceful_shutdown_timeout_s: float = 5.0
     replica_startup_timeout_s: float = 60.0
+    # Arbitrary payload delivered to every replica's `reconfigure(cfg)`
+    # hook — model weights, sampling params, feature flags. The controller
+    # puts it in the object store ONCE and passes the ref to each replica,
+    # so a large payload (a weight pytree) fans out over the object
+    # transfer plane's tree broadcast instead of being re-pickled through
+    # the controller per replica (reference: serve user_config semantics).
+    user_config: Any = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling is not None:

@@ -36,6 +36,18 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 
 
+def _user_config_changed(old: Any, new: Any) -> bool:
+    """Equality with array-friendly semantics: identical object or a
+    cleanly-True comparison means unchanged; anything ambiguous (numpy
+    arrays raise on bool()) counts as changed."""
+    if old is new:
+        return False
+    try:
+        return not bool(old == new)
+    except Exception:  # noqa: BLE001 — ambiguous equality: assume changed
+        return True
+
+
 class _ReplicaInfo:
     def __init__(self, handle, replica_id: str):
         self.handle = handle
@@ -43,6 +55,8 @@ class _ReplicaInfo:
         self.state = REPLICA_STARTING
         self.last_ongoing = 0
         self.started_at = time.time()
+        # Last user_config version pushed to this replica (0 = never).
+        self.user_config_version = 0
 
 
 class _DeploymentInfo:
@@ -55,6 +69,12 @@ class _DeploymentInfo:
         self.replicas: List[_ReplicaInfo] = []
         self.target = config.initial_replicas()
         self.next_replica_seq = 0
+        # Weight/config broadcast plane: the user_config payload is put in
+        # the object store ONCE per version; replicas receive the REF, so
+        # N replicas pulling a big payload concurrently form a transfer
+        # tree instead of N pickled copies through this actor.
+        self.user_config_version = 1 if config.user_config is not None else 0
+        self.user_config_ref = None
         # Autoscaling bookkeeping: when pressure/idleness began.
         self.pressure_since: Optional[float] = None
         self.idle_since: Optional[float] = None
@@ -102,12 +122,21 @@ class ServeController:
 
         import cloudpickle
 
+        import dataclasses
+
         state = {}
         for name, info in self._deployments.items():
+            # user_config may be a multi-GB weight pytree (that's the
+            # point of the ref-broadcast path) — never re-pickle it into
+            # every checkpoint. Post-crash, surviving replicas keep their
+            # applied config; pushing it to NEW replicas requires a
+            # redeploy (restore() zeroes the version accordingly).
+            cfg = info.config
+            if cfg.user_config is not None:
+                cfg = dataclasses.replace(cfg, user_config=None)
             state[name] = {
                 "blob": cloudpickle.dumps(
-                    (info.user_cls, info.init_args, info.init_kwargs,
-                     info.config)),
+                    (info.user_cls, info.init_args, info.init_kwargs, cfg)),
                 "target": info.target,
                 "next_replica_seq": info.next_replica_seq,
                 "replica_ids": [r.replica_id for r in info.replicas],
@@ -205,11 +234,21 @@ class ServeController:
             changed_code = (user_cls is not info.user_cls
                             or init_args != info.init_args
                             or init_kwargs != info.init_kwargs)
+            old_user_config = info.config.user_config
             info.user_cls = user_cls
             info.init_args = init_args
             info.init_kwargs = init_kwargs
             info.config = config
             info.target = config.initial_replicas()
+            if config.user_config is not None and _user_config_changed(
+                    old_user_config, config.user_config):
+                # New payload version: re-put lazily and re-push to every
+                # replica (running ones via reconfigure, new ones on
+                # promotion) — live weight updates without a restart. An
+                # unchanged payload (a redeploy that only moved replica
+                # counts) is NOT re-pushed.
+                info.user_config_version += 1
+                info.user_config_ref = None
             if changed_code:
                 for rep in info.replicas:
                     self._stop_replica(rep)
@@ -411,10 +450,22 @@ class ServeController:
                 state = await loop.run_in_executor(
                     None, functools.partial(_try_ping, rep.handle, 0.05))
                 if state == "ok":
-                    rep.state = REPLICA_RUNNING
-                    changed = True
-                elif state == "dead" or (
-                        time.time() - rep.started_at
+                    # Deliver the current user_config BEFORE the replica
+                    # becomes routable: a request must never reach user
+                    # code whose reconfigure(weights) hasn't run. A failed
+                    # push leaves it STARTING (retried next tick until the
+                    # startup timeout below replaces it).
+                    needs_cfg = (info.user_config_version
+                                 and info.config.user_config is not None
+                                 and rep.user_config_version
+                                 < info.user_config_version)
+                    if not needs_cfg or await self._push_user_config(
+                            loop, info, rep):
+                        rep.state = REPLICA_RUNNING
+                        changed = True
+                if rep.state == REPLICA_STARTING and (
+                        state == "dead"
+                        or time.time() - rep.started_at
                         > info.config.replica_startup_timeout_s):
                     logger.warning(
                         "serve: replica %s of %s failed to start — "
@@ -422,6 +473,26 @@ class ServeController:
                     self._stop_replica(rep, graceful=False)
                     info.replicas.remove(rep)
                     changed = True
+
+            # 1.5 Weight/config broadcast: push the current user_config to
+            # RUNNING replicas behind on it (a live update bumped the
+            # version). The payload lives in the object store once per
+            # version; each replica receives the REF as its reconfigure
+            # argument and pulls the bytes over the transfer plane
+            # (concurrent replicas self-organize into a tree there — the
+            # controller never re-pickles the payload per replica).
+            if info.user_config_version and info.config.user_config is not None:
+                behind = [r for r in info.replicas
+                          if r.state == REPLICA_RUNNING
+                          and r.user_config_version < info.user_config_version]
+                if behind:
+                    # Materialize the ref BEFORE fanning out: concurrent
+                    # pushes racing the first put would each serialize
+                    # their own copy of the payload.
+                    await self._ensure_user_config_ref(loop, info)
+                    await asyncio.gather(
+                        *(self._push_user_config(loop, info, rep)
+                          for rep in behind))
 
             # 2. Health-check RUNNING replicas; replace the dead.
             if (time.time() - info.last_health_check
@@ -469,6 +540,36 @@ class ServeController:
         if changed:
             self._rebuild_routing_table()
             self._checkpoint()  # replica set moved: keep recovery current
+
+    async def _ensure_user_config_ref(self, loop, info: _DeploymentInfo):
+        """Put the payload ONCE per version, serially — concurrent
+        _push_user_config coroutines must never each put their own copy."""
+        import ray_tpu
+
+        if info.user_config_ref is None:
+            info.user_config_ref = await loop.run_in_executor(
+                None, ray_tpu.put, info.config.user_config)
+
+    async def _push_user_config(self, loop, info: _DeploymentInfo,
+                                rep: _ReplicaInfo) -> bool:
+        """Deliver the current user_config version to one replica and
+        AWAIT its reconfigure hook: the version is only marked applied on
+        success, so failures are retried next tick instead of silently
+        leaving the replica on stale config."""
+        import ray_tpu
+
+        await self._ensure_user_config_ref(loop, info)
+        version = info.user_config_version
+        try:
+            ref = rep.handle.reconfigure.remote(info.user_config_ref)
+            await loop.run_in_executor(
+                None, functools.partial(ray_tpu.get, ref, timeout=60.0))
+        except Exception:  # noqa: BLE001 — user hook raised or replica died
+            logger.warning("serve: reconfigure of replica %s failed",
+                           rep.replica_id, exc_info=True)
+            return False
+        rep.user_config_version = version
+        return True
 
     def _autoscale_decision(self, info: _DeploymentInfo) -> int:
         cfg = info.config.autoscaling

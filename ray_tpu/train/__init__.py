@@ -27,13 +27,16 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.session import (
+    broadcast_params,
     get_checkpoint,
+    get_collective,
     get_context,
     get_dataset_shard,
     get_mesh,
     get_world_rank,
     get_world_size,
     report,
+    sync_gradients,
 )
 from ray_tpu.train.trainer import (
     BaseTrainer,

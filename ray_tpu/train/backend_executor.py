@@ -9,6 +9,7 @@ reported results back; whole-group restart on failure (FailureConfig).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import ray_tpu
@@ -74,11 +75,16 @@ class BackendExecutor:
         if hasattr(self.backend, "mesh_builder"):
             mesh_builder = self.backend.mesh_builder(self.backend_config)
         self.backend.on_training_start(wg, self.backend_config)
+        # Run-unique tag shared by all ranks: the host-collective group is
+        # named per RUN, so concurrent runs (or a restart of this one)
+        # can never interleave joins into one group.
+        run_nonce = os.urandom(4).hex()
         start_refs = []
         for i, w in enumerate(wg.workers):
             ds = datasets_per_worker[i] if datasets_per_worker else None
             start_refs.append(w.start_training.remote(
-                train_fn, config, checkpoint, mesh_builder, ds, experiment_name))
+                train_fn, config, checkpoint, mesh_builder, ds,
+                experiment_name, run_nonce))
         ray_tpu.get(start_refs)
         done = [False] * len(wg.workers)
         while not all(done):

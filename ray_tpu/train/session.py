@@ -34,7 +34,7 @@ class _TrainSession:
     def __init__(self, context: TrainContext,
                  datasets: Optional[Dict[str, Any]] = None,
                  checkpoint: Optional[Checkpoint] = None,
-                 mesh=None):
+                 mesh=None, collective_factory=None):
         self.context = context
         self.datasets = datasets or {}
         self.loaded_checkpoint = checkpoint
@@ -43,6 +43,30 @@ class _TrainSession:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.final_return: Any = None
+        # Host collective plane (cross-host DDP outside XLA): lazily
+        # joined on first use so single-host loops never pay for it.
+        self._collective_factory = collective_factory
+        self._collective = None
+        self._collective_lock = threading.Lock()
+
+    def collective(self):
+        """This worker's handle on the run-wide host collective group
+        (ray_tpu.collective), joined on first use. None when the session
+        runs outside a WorkerGroup (no factory)."""
+        with self._collective_lock:
+            if self._collective is None and self._collective_factory is not None:
+                self._collective = self._collective_factory()
+            return self._collective
+
+    def teardown_collective(self):
+        with self._collective_lock:
+            group, self._collective = self._collective, None
+            self._collective_factory = None  # no join can land after this
+        if group is not None:
+            try:
+                group.leave()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
@@ -116,3 +140,38 @@ def get_mesh():
     """The slice-wide jax.sharding.Mesh assembled by the backend (None when
     the trainer was configured without one)."""
     return get_session().mesh
+
+
+def get_collective():
+    """The run-wide host collective group (`ray_tpu.collective`): ring
+    allreduce / tree broadcast between the training workers, outside
+    compiled programs. Raises when the session has no worker group."""
+    group = get_session().collective()
+    if group is None:
+        raise RuntimeError(
+            "No host collective available: this session is not running "
+            "under a WorkerGroup (single-process loops have no peers).")
+    return group
+
+
+def sync_gradients(grads, op: str = "mean"):
+    """Cross-host data-parallel gradient sync: allreduce a pytree of
+    numpy/jax gradients across all training workers over the host
+    collective plane (ring reduce-scatter + all-gather through the object
+    transfer plane — see docs/COLLECTIVE.md). The DDP seam for loops whose
+    collectives are NOT compiled into XLA (separate JAX processes without
+    jax.distributed, torch-free CPU loops, DCN-spanning worker groups)."""
+    session = get_session()
+    if session.context.world_size <= 1:
+        return grads
+    return get_collective().allreduce(grads, op=op)
+
+
+def broadcast_params(params, src_rank: int = 0):
+    """Broadcast a pytree (initial weights, updated params) from one
+    training worker to all others via the transfer plane's tree
+    broadcast."""
+    session = get_session()
+    if session.context.world_size <= 1:
+        return params
+    return get_collective().broadcast(params, src_rank=src_rank)

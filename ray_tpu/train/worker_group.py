@@ -52,14 +52,41 @@ class TrainWorker:
     def start_training(self, train_fn: Callable, config: Dict[str, Any],
                        checkpoint=None, mesh_builder: Optional[Callable] = None,
                        datasets: Optional[Dict[str, Any]] = None,
-                       experiment_name: str = ""):
+                       experiment_name: str = "", run_nonce: str = ""):
         assert self._thread is None or not self._thread.is_alive(), \
             "training already running"
         mesh = mesh_builder() if mesh_builder is not None else None
         context = TrainContext(world_rank=self.rank, world_size=self.world_size,
                                experiment_name=experiment_name)
+        collective_factory = None
+        if self.world_size > 1:
+            rank, world = self.rank, self.world_size
+            # Run-unique name (nonce from the executor): concurrent runs
+            # with the same experiment name can never share a group.
+            group_name = (f"train:{experiment_name or 'run'}"
+                          f":{run_nonce or 'default'}")
+
+            def collective_factory():
+                import ray_tpu
+                from ray_tpu import collective as _collective
+                from ray_tpu.exceptions import CollectiveError
+
+                try:
+                    return _collective.init_collective_group(
+                        world, rank, group_name=group_name)
+                except CollectiveError:
+                    # A crashed previous run left the name broken (its
+                    # members died, the record stayed). Clear it — only
+                    # if still broken, so a peer's fresh incarnation
+                    # survives the race — and join the new epoch.
+                    ray_tpu._require_runtime().gcs.call(
+                        "collective_destroy",
+                        {"name": group_name, "if_broken": True}, timeout=10)
+                    return _collective.init_collective_group(
+                        world, rank, group_name=group_name)
+
         session = _TrainSession(context, datasets=datasets, checkpoint=checkpoint,
-                                mesh=mesh)
+                                mesh=mesh, collective_factory=collective_factory)
         self._session = session
         init_session(session)
 
@@ -107,6 +134,8 @@ class TrainWorker:
                     return {"done": False, "timeout": True}
 
     def finish(self):
+        if self._session is not None:
+            self._session.teardown_collective()
         shutdown_session()
         self._session = None
         return True

@@ -1,16 +1,17 @@
-"""Host-level collective ops between actors.
+"""Host-level collective ops — compatibility shim over `ray_tpu.collective`.
 
-Equivalent of `python/ray/util/collective/collective.py` (:40 GroupManager,
-:120 init_collective_group, :258 allreduce) — but with no NCCL/Gloo layer:
+Historically this module WAS the collective implementation: a star-topology
+rendezvous actor that round-tripped every payload, fully pickled, through
+one process (O(world_size × bytes) through a single actor). The real plane
+now lives in `ray_tpu.collective` — ring allreduce / tree broadcast over
+the pipelined object-transfer plane, GCS-backed membership with
+rank-attributed death aborts (docs/COLLECTIVE.md). The module-level API
+below delegates there.
 
-- **Device-side collectives** (the hot path) live *inside* XLA programs:
-  `jax.lax.psum/...` over a mesh axis, compiled to ICI/DCN transfers. See
-  `ray_tpu.parallel`. A "collective group" maps to a named JAX mesh, not a
-  communicator object (SURVEY.md §5.8).
-- **This module** is the host-RAM fallback for control-plane data (metric
-  reduction, weight broadcast between actor groups, rendezvous): CPU
-  reductions via a rendezvous actor, exchanging numpy through the object
-  store (zero-copy shm on one host).
+The star implementation is retained as ``backend="star"`` (and the
+`_RendezvousActor` class) for A/B benchmarking — bench.py's
+collective microbench measures ring vs star — and for tiny host-side
+rendezvous where one actor is genuinely enough.
 """
 
 from __future__ import annotations
@@ -19,6 +20,8 @@ import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ray_tpu.collective.buffer import tree_index as _tree_index_impl
 
 _REDUCE_OPS = {
     "sum": lambda xs: _tree_reduce(xs, np.add),
@@ -43,15 +46,33 @@ def _tree_map2(op, a, b):
     return op(np.asarray(a), np.asarray(b))
 
 
+def _tree_index(x, rank: int, world: int):
+    """Row-slice every leaf for reducescatter; raises ValueError when a
+    leading dimension does not divide world_size (the old code silently
+    dropped the remainder rows)."""
+    return _tree_index_impl(x, rank, world)
+
+
 class _RendezvousActor:
-    """Barrier + gather/reduce/broadcast state machine for one group."""
+    """Barrier + gather/reduce/broadcast state machine for one group.
+
+    Per-key state is refcounted by fetches: every member fetches each
+    result exactly once, so the slot (result + event) is deleted when the
+    world_size'th fetch drains it — long-lived groups no longer grow
+    unboundedly."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._round: Dict[str, Dict[int, Any]] = {}
         self._results: Dict[str, Any] = {}
+        self._fetches: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._events: Dict[str, threading.Event] = {}
+
+    def get_world_size(self) -> int:
+        """Attach-time validation hook: a namesake group with a different
+        world_size must raise at init, not hang every rank."""
+        return self.world_size
 
     def _event(self, key: str) -> threading.Event:
         with self._lock:
@@ -78,17 +99,28 @@ class _RendezvousActor:
             raise TimeoutError(f"collective '{key}' timed out "
                                f"(world_size={self.world_size})")
         with self._lock:
-            return self._results[key]
+            result = self._results[key]
+            self._fetches[key] = self._fetches.get(key, 0) + 1
+            if self._fetches[key] >= self.world_size:
+                # Drained: every member has its copy — delete the slot so
+                # a long-lived group's memory stays bounded.
+                del self._results[key]
+                del self._fetches[key]
+                self._events.pop(key, None)
+            return result
 
     def reset(self):
         with self._lock:
             self._round.clear()
             self._results.clear()
+            self._fetches.clear()
             self._events.clear()
 
 
-class CollectiveGroup:
-    """Handle used by each member actor/process."""
+class StarCollectiveGroup:
+    """Legacy star topology: every op round-trips through one rendezvous
+    actor. Kept for A/B measurement against the ring plane and as a
+    minimal dependency-free fallback."""
 
     def __init__(self, name: str, world_size: int, rank: int):
         import ray_tpu
@@ -100,6 +132,16 @@ class CollectiveGroup:
             name=f"rtpu_collective_{name}", get_if_exists=True,
             max_concurrency=max(8, world_size * 2), num_cpus=0,
             lifetime="detached").remote(world_size)
+        # get_if_exists may have attached to a pre-existing namesake actor:
+        # a mismatched world_size would deadlock every op (the barrier
+        # count never completes) — validate now and fail loudly.
+        existing = ray_tpu.get(self._actor.get_world_size.remote())
+        if existing != world_size:
+            raise ValueError(
+                f"collective group '{name}' already exists with "
+                f"world_size={existing}; attach requested "
+                f"world_size={world_size}. destroy_collective_group() it "
+                "first (or pick another name).")
         self._seq = 0
 
     def _next_key(self, tag: str) -> str:
@@ -138,28 +180,43 @@ class CollectiveGroup:
         except Exception:
             pass
 
-
-def _tree_index(x, rank: int, world: int):
-    if isinstance(x, dict):
-        return {k: _tree_index(v, rank, world) for k, v in x.items()}
-    if isinstance(x, (list, tuple)):
-        return type(x)(_tree_index(v, rank, world) for v in x)
-    arr = np.asarray(x)
-    chunk = arr.shape[0] // world
-    return arr[rank * chunk:(rank + 1) * chunk]
+    def leave(self):  # API parity with the ring plane
+        pass
 
 
-_groups: Dict[str, CollectiveGroup] = {}
+# Backwards-compatible alias: `CollectiveGroup` from this module used to be
+# the star implementation; the canonical CollectiveGroup now lives in
+# ray_tpu.collective.
+CollectiveGroup = StarCollectiveGroup
+
+_groups: Dict[str, Any] = {}
 
 
 def init_collective_group(world_size: int, rank: int,
-                          group_name: str = "default") -> CollectiveGroup:
-    group = CollectiveGroup(group_name, world_size, rank)
+                          group_name: str = "default",
+                          backend: str = "ring"):
+    """Join a host collective group.
+
+    backend="ring" (default): the `ray_tpu.collective` plane — ring
+    allreduce / tree broadcast over the object-transfer plane, GCS
+    membership, CollectiveError on member death.
+    backend="star": the legacy single-actor rendezvous.
+    """
+    if backend == "ring":
+        import ray_tpu.collective as _collective
+
+        group = _collective.init_collective_group(world_size, rank,
+                                                  group_name=group_name)
+    elif backend == "star":
+        group = StarCollectiveGroup(group_name, world_size, rank)
+    else:
+        raise ValueError(f"unknown collective backend {backend!r} "
+                         "(expected 'ring' or 'star')")
     _groups[group_name] = group
     return group
 
 
-def get_group(group_name: str = "default") -> CollectiveGroup:
+def get_group(group_name: str = "default"):
     if group_name not in _groups:
         raise ValueError(f"collective group '{group_name}' not initialized")
     return _groups[group_name]

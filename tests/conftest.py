@@ -68,3 +68,10 @@ def ray_start_regular():
     yield
     if ray_tpu._global_runtime is created:
         ray_tpu.shutdown()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running perf comparisons excluded from the tier-1 "
+        "budget (run explicitly or via bench.py)")
