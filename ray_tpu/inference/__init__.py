@@ -1,0 +1,40 @@
+"""ray_tpu.inference — continuous-batching LLM serving engine.
+
+The inference plane next to the transfer (PR 1) and collective (PR 2)
+planes: a paged KV-cache block manager (`kv_cache`), an iteration-level
+scheduler that re-forms the batch every decode step (`engine`), and a
+Serve deployment streaming tokens as they are produced (`api`).
+
+    from ray_tpu.inference import LLMServer
+    handle = serve.run(LLMServer.bind("tiny"))
+    for event in handle.options(stream=True).stream.remote(
+            {"ids": [1, 2, 3], "max_new_tokens": 16}):
+        ...
+"""
+
+from ray_tpu.inference.engine import (
+    EngineConfig,
+    EngineLoop,
+    InferenceEngine,
+    Request,
+)
+from ray_tpu.inference.kv_cache import BlockManager
+
+__all__ = [
+    "BlockManager",
+    "EngineConfig",
+    "EngineLoop",
+    "InferenceEngine",
+    "LLMServer",
+    "Request",
+]
+
+
+def __getattr__(name):
+    # LLMServer pulls in ray_tpu.serve; keep the core engine importable
+    # without the serving stack (and without a cluster).
+    if name == "LLMServer":
+        from ray_tpu.inference.api import LLMServer
+
+        return LLMServer
+    raise AttributeError(name)

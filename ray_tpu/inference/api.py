@@ -1,0 +1,145 @@
+"""Serve integration for the continuous-batching engine.
+
+`LLMServer` is a `@serve.deployment` hosting one `InferenceEngine` per
+replica (the replica's actor owns the chip; the engine thread owns the
+jitted step programs). Two entry points:
+
+- `__call__` / `generate`: complete the whole generation, return
+  ``{"ids": [...]}`` — wire-compatible with the `LlamaSampler` example.
+- `stream`: an async generator yielding one event per produced token;
+  the existing replica/handle/proxy stream plumbing carries them to
+  Python callers (``handle.options(stream=True)``) and HTTP clients
+  (chunked JSON lines) as they are emitted — time-to-first-token is one
+  scheduler step, not one full generation.
+
+The replica exports the engine's queue depth through the
+``__serve_metrics__`` hook, so the controller's autoscaler sees queued
+requests (not just in-flight RPCs) and scales replicas on real backlog.
+``__serve_shutdown__`` stops the engine thread at replica teardown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from ray_tpu import serve
+from ray_tpu.inference.engine import EngineConfig, EngineLoop, InferenceEngine
+
+
+def _parse(payload: Optional[Dict[str, Any]], default_new: int):
+    payload = payload or {}
+    ids = [int(t) for t in payload.get("ids", [])] or [0]
+    max_new = max(1, int(payload.get("max_new_tokens", default_new)))
+    return ids, max_new
+
+
+@serve.deployment(max_concurrent_queries=64)
+class LLMServer:
+    """Continuous-batching LLM deployment.
+
+    Request: ``{"ids": [int, ...], "max_new_tokens": int}``;
+    response: ``{"ids": [prompt + generated]}`` (generate) or a stream of
+    ``{"token": int}`` events followed by ``{"done": true, "ids": [...]}``
+    (stream).
+    """
+
+    def __init__(self, model_size: str = "tiny",
+                 max_model_len: int = 256,
+                 default_new_tokens: int = 16,
+                 engine_config: Optional[Dict[str, Any]] = None):
+        kwargs = dict(engine_config or {})
+        kwargs.setdefault("model_size", model_size)
+        kwargs.setdefault("max_model_len", max_model_len)
+        self._default_new = default_new_tokens
+        self._config = EngineConfig(**kwargs)
+        self._engine = InferenceEngine(self._config)
+        self._loop = EngineLoop(self._engine)
+
+    # ------------------------------------------------------------ complete
+
+    async def __call__(self, payload=None):
+        # HTTP clients reach methods only through __call__: a
+        # ``"stream": true`` field switches to the token stream (the
+        # replica pumps the returned async generator, the proxy relays
+        # it as chunked JSON lines).
+        if isinstance(payload, dict) and payload.get("stream"):
+            return self.stream(payload)
+        return await self.generate(payload)
+
+    async def generate(self, payload=None):
+        ids, max_new = _parse(payload, self._default_new)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def on_finish(req):
+            def _resolve():
+                if fut.done():
+                    return
+                if req.error:
+                    fut.set_exception(RuntimeError(req.error))
+                else:
+                    fut.set_result(None)
+            loop.call_soon_threadsafe(_resolve)
+
+        req = self._loop.submit(ids, max_new, on_finish=on_finish)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # Caller abandoned the request: release its slot and blocks.
+            self._engine.cancel(req.request_id)
+            raise
+        return {"ids": list(req.prompt) + list(req.generated)}
+
+    # -------------------------------------------------------------- stream
+
+    async def stream(self, payload=None):
+        """Async generator: one ``{"token": t}`` per produced token, then
+        ``{"done": True, "ids": [...]}`` — replica pumps it through the
+        stream queue, the proxy relays chunked JSON lines, handles iterate
+        it with ``options(stream=True)``."""
+        ids, max_new = _parse(payload, self._default_new)
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def on_token(req, token):
+            loop.call_soon_threadsafe(queue.put_nowait, ("token", token))
+
+        def on_finish(req):
+            loop.call_soon_threadsafe(queue.put_nowait, ("end", req))
+
+        req = self._loop.submit(ids, max_new, on_token=on_token,
+                                on_finish=on_finish)
+        try:
+            while True:
+                kind, item = await queue.get()
+                if kind == "token":
+                    yield {"token": item}
+                else:
+                    if item.error:
+                        raise RuntimeError(item.error)
+                    yield {"done": True,
+                           "ids": list(item.prompt) + list(item.generated)}
+                    return
+        finally:
+            # Client gone mid-stream (the replica's pump was cancelled /
+            # the generator closed): abort the engine request so its
+            # batch slot and KV blocks go back to live traffic instead
+            # of decoding to budget for nobody. No-op when finished.
+            self._engine.cancel(req.request_id)
+
+    # ------------------------------------------------------------- control
+
+    def metrics(self, _=None) -> Dict[str, Any]:
+        return self._engine.stats()
+
+    def __serve_metrics__(self) -> Dict[str, Any]:
+        """Autoscaling signal (replica merges this into its stats): queued
+        requests count toward pressure exactly like in-flight ones."""
+        stats = self._engine.stats()
+        return {"queue_depth": stats["queue_depth"],
+                "running": stats["running"],
+                "tokens_per_sec": stats["tokens_per_sec"]}
+
+    def __serve_shutdown__(self) -> None:
+        self._loop.stop()

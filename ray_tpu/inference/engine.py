@@ -1,0 +1,688 @@
+"""Continuous-batching inference engine (Orca-style iteration scheduling).
+
+The serving batch is re-formed every decode step instead of every request:
+finished sequences leave their batch slot immediately, queued requests are
+admitted into freed slots, and long prompts prefill in fixed-size chunks
+interleaved with decode steps so token emission never stalls behind a new
+arrival. K/V lives in a paged arena (`kv_cache.BlockManager` +
+`models/llama.py:decode_paged`); when the arena runs out of blocks the
+engine preempts the lowest-priority sequence — frees its blocks and
+re-queues it for recompute — so the answer to memory pressure is degraded
+latency, never an OOM.
+
+Two jitted programs serve every request mix, each compiled exactly once:
+
+- prefill: [1, prefill_chunk] tokens of one sequence (padded chunk),
+- decode:  [batch_slots, 1] — one token for every running slot.
+
+All shapes are static (batch slots, chunk width, block-table width), so
+the engine's per-step work is argument values, never new programs; the
+stats track compile counts to prove it.
+
+The engine core is synchronous and single-threaded (`step()`); tests drive
+it directly. `EngineLoop` runs it on a background thread and is what the
+Serve deployment (`api.py`) uses; token/finish callbacks are fired outside
+the engine lock so they may bounce into an asyncio loop safely.
+
+`scheduling="static"` emulates the request-level `@serve.batch` baseline
+(gang admission, batch drains at the speed of its longest member, results
+delivered only when the whole gang finishes) through the same compute
+path — `bench.py:bench_inference` uses it so the comparison is pure
+scheduling policy.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.inference.kv_cache import BlockManager
+
+logger = logging.getLogger(__name__)
+
+# Request states.
+WAITING = "WAITING"      # queued (fresh, or preempted awaiting recompute)
+PREFILL = "PREFILL"      # in a slot, prompt (+ recomputed tokens) mid-chunk
+DECODE = "DECODE"        # in a slot, emitting one token per step
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+_DONE_HOLD = "DONE_HOLD"  # static mode: finished but holding its gang slot
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model_size: str = "tiny"        # LlamaConfig preset (tiny/small/7b)
+    max_model_len: int = 256        # positions preset for tiny
+    batch_slots: int = 4            # fixed decode batch width
+    block_size: int = 16            # KV tokens per block
+    num_blocks: int = 64            # arena size (incl. trash block 0)
+    max_blocks_per_seq: int = 8     # block-table width => max context
+    prefill_chunk: int = 16         # prompt tokens per prefill step
+    eos_id: Optional[int] = None    # stop token (None = budget only)
+    use_jit: bool = True            # False = eager smoke mode
+    scheduling: str = "continuous"  # or "static" (@serve.batch emulation)
+
+    @property
+    def max_context(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: int                      # admission priority (lower = older)
+    on_token: Optional[Callable] = None    # (req, token) per emitted token
+    on_finish: Optional[Callable] = None   # (req) once, FINISHED or FAILED
+    state: str = WAITING
+    generated: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+    preemptions: int = 0
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    # Scheduler-internal:
+    slot: Optional[int] = None
+    processed: int = 0                # tokens written into the KV cache
+    cur_token: Optional[int] = None   # next decode input
+    _held_emits: List[tuple] = field(default_factory=list)
+
+    @property
+    def total_to_prefill(self) -> int:
+        # Recompute after preemption replays prompt + already-generated.
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, FAILED)
+
+
+class InferenceEngine:
+    """Synchronous engine core; every public method takes the engine lock.
+
+    `model`/`params` may be injected (tests share one tiny checkpoint with
+    their reference loop); by default the config's Llama preset is built
+    with randomly initialized weights, matching the sampler examples.
+    """
+
+    def __init__(self, config: EngineConfig, model=None, params=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import (
+            Llama,
+            LlamaConfig,
+            make_paged_arena,
+        )
+
+        cfg = config
+        if cfg.scheduling not in ("continuous", "static"):
+            raise ValueError(f"unknown scheduling {cfg.scheduling!r}")
+        if cfg.max_blocks_per_seq * cfg.block_size < cfg.prefill_chunk:
+            raise ValueError("prefill_chunk exceeds the per-seq context")
+        self.config = cfg
+        if model is None:
+            mc = {"tiny": LlamaConfig.tiny(seq=cfg.max_model_len),
+                  "small": LlamaConfig.small(),
+                  "7b": LlamaConfig.llama7b()}[cfg.model_size]
+            model = Llama(mc)
+            params = jax.jit(lambda: model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 8), jnp.int32)))()
+        self._model = model
+        self._params = params
+        self._bm = BlockManager(cfg.num_blocks, cfg.block_size)
+        self._arenas = make_paged_arena(model.config, cfg.num_blocks,
+                                        cfg.block_size)
+        self._slots: List[Optional[Request]] = [None] * cfg.batch_slots
+        self._waiting: List[Request] = []     # kept sorted by arrival
+        self._live: Dict[str, Request] = {}   # request_id -> live request
+        self._lock = threading.RLock()
+        self._arrival_seq = itertools.count()
+        self._req_seq = itertools.count()
+        # Stats.
+        self._tokens_emitted = 0
+        self._finished = 0
+        self._failed = 0
+        self._preemptions = 0
+        self._recomputed_tokens = 0
+        self._started_at: Optional[float] = None
+        self._rate_window: List[tuple] = []   # (t, n) recent emissions
+        self._shapes = {"prefill": set(), "decode": set()}
+        self._build_programs()
+        self._last_stats = self._stats_locked()
+
+    # ----------------------------------------------------------- programs
+
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import Llama
+
+        model = self._model
+
+        def prefill_fn(params, arenas, ids, bt, pos, wmask, last_idx):
+            logits, arenas = model.apply(params, ids, arenas, bt, pos,
+                                         wmask, method=Llama.decode_paged)
+            nxt = jnp.argmax(jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0], axis=-1)
+            return nxt.astype(jnp.int32), arenas
+
+        def decode_fn(params, arenas, toks, bt, pos, wmask):
+            logits, arenas = model.apply(params, toks, arenas, bt, pos,
+                                         wmask, method=Llama.decode_paged)
+            return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), \
+                arenas
+
+        if self.config.use_jit:
+            # Arenas are donated: the update is in place on the device,
+            # not a fresh copy of the whole cache per step.
+            self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1,))
+            self._decode_fn = jax.jit(decode_fn, donate_argnums=(1,))
+        else:
+            self._prefill_fn = prefill_fn
+            self._decode_fn = decode_fn
+
+    def _program_compiles(self, name: str) -> int:
+        fn = self._prefill_fn if name == "prefill" else self._decode_fn
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            try:
+                return int(size())
+            except Exception:  # noqa: BLE001 — introspection only
+                pass
+        return len(self._shapes[name])
+
+    # ---------------------------------------------------------- submission
+
+    def add_request(self, prompt: List[int],
+                    max_new_tokens: int = 16,
+                    on_token: Optional[Callable] = None,
+                    on_finish: Optional[Callable] = None,
+                    request_id: Optional[str] = None) -> Request:
+        cfg = self.config
+        prompt = [int(t) for t in prompt] or [0]
+        max_new_tokens = max(1, int(max_new_tokens))
+        total = len(prompt) + max_new_tokens
+        if total > cfg.max_context or not self._bm.fits(total):
+            raise ValueError(
+                f"request needs {total} token slots; engine caps at "
+                f"{min(cfg.max_context, self._bm.capacity * cfg.block_size)}"
+                f" (max_blocks_per_seq={cfg.max_blocks_per_seq}, "
+                f"num_blocks={cfg.num_blocks})")
+        with self._lock:
+            rid = request_id or f"req-{next(self._req_seq)}"
+            if rid in self._live:
+                # Reject NOW: a duplicate reaching _admit would raise out
+                # of step() and trip the circuit breaker for everyone.
+                raise ValueError(f"request id {rid!r} is already live")
+            req = Request(
+                request_id=rid,
+                prompt=prompt, max_new_tokens=max_new_tokens,
+                arrival=next(self._arrival_seq),
+                on_token=on_token, on_finish=on_finish,
+                submitted_at=time.monotonic())
+            self._live[rid] = req
+            # Arrivals are strictly increasing: append preserves the
+            # sorted-by-arrival invariant (_preempt_one re-sorts for its
+            # out-of-order re-inserts).
+            self._waiting.append(req)
+            if self._started_at is None:
+                self._started_at = time.monotonic()
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Abort one request (client disconnected mid-stream): free its
+        slot and blocks immediately so live traffic isn't stuck behind a
+        generation nobody is reading. True if it was still live."""
+        emissions: List[tuple] = []
+        with self._lock:
+            req = self._live.get(request_id)
+            if req is None or req.done or req.state == _DONE_HOLD:
+                return False   # gone, or already complete (static hold)
+            if req.state == WAITING:
+                self._waiting.remove(req)
+            self._finish(req, emissions, error="cancelled")
+        for fn, args in emissions:
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def has_work(self) -> bool:
+        with self._lock:
+            # Any occupied slot is work: static DONE_HOLD members still
+            # need their gang-release step.
+            return bool(self._waiting) or any(
+                r is not None for r in self._slots)
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, one prefill chunk, one decode
+        step. Returns whether any work ran. Callbacks fire after the lock
+        is released (they may hop into an asyncio loop)."""
+        emissions: List[tuple] = []
+        with self._lock:
+            self._release_static_gang(emissions)
+            self._admit()
+            ran = self._prefill_step(emissions)
+            ran = self._decode_step(emissions) or ran
+        for fn, args in emissions:
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — user callback must not
+                pass           # take down the scheduler
+        return ran
+
+    def run_until_idle(self, max_steps: int = 10000) -> int:
+        """Drive the loop synchronously (tests / offline batch); returns
+        steps taken."""
+        steps = 0
+        while self.has_work():
+            if steps >= max_steps:
+                raise RuntimeError(f"engine not idle after {max_steps} steps")
+            self.step()
+            steps += 1
+        return steps
+
+    # ----------------------------------------------------------- admission
+
+    def _scheduled(self) -> List[Request]:
+        return [r for r in self._slots if r is not None]
+
+    def _admit(self):
+        cfg = self.config
+        if cfg.scheduling == "static":
+            # Gang admission: only into an EMPTY batch, all at once.
+            if any(r is not None for r in self._slots):
+                return
+        while self._waiting:
+            free_slots = [i for i, r in enumerate(self._slots) if r is None]
+            if not free_slots:
+                return
+            req = self._waiting[0]
+            first = min(req.total_to_prefill, cfg.prefill_chunk)
+            self._bm.register(req.request_id)
+            if not self._bm.ensure(req.request_id, first):
+                # Pool exhausted: stay queued; running sequences finishing
+                # (or preempting) will free blocks.
+                self._bm.free(req.request_id)
+                return
+            self._waiting.pop(0)
+            req.slot = free_slots[0]
+            req.state = PREFILL
+            req.processed = 0
+            if req.generated:
+                self._recomputed_tokens += req.total_to_prefill
+            self._slots[req.slot] = req
+
+    # ---------------------------------------------------------- preemption
+
+    def _preempt_one(self) -> bool:
+        """Free the lowest-priority (latest-arrival) scheduled sequence to
+        relieve block pressure. The victim may be the requester itself
+        (callers detect that via its WAITING state). Returns False when
+        there is nothing left to preempt."""
+        victims = [r for r in self._scheduled()
+                   if r.state in (PREFILL, DECODE)]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: r.arrival)
+        self._bm.free(victim.request_id)
+        self._slots[victim.slot] = None
+        victim.slot = None
+        victim.state = WAITING
+        victim.processed = 0
+        victim.cur_token = None
+        victim.preemptions += 1
+        self._preemptions += 1
+        self._waiting.append(victim)
+        self._waiting.sort(key=lambda r: r.arrival)
+        return True
+
+    def _ensure_blocks(self, req: Request, num_tokens: int) -> bool:
+        """Grow req's block table, preempting victims until it fits.
+        False when req itself was preempted (caller must drop it)."""
+        while not self._bm.ensure(req.request_id, num_tokens):
+            if self.config.scheduling == "static":
+                # A drained gang member's KV is never read again — reclaim
+                # its blocks before preempting anything still running.
+                holders = [r for r in self._scheduled()
+                           if r.state == _DONE_HOLD
+                           and self._bm.registered(r.request_id)]
+                if holders:
+                    self._bm.free(holders[0].request_id)
+                    continue
+            if not self._preempt_one():
+                return False
+            if req.state == WAITING:   # preempted itself
+                return False
+        return True
+
+    # ------------------------------------------------------------- prefill
+
+    def _prefill_step(self, emissions) -> bool:
+        import numpy as np
+
+        cfg = self.config
+        cands = [r for r in self._scheduled() if r.state == PREFILL]
+        if not cands:
+            return False
+        req = min(cands, key=lambda r: r.arrival)   # oldest first
+        total = req.total_to_prefill
+        chunk = min(cfg.prefill_chunk, total - req.processed)
+        if not self._ensure_blocks(req, req.processed + chunk):
+            return False
+        stream = req.prompt + req.generated
+        ids = np.zeros((1, cfg.prefill_chunk), np.int32)
+        ids[0, :chunk] = stream[req.processed:req.processed + chunk]
+        wmask = np.zeros((1, cfg.prefill_chunk), bool)
+        wmask[0, :chunk] = True
+        bt = self._block_table_rows([req])
+        nxt, self._arenas = self._call(
+            "prefill", self._prefill_fn, self._params, self._arenas,
+            ids, bt, np.asarray([req.processed], np.int32), wmask,
+            np.asarray([chunk - 1], np.int32))
+        req.processed += chunk
+        if req.processed >= total:
+            self._emit_token(req, int(nxt[0]), emissions)
+        return True
+
+    # -------------------------------------------------------------- decode
+
+    def _decode_step(self, emissions) -> bool:
+        import numpy as np
+
+        cfg = self.config
+        active: List[Request] = []
+        for req in list(self._scheduled()):
+            if req.state != DECODE:
+                continue
+            # Writing cur_token at position `processed` needs capacity for
+            # processed + 1 tokens.
+            if self._ensure_blocks(req, req.processed + 1):
+                active.append(req)
+        # A later sequence's block claim may have preempted one already
+        # admitted to this step — keep only the still-scheduled.
+        active = [r for r in active if r.state == DECODE
+                  and r.slot is not None]
+        if not active:
+            return False
+        B = cfg.batch_slots
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros(B, np.int32)
+        wmask = np.zeros((B, 1), bool)
+        rows = [None] * B
+        for req in active:
+            i = req.slot
+            rows[i] = req
+            toks[i, 0] = req.cur_token
+            pos[i] = req.processed
+            wmask[i, 0] = True
+        bt = self._block_table_rows(rows)
+        nxt, self._arenas = self._call(
+            "decode", self._decode_fn, self._params, self._arenas,
+            toks, bt, pos, wmask)
+        nxt = np.asarray(nxt)
+        for req in active:
+            req.processed += 1
+            self._emit_token(req, int(nxt[req.slot]), emissions)
+        return True
+
+    # ------------------------------------------------------------- helpers
+
+    def _call(self, name: str, fn, *args):
+        self._shapes[name].add(tuple(
+            getattr(a, "shape", None) for a in args[2:]))
+        return fn(*args)
+
+    def _block_table_rows(self, reqs) -> "np.ndarray":  # noqa: F821
+        import numpy as np
+
+        cfg = self.config
+        bt = np.zeros((len(reqs), cfg.max_blocks_per_seq), np.int32)
+        for i, req in enumerate(reqs):
+            if req is None or req.done or req.state == WAITING:
+                continue
+            table = self._bm.block_table(req.request_id)
+            bt[i, :len(table)] = table
+        return bt
+
+    def _emit_token(self, req: Request, token: int, emissions):
+        req.generated.append(token)
+        req.cur_token = token
+        req.state = DECODE
+        self._record_emit(req, ("token", token), emissions)
+        if (len(req.generated) >= req.max_new_tokens
+                or (self.config.eos_id is not None
+                    and token == self.config.eos_id)):
+            self._finish(req, emissions)
+
+    def _record_emit(self, req: Request, event, emissions):
+        """Route one client-visible event. Static mode holds everything
+        back until the gang drains — that IS the baseline's latency."""
+        if self.config.scheduling == "static" and event[0] == "token":
+            req._held_emits.append(event)
+            return
+        self._fire(req, event, emissions)
+
+    def _fire(self, req: Request, event, emissions):
+        kind, payload = event
+        if kind == "token":
+            now = time.monotonic()
+            if req.first_token_at is None:
+                req.first_token_at = now
+            self._tokens_emitted += 1
+            self._rate_window.append((now, 1))
+            # Prune the stale head here, not just in stats(): an unpolled
+            # engine must not grow a tuple per token forever.
+            while self._rate_window and now - self._rate_window[0][0] > 5.0:
+                self._rate_window.pop(0)
+            if req.on_token is not None:
+                emissions.append((req.on_token, (req, payload)))
+        else:  # finish
+            req.finished_at = time.monotonic()
+            if req.on_finish is not None:
+                emissions.append((req.on_finish, (req,)))
+
+    def _finish(self, req: Request, emissions, error: Optional[str] = None):
+        req.state = FAILED if error else FINISHED
+        req.error = error
+        if error:
+            self._failed += 1
+        else:
+            self._finished += 1
+        if self.config.scheduling == "static" and not error:
+            # Hold the slot (and blocks) until the whole gang drains:
+            # request-level batching runs at the longest member's speed.
+            req.state = _DONE_HOLD
+            return
+        for event in req._held_emits:   # static error: flush, then fail
+            self._fire(req, event, emissions)
+        req._held_emits = []
+        self._bm.free(req.request_id)
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+        self._live.pop(req.request_id, None)
+        self._fire(req, ("finish", None), emissions)
+
+    def fail_all(self, error: str) -> int:
+        """Abort every scheduled and waiting request with `error` (the
+        EngineLoop's circuit breaker after repeated step failures —
+        callers must see the failure, not hang on futures nothing will
+        resolve). Completed static gang members are released as
+        successes. Returns how many requests were failed."""
+        emissions: List[tuple] = []
+        failed = 0
+        with self._lock:
+            for req in list(self._scheduled()):
+                if req.state == _DONE_HOLD:
+                    self._release_hold(req, emissions)
+                else:
+                    self._finish(req, emissions, error=error)
+                    failed += 1
+            for req in self._waiting:
+                req.state = FAILED
+                req.error = error
+                self._failed += 1
+                failed += 1
+                self._live.pop(req.request_id, None)
+                self._fire(req, ("finish", None), emissions)
+            self._waiting.clear()
+            # Rebuild the arena: a step that failed mid-execution consumed
+            # the DONATED buffers without producing replacements, so the
+            # old self._arenas may reference deleted arrays — without this
+            # every future request would fail on 'Array has been deleted'
+            # and the circuit breaker could never actually recover.
+            from ray_tpu.models.llama import make_paged_arena
+
+            self._arenas = make_paged_arena(
+                self._model.config, self.config.num_blocks,
+                self.config.block_size)
+        for fn, args in emissions:
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001
+                pass
+        return failed
+
+    def _release_static_gang(self, emissions):
+        if self.config.scheduling != "static":
+            return
+        scheduled = self._scheduled()
+        if not scheduled or any(r.state != _DONE_HOLD for r in scheduled):
+            return
+        for req in scheduled:
+            self._release_hold(req, emissions)
+
+    def _release_hold(self, req: Request, emissions):
+        """Complete a static DONE_HOLD member: flush its held events in
+        order, free its slot and blocks, fire its finish."""
+        req.state = FINISHED
+        self._live.pop(req.request_id, None)
+        for event in req._held_emits:
+            self._fire(req, event, emissions)
+        req._held_emits = []
+        self._bm.free(req.request_id)
+        self._slots[req.slot] = None
+        req.slot = None
+        self._fire(req, ("finish", None), emissions)
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine statistics. Non-blocking: a step mid-XLA-compile can
+        hold the engine lock for seconds, and the replica's health check
+        (stats with a 1s timeout) must not read that as a dead replica —
+        fall back to the last snapshot instead of parking."""
+        if not self._lock.acquire(timeout=0.2):
+            return dict(self._last_stats)
+        try:
+            self._last_stats = self._stats_locked()
+            return dict(self._last_stats)
+        finally:
+            self._lock.release()
+
+    def _stats_locked(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        self._rate_window = [(t, n) for t, n in self._rate_window
+                             if now - t <= 5.0]
+        window_tokens = sum(n for _, n in self._rate_window)
+        span = (now - self._rate_window[0][0]) if self._rate_window else 0.0
+        running = [r for r in self._slots if r is not None
+                   and r.state in (PREFILL, DECODE)]
+        return {
+            "queue_depth": len(self._waiting),
+            "running": len(running),
+            "batch_slots": self.config.batch_slots,
+            "tokens_emitted": self._tokens_emitted,
+            "tokens_per_sec": (window_tokens / span) if span > 0 else 0.0,
+            "requests_finished": self._finished,
+            "requests_failed": self._failed,
+            "preemptions": self._preemptions,
+            "recomputed_tokens": self._recomputed_tokens,
+            "prefill_compiles": self._program_compiles("prefill"),
+            "decode_compiles": self._program_compiles("decode"),
+            "kv": self._bm.stats(),
+        }
+
+    def check_no_leaks(self):
+        """Test hook: after every request finishes, the arena must be
+        fully free and internally consistent."""
+        with self._lock:
+            self._bm.check_consistency()
+            assert self._bm.blocks_in_use() == 0, self._bm.stats()
+
+
+class EngineLoop:
+    """Background thread driving `engine.step()` while there is work.
+
+    Submissions from any thread; the replica's asyncio loop talks to it
+    through thread-safe callbacks (`api.py`)."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="inference-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    # After this many consecutive step failures every in-flight request
+    # is failed (fail_all) instead of retrying the same broken state
+    # forever while callers hang on futures nothing will resolve.
+    MAX_CONSECUTIVE_FAILURES = 3
+
+    def submit(self, *args, **kwargs) -> Request:
+        # Check-and-enqueue under the loop's condition: a submit racing
+        # stop() must either raise or land before stop's fail_all sweep —
+        # never slip into a queue no thread will ever drain.
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError(
+                    "engine loop is stopped (replica shutdown)")
+            req = self.engine.add_request(*args, **kwargs)
+            self._cv.notify()
+        return req
+
+    def _run(self):
+        failures = 0
+        while True:
+            with self._cv:
+                while not self._stopped and not self.engine.has_work():
+                    self._cv.wait(timeout=0.05)
+                if self._stopped:
+                    return
+            try:
+                self.engine.step()
+                failures = 0
+            except Exception as e:  # noqa: BLE001 — scheduler survives a
+                failures += 1       # bad step; circuit-break if persistent
+                logger.exception("inference engine step failed (%d/%d)",
+                                 failures, self.MAX_CONSECUTIVE_FAILURES)
+                if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    self.engine.fail_all(
+                        f"engine step failed repeatedly: "
+                        f"{type(e).__name__}: {e}")
+                    failures = 0
+                else:
+                    time.sleep(0.01)
+
+    def stop(self, timeout_s: float = 5.0):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout_s)
+        # Anything still parked (a request that slipped in as we stopped)
+        # must fail fast, not hang its caller.
+        self.engine.fail_all("engine loop stopped")

@@ -1,0 +1,170 @@
+"""Paged KV-cache block manager (vLLM/PagedAttention-shaped).
+
+The cache arena is a preallocated pool of fixed-size blocks shared by every
+sequence (`models/llama.py:make_paged_arena` holds the actual K/V tensors);
+this module owns the bookkeeping: which physical blocks belong to which
+sequence, in logical order, with refcounts so a fork shares its parent's
+blocks copy-on-write. The manager never touches device memory — it hands
+out indices, and the engine's jitted step functions read/write the arena
+through per-row block tables.
+
+Physical block 0 is reserved as the trash block: the model's scatter sends
+masked-off writes (batch padding, prefill-chunk padding) there, so it must
+never be allocated to a sequence.
+
+Invariants (asserted by tests):
+- a block is free XOR referenced; refcounts are exact across fork/free;
+- `blocks_in_use == 0` once every sequence is freed (no leaks);
+- allocation never raises on exhaustion — it returns False and the engine
+  degrades (preempts a victim) instead of OOMing.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+TRASH_BLOCK = 0
+
+
+class BlockManager:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: deque = deque(range(1, num_blocks))
+        self._ref: Dict[int, int] = {}            # physical block -> refcount
+        self._tables: Dict[str, List[int]] = {}   # seq id -> logical order
+        self._peak_in_use = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (total minus the trash block)."""
+        return self.num_blocks - 1
+
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def blocks_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def peak_in_use(self) -> int:
+        return self._peak_in_use
+
+    def num_seqs(self) -> int:
+        return len(self._tables)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        return max(0, -(-num_tokens // self.block_size))
+
+    def fits(self, num_tokens: int) -> bool:
+        """Whether a sequence of num_tokens can EVER be resident (engine
+        rejects oversized requests at submit time instead of preempting
+        forever)."""
+        return self.blocks_for_tokens(num_tokens) <= self.capacity
+
+    def block_table(self, seq_id: str) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def registered(self, seq_id: str) -> bool:
+        return seq_id in self._tables
+
+    # ---------------------------------------------------------- lifecycle
+
+    def register(self, seq_id: str) -> None:
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already registered")
+        self._tables[seq_id] = []
+
+    def ensure(self, seq_id: str, num_tokens: int) -> bool:
+        """Grow seq_id's table to cover num_tokens. False (and no change)
+        when the pool can't supply the missing blocks — caller preempts."""
+        table = self._tables[seq_id]
+        need = self.blocks_for_tokens(num_tokens) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            blk = self._free.popleft()
+            self._ref[blk] = 1
+            table.append(blk)
+        self._peak_in_use = max(self._peak_in_use, self.blocks_in_use())
+        return True
+
+    def free(self, seq_id: str) -> int:
+        """Release a sequence: decref every block, return how many went
+        back to the pool (shared blocks stay with the other holder)."""
+        table = self._tables.pop(seq_id, None)
+        if table is None:
+            return 0
+        released = 0
+        for blk in table:
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                del self._ref[blk]
+                self._free.append(blk)
+                released += 1
+        return released
+
+    def fork(self, parent_id: str, child_id: str) -> None:
+        """Child shares the parent's blocks (refcount++, no copies) —
+        beam/parallel sampling shape. Appends by either party must go
+        through ensure_appendable first (copy-on-write)."""
+        if child_id in self._tables:
+            raise ValueError(f"sequence {child_id!r} already registered")
+        table = self._tables[parent_id]
+        for blk in table:
+            self._ref[blk] += 1
+        self._tables[child_id] = list(table)
+
+    def ensure_appendable(self, seq_id: str
+                          ) -> Optional[Tuple[int, int]]:
+        """Copy-on-write for the last block: if it is shared (refcount >
+        1), claim a fresh block in its place and return (src, dst) so the
+        caller copies the arena contents; None when nothing to do. Returns
+        (src, -1) without changes when the pool is exhausted — caller
+        preempts and retries."""
+        table = self._tables[seq_id]
+        if not table:
+            return None
+        last = table[-1]
+        if self._ref[last] == 1:
+            return None
+        if not self._free:
+            return (last, -1)
+        dst = self._free.popleft()
+        self._ref[dst] = 1
+        self._ref[last] -= 1
+        table[-1] = dst
+        self._peak_in_use = max(self._peak_in_use, self.blocks_in_use())
+        return (last, dst)
+
+    def check_consistency(self) -> None:
+        """Every block is free XOR referenced, refcounts match the tables
+        (test hook; cheap enough to run after every scenario)."""
+        counts: Dict[int, int] = {}
+        for table in self._tables.values():
+            for blk in table:
+                counts[blk] = counts.get(blk, 0) + 1
+        assert counts == self._ref, (counts, self._ref)
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate free blocks"
+        assert not (free & set(self._ref)), "block both free and referenced"
+        assert TRASH_BLOCK not in free and TRASH_BLOCK not in self._ref
+        assert len(free) + len(self._ref) == self.capacity
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_in_use": self.blocks_in_use(),
+            "blocks_free": self.num_free(),
+            "peak_blocks_in_use": self._peak_in_use,
+            "sequences": self.num_seqs(),
+        }

@@ -6,12 +6,19 @@ grouped-query attention, untied LM head — the same logical-axis annotations
 as `gpt2.py` (tp shards heads/mlp, dp/fsdp shard batch, sp shards seq), so
 `make_train_step`/`mesh_shardings_for` work unchanged.
 
-Two forward paths share parameters:
+Three forward paths share parameters:
 - `__call__(input_ids)` — full-sequence training forward (flash attention).
 - `decode(input_ids, cache, pos)` — incremental inference against a
   preallocated KV cache: prefill writes the prompt's K/V once, each decode
   step attends a 1-token query over the cache (O(context) memory reads
   instead of an O(context^2) recompute per token).
+- `decode_paged(input_ids, arenas, block_tables, pos, write_mask)` — the
+  same incremental math against a PAGED cache (vLLM/PagedAttention shape):
+  K/V live in a shared fixed-size block arena; each row's block table maps
+  logical blocks to physical ones, so the continuous-batching engine
+  (`ray_tpu/inference/`) can admit/evict/preempt sequences without ever
+  reshaping the cache — one compiled program per (batch, step-width)
+  shape, forever.
 """
 
 from __future__ import annotations
@@ -109,7 +116,12 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, positions, cache: Optional[Tuple] = None):
         """cache=None: full causal forward. cache=(k, v) with layout
         [b, max_len, kv_heads, head_dim]: write this call's K/V at each
-        row's `positions` and attend over the cache; returns (x, cache')."""
+        row's `positions` and attend over the cache; returns (x, cache').
+        cache=(k_arena, v_arena, block_tables, write_mask) with arenas
+        [num_blocks, block_size, kv_heads, head_dim]: paged variant —
+        writes land at the physical slot the row's block table maps each
+        position to (masked-off tokens go to trash block 0), reads gather
+        the row's logical context back out of the arena."""
         cfg = self.cfg
         hd = cfg.head_dim
         b, s, _ = x.shape
@@ -132,6 +144,49 @@ class LlamaBlock(nn.Module):
             else:
                 attn = mha_reference(q, kf, vf, causal=True)
             new_cache = None
+        elif len(cache) == 4:
+            k_arena, v_arena, block_tables, write_mask = cache
+            nb, bsz, kvh, _ = k_arena.shape
+            max_blocks = block_tables.shape[1]
+            max_ctx = max_blocks * bsz
+            # Scatter this call's K/V into the arena. Physical slot of
+            # logical position p in row i: block_tables[i, p // bsz] * bsz
+            # + p % bsz. Masked tokens (batch padding, chunk padding) are
+            # pointed at physical block 0 — reserved as a trash block the
+            # manager never allocates — so one fixed-shape scatter handles
+            # every mix of active/idle slots without recompiling.
+            kw = k.transpose(0, 2, 1, 3).astype(k_arena.dtype)  # [b,s,kvh,d]
+            vw = v.transpose(0, 2, 1, 3).astype(v_arena.dtype)
+            blk = jnp.clip(positions // bsz, 0, max_blocks - 1)
+            phys = jnp.take_along_axis(block_tables, blk, axis=1)  # [b, s]
+            phys = jnp.where(write_mask, phys, 0)
+            flat = (phys * bsz + positions % bsz).reshape(-1)
+            k_flat = k_arena.reshape(nb * bsz, kvh, hd)
+            v_flat = v_arena.reshape(nb * bsz, kvh, hd)
+            k_flat = k_flat.at[flat].set(kw.reshape(-1, kvh, hd))
+            v_flat = v_flat.at[flat].set(vw.reshape(-1, kvh, hd))
+            # Gather each row's logical context back out of the arena.
+            slot = (block_tables * bsz)[:, :, None] \
+                + jnp.arange(bsz)[None, None, :]
+            slot = slot.reshape(b, max_ctx)
+            kf = jnp.repeat(k_flat[slot], groups, axis=2)  # [b,ctx,h,d]
+            vf = jnp.repeat(v_flat[slot], groups, axis=2)
+            # Causal over LOGICAL positions: arena slot (j, o) of a row
+            # holds logical position j*bsz+o; unwritten slots sit past
+            # every query's position (or behind trash-padded table
+            # entries) and are masked out.
+            kv_pos = jnp.arange(max_ctx)
+            mask = kv_pos[None, None, :] <= positions[:, :, None]
+            scores = jnp.einsum("bhqd,bkhd->bhqk",
+                                q.astype(jnp.float32),
+                                kf.astype(jnp.float32)) / (hd ** 0.5)
+            scores = jnp.where(mask[:, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bhqd", probs,
+                              vf.astype(jnp.float32)).astype(cfg.dtype)
+            new_cache = (k_flat.reshape(nb, bsz, kvh, hd),
+                         v_flat.reshape(nb, bsz, kvh, hd),
+                         block_tables, write_mask)
         else:
             k_cache, v_cache = cache                 # [b, max, kvh, d]
             max_len = k_cache.shape[1]
@@ -214,6 +269,40 @@ class Llama(nn.Module):
             new_cache.append(layer_cache)
         x = self.final_norm(x)
         return self.lm_head(x), new_cache
+
+    def decode_paged(self, input_ids, arenas, block_tables, row_pos,
+                     write_mask):
+        """Step-shaped paged decode: the continuous-batching engine's
+        entry point. `input_ids` [b, s] are each row's next s tokens
+        (s = 1 for decode steps, s = chunk for chunked prefill),
+        `arenas` is the per-layer [(k, v)] block arena shared by every
+        sequence, `block_tables` [b, max_blocks] maps each row's logical
+        blocks to physical ones, `row_pos` [b] is each row's first write
+        position, and `write_mask` [b, s] zeroes batch/chunk padding
+        (masked writes land in trash block 0). Returns (logits [b, s,
+        vocab], new_arenas) — all shapes static, so one jitted program
+        per (b, s) serves the engine forever."""
+        cfg = self.config
+        b, s = input_ids.shape
+        x = self.embed.astype(cfg.dtype)[input_ids]
+        positions = row_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+        new_arenas = []
+        for i, blk in enumerate(self.blocks):
+            k_a, v_a = arenas[i]
+            x, layer_cache = blk(x, positions,
+                                 cache=(k_a, v_a, block_tables, write_mask))
+            new_arenas.append((layer_cache[0], layer_cache[1]))
+        x = self.final_norm(x)
+        return self.lm_head(x), new_arenas
+
+
+def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int):
+    """Preallocated per-layer (k, v) paged arena [num_blocks, block_size,
+    kv_heads, head_dim]. Block 0 is the trash block (never allocated to a
+    sequence): masked writes land there and nothing ever reads it."""
+    shape = (num_blocks, block_size, cfg.n_kv_head, cfg.head_dim)
+    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.n_layer)]
 
 
 def make_cache(cfg: LlamaConfig, batch: int, max_len: int):

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Set
 
 
 class _BatchQueue:
@@ -31,6 +31,8 @@ class _BatchQueue:
         self._timeout = batch_wait_timeout_s
         self._queue: Optional[asyncio.Queue] = None
         self._flusher: Optional[asyncio.Task] = None
+        self._pending: Set[asyncio.Future] = set()
+        self._stopped = False
 
     def _ensure_started(self):
         # Lazily bind to the running loop (the replica's actor loop).
@@ -40,10 +42,37 @@ class _BatchQueue:
                 self._flush_forever())
 
     async def submit(self, item: Any) -> Any:
+        if self._stopped:
+            raise RuntimeError("batch queue is stopped (replica shutdown)")
         self._ensure_started()
         fut = asyncio.get_running_loop().create_future()
+        self._pending.add(fut)
+        fut.add_done_callback(self._pending.discard)
         self._queue.put_nowait((item, fut))
         return await fut
+
+    def stop(self) -> int:
+        """Replica teardown: cancel the flusher task and fail every
+        parked future (queued AND mid-batch) — without this, a replica
+        shutdown leaks the `_flush_forever` coroutine forever and strands
+        callers awaiting futures nothing will ever resolve. Returns how
+        many pending calls were failed."""
+        self._stopped = True
+        if self._flusher is not None and not self._flusher.done():
+            self._flusher.cancel()
+        self._flusher = None
+        failed = 0
+        for fut in list(self._pending):
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("replica shut down before the batched "
+                                 "call completed"))
+                failed += 1
+        self._pending.clear()
+        if self._queue is not None:
+            while not self._queue.empty():
+                self._queue.get_nowait()
+        return failed
 
     async def _flush_forever(self):
         while True:

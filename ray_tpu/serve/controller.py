@@ -507,7 +507,26 @@ class ServeController:
                     if st is None:
                         dead.append(rep)
                     else:
-                        rep.last_ongoing = st.get("ongoing", 0)
+                        # Deployment-exported backlog (__serve_metrics__,
+                        # e.g. the inference engine's queued + running
+                        # sequences) counts as pressure: streamed
+                        # generations leave `ongoing` as soon as the
+                        # stream marker returns, so the engine's own
+                        # counts are the only saturation signal for them.
+                        # max() against ongoing, not sum — a unary
+                        # generate() is BOTH an ongoing RPC and an engine
+                        # request, and adding them would double-count it.
+                        user = st.get("user") or {}
+
+                        def _n(key):
+                            try:
+                                return int(user.get(key, 0) or 0)
+                            except (TypeError, ValueError):
+                                return 0
+
+                        rep.last_ongoing = max(
+                            st.get("ongoing", 0),
+                            _n("queue_depth") + _n("running"))
                 for rep in dead:
                     logger.warning("serve: replica %s of %s failed health "
                                    "check — replacing", rep.replica_id, name)

@@ -292,13 +292,24 @@ class Replica:
         return {"items": items, "done": done, "error": error}
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "deployment": self._deployment,
             "ongoing": self._ongoing,
             "processed": self._processed,
             "errored": self._errored,
             "uptime_s": time.time() - self._started_at,
         }
+        # User-exported metrics (e.g. the inference engine's queue depth
+        # and tokens/s): the controller folds `queue_depth` into its
+        # autoscaling signal so backlog inside the deployment counts as
+        # pressure, not just in-flight RPCs.
+        hook = getattr(self._user, "__serve_metrics__", None)
+        if hook is not None:
+            try:
+                out["user"] = dict(hook())
+            except Exception:  # noqa: BLE001 — stats must never fail
+                pass
+        return out
 
     def ping(self) -> str:
         # The controller health-checks periodically: piggyback the idle
@@ -308,11 +319,36 @@ class Replica:
         return "pong"
 
     async def prepare_shutdown(self, timeout_s: float = 5.0) -> int:
-        """Graceful drain: refuse new requests, wait for ongoing ones."""
+        """Graceful drain: refuse new requests, wait for ongoing ones,
+        then tear down user-side resources — every `@serve.batch` queue
+        (its flusher task and parked futures would otherwise leak) and
+        the optional `__serve_shutdown__` hook (e.g. the inference
+        engine's scheduler thread)."""
         self._draining = True
         deadline = time.time() + timeout_s
-        while self._ongoing > 0 and time.time() < deadline:
+        # Streamed responses decrement _ongoing as soon as the stream id
+        # is returned — wait on the registered streams too, or a graceful
+        # drain would kill the engine mid-generation for clients that are
+        # still pulling tokens.
+        while (self._ongoing > 0 or self._streams) \
+                and time.time() < deadline:
             await asyncio.sleep(0.02)
+        from ray_tpu.serve.batching import _BatchQueue
+
+        for value in list(getattr(self._user, "__dict__", {}).values()):
+            if isinstance(value, _BatchQueue):
+                try:
+                    value.stop()
+                except Exception:  # noqa: BLE001 — teardown is best effort
+                    pass
+        hook = getattr(self._user, "__serve_shutdown__", None)
+        if hook is not None:
+            try:
+                out = hook()
+                if inspect.iscoroutine(out):
+                    await out
+            except Exception:  # noqa: BLE001
+                pass
         return self._ongoing
 
     def reconfigure(self, user_config: Any) -> None:

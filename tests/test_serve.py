@@ -449,3 +449,70 @@ def test_per_node_proxies_and_replacement():
         except Exception:
             pass
         cluster.shutdown()
+
+
+def test_batch_queue_stop_fails_pending_and_cancels_flusher():
+    """Satellite: _BatchQueue.stop() must cancel the flusher task and
+    fail every parked future — queued AND mid-batch — instead of leaking
+    them past replica shutdown."""
+    import asyncio
+
+    from ray_tpu.serve.batching import _BatchQueue
+
+    async def main():
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        async def fn(items):
+            started.set()
+            await release.wait()
+            return items
+
+        q = _BatchQueue(fn, max_batch_size=2, batch_wait_timeout_s=10.0)
+        t1 = asyncio.ensure_future(q.submit(1))
+        t2 = asyncio.ensure_future(q.submit(2))
+        await started.wait()           # flusher is mid-batch, parked in fn
+        flusher = q._flusher
+        assert q.stop() == 2
+        with pytest.raises(RuntimeError, match="shut down"):
+            await t1
+        with pytest.raises(RuntimeError, match="shut down"):
+            await t2
+        for _ in range(5):             # let the cancellation land
+            await asyncio.sleep(0)
+        assert flusher.done()
+        # A stopped queue refuses new work instead of parking it forever.
+        with pytest.raises(RuntimeError, match="stopped"):
+            await q.submit(3)
+
+    asyncio.run(main())
+
+
+def test_replica_teardown_stops_batch_queue_and_runs_shutdown_hook():
+    """prepare_shutdown tears down user-side resources: batch queues are
+    stopped (their parked callers fail fast) and __serve_shutdown__ runs."""
+    import asyncio
+
+    from ray_tpu.serve.replica import Replica
+
+    events = []
+
+    class User:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=30.0)
+        async def __call__(self, items):
+            return items
+
+        def __serve_shutdown__(self):
+            events.append("shutdown")
+
+    async def main():
+        rep = Replica("D", User, (), {})
+        task = asyncio.ensure_future(
+            rep.handle_request("__call__", (1,), {}))
+        await asyncio.sleep(0.05)      # flusher parked in its batch wait
+        await rep.prepare_shutdown(timeout_s=0.2)
+        with pytest.raises(RuntimeError, match="shut down"):
+            await task
+        assert events == ["shutdown"]
+
+    asyncio.run(main())
