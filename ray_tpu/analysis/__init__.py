@@ -1,0 +1,36 @@
+"""raylint: AST-based invariant checker for the control plane.
+
+The reference ships TSAN/ASAN bazel configs for its C++ core; the Python
+control plane got the *runtime* half of that in
+:mod:`ray_tpu.util.lock_witness`, but runtime witnesses only see
+interleavings that tests actually execute.  This package is the *static*
+half: a small rule engine that parses the package with :mod:`ast` and
+checks the ownership and concurrency disciplines the codebase depends on
+— DEFERRED replies must always be completed, raw store segments must be
+freed on every path, nothing blocking may run under a control-plane
+lock, broad excepts must not silently eat cancellation, threads must be
+daemonized or joined, XLA programs must be compiled once, and lock
+acquisition order must be acyclic.
+
+Usage::
+
+    python -m ray_tpu.analysis [paths] [--json] [--rules RL001,RL002]
+
+Findings print as ``path:line: RULE-ID message`` and the process exits
+non-zero when any unsuppressed finding remains.  Individual lines are
+suppressed with a trailing ``# raylint: disable=RL002`` comment (comma
+lists and ``all`` accepted; the comment may also sit on the line directly
+above); a whole file opts out of a rule with ``# raylint:
+disable-file=RL004`` in its first ten lines.  See docs/ANALYSIS.md for
+the rule catalog.
+"""
+
+from ray_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_paths,
+    rule,
+)
+from ray_tpu.analysis import rules as _rules  # noqa: F401  (registers rules)
+
+__all__ = ["Finding", "RULES", "lint_paths", "rule"]

@@ -1,0 +1,174 @@
+"""CLI: ``python -m ray_tpu.analysis [paths] [--json] [--rules ...]``.
+
+Exit code 0 when no unsuppressed finding remains (the tier-1 contract:
+``python -m ray_tpu.analysis ray_tpu/`` must exit 0), 1 otherwise, 2 on
+usage errors.  ``--sleep-report`` is a side tool for the test-budget
+audit: it sums literal ``time.sleep`` seconds (times constant loop
+bounds) per test function so heavy tests can be found and marked
+``@pytest.mark.slow`` before they drift the tier-1 suite into its
+timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from ray_tpu.analysis.engine import (
+    RULES,
+    FileContext,
+    dotted,
+    iter_python_files,
+    lint_paths,
+)
+
+
+def _default_paths() -> List[str]:
+    import ray_tpu
+
+    return [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+
+
+# ------------------------------------------------------- sleep accounting
+
+
+def _const_float(node: ast.AST) -> float:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return float(node.value)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        return _const_float(node.left) * _const_float(node.right)
+    return 0.0
+
+
+def _trip_count(range_call: ast.Call) -> float:
+    """Trip count of a `range(...)` loop; non-literal bounds count the
+    loop once (factor 1.0) rather than zeroing the sleeps inside it —
+    the report must under-estimate, never erase."""
+    args = range_call.args
+    stop = args[1] if len(args) > 1 else args[0]
+    if not isinstance(stop, ast.Constant):
+        return 1.0
+    start = 0.0
+    if len(args) > 1:
+        if not isinstance(args[0], ast.Constant):
+            return 1.0
+        start = _const_float(args[0])
+    return max(_const_float(stop) - start, 0.0)
+
+
+def _loop_multiplier(fn: ast.AST, node: ast.AST, ctx: FileContext) -> float:
+    """Product of constant trip counts of loops enclosing `node` in `fn`
+    (unknown bounds count as 1 — the report under-estimates, it never
+    invents)."""
+    mult = 1.0
+    cur = ctx.parent(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.For, ast.AsyncFor)):
+            it = cur.iter
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                    and it.func.id == "range" and it.args:
+                mult *= _trip_count(it)
+        cur = ctx.parent(cur)
+    return mult
+
+
+def sleep_report(paths: List[str]) -> List[Tuple[str, str, float]]:
+    """(path, function, aggregate literal sleep seconds), descending."""
+    rows: List[Tuple[str, str, float]] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(path, path, source)
+        except SyntaxError:
+            continue
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            total = 0.0
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    name = dotted(sub.func) or ""
+                    if name.endswith("sleep") and sub.args:
+                        total += _const_float(sub.args[0]) \
+                            * _loop_multiplier(fn, sub, ctx)
+            if total > 0:
+                rows.append((os.path.relpath(path), fn.name, total))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+# ----------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="raylint: AST-based invariant checker for the "
+                    "ray_tpu control plane")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the ray_tpu "
+                             "package)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--rules",
+                        help="comma-separated subset, e.g. RL001,RL002")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--sleep-report", action="store_true",
+                        help="per-function aggregate literal sleep seconds "
+                             "(test-budget audit), instead of linting")
+    parser.add_argument("--sleep-threshold", type=float, default=0.0,
+                        help="only report functions above this many seconds")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (_fn, desc) in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+
+    if args.sleep_report:
+        rows = [r for r in sleep_report(paths)
+                if r[2] >= args.sleep_threshold]
+        if args.json:
+            print(json.dumps([{"path": p, "function": fn, "sleep_s": s}
+                              for p, fn, s in rows], indent=2))
+        else:
+            for p, fn, s in rows:
+                print(f"{s:8.1f}s  {p}::{fn}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",")]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = lint_paths(paths, rule_ids)
+    except FileNotFoundError as e:
+        print(f"no such path: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"raylint: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `... | head` closed the pipe: fine
+        sys.exit(0)

@@ -1,0 +1,262 @@
+"""Rule engine for raylint: file walking, suppressions, reporting.
+
+A rule is a function ``fn(ctx: FileContext) -> Iterable[Finding]``
+registered with the :func:`rule` decorator.  The engine parses each file
+once, hands every rule the same :class:`FileContext` (source, lines,
+tree, parent links), filters findings through the suppression comments,
+and aggregates.  Rules never import the code they lint — everything is
+syntactic, so the linter runs in milliseconds with no cluster, no JAX,
+and no import side effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+# ---------------------------------------------------------------- findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+# ------------------------------------------------------------------- rules
+
+#: rule id -> (checker, one-line description)
+RULES: Dict[str, tuple] = {}
+
+
+def rule(rule_id: str, description: str):
+    """Register a rule checker under `rule_id` (e.g. "RL002")."""
+
+    def deco(fn: Callable[["FileContext"], Iterable[Finding]]):
+        RULES[rule_id] = (fn, description)
+        return fn
+
+    return deco
+
+
+# ------------------------------------------------------------ file context
+
+
+class FileContext:
+    """One parsed file, shared by every rule."""
+
+    def __init__(self, path: str, display_path: str, source: str):
+        self.path = path
+        self.display_path = display_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # Parent links let rules climb from a node to its enclosing
+        # function/loop/with without every rule re-implementing the walk.
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def finding(self, node_or_line, rule_id: str, message: str) -> Finding:
+        line = (node_or_line if isinstance(node_or_line, int)
+                else getattr(node_or_line, "lineno", 1))
+        return Finding(self.display_path, line, rule_id, message)
+
+
+# ------------------------------------------------------------ AST helpers
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'self._ckpt_lock' / 'time.sleep' for Name/Attribute chains, else
+    None (calls, subscripts and literals have no stable dotted name)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def walk_excluding_nested_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function bodies — code
+    in a nested def runs when the closure is *called*, not where it is
+    defined, so e.g. it does not execute under an enclosing `with lock`.
+    """
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """All statements in `body`, recursively, excluding nested defs."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                yield from statements(sub)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            yield from statements(handler.body)
+
+
+_LOCKISH = re.compile(r"(^|_)(lock|mutex|mu)($|_|\d)|_lock$|lock$")
+
+
+def is_lockish(name: Optional[str]) -> bool:
+    """Does a dotted name look like a threading lock?  Matches the
+    codebase's naming discipline (`_lock`, `_ckpt_lock`, `_state_lock`,
+    `send_lock`, `_link_lock`); deliberately does not match `clock` or
+    `blocked`."""
+    seg = last_segment(name).lower()
+    if not seg or seg.endswith("clock"):
+        return False
+    return bool(_LOCKISH.search(seg))
+
+
+# ---------------------------------------------------------- suppressions
+
+
+_DISABLE_LINE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\s]+)")
+_DISABLE_FILE = re.compile(r"#\s*raylint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+def _parse_rule_list(text: str) -> List[str]:
+    return [t.strip().upper() for t in text.split(",") if t.strip()]
+
+
+class Suppressions:
+    def __init__(self, lines: List[str]):
+        self.by_line: Dict[int, List[str]] = {}
+        self.comment_only: set = set()
+        self.file_wide: List[str] = []
+        for i, line in enumerate(lines, start=1):
+            m = _DISABLE_LINE.search(line)
+            if m:
+                self.by_line[i] = _parse_rule_list(m.group(1))
+                if line.lstrip().startswith("#"):
+                    self.comment_only.add(i)
+            if i <= 10:
+                m = _DISABLE_FILE.search(line)
+                if m:
+                    self.file_wide.extend(_parse_rule_list(m.group(1)))
+
+    def _matches(self, ln: int, rid: str) -> bool:
+        rules = self.by_line.get(ln)
+        return bool(rules) and (rid in rules or "ALL" in rules)
+
+    def suppressed(self, finding: Finding) -> bool:
+        rid = finding.rule.upper()
+        if rid in self.file_wide or "ALL" in self.file_wide:
+            return True
+        # Trailing comment on the flagged line, or a COMMENT-ONLY line
+        # directly above it (for lines too long to carry the marker).
+        # The comment-only check matters: a trailing marker on the
+        # previous code line must not leak onto this one and silently
+        # suppress an unannotated neighboring violation.
+        if self._matches(finding.line, rid):
+            return True
+        return (finding.line - 1 in self.comment_only
+                and self._matches(finding.line - 1, rid))
+
+
+# --------------------------------------------------------------- running
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(path)
+
+
+def lint_file(path: str, rule_ids: Optional[Sequence[str]] = None,
+              display_path: Optional[str] = None) -> List[Finding]:
+    display = display_path if display_path is not None else path
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        ctx = FileContext(path, display, source)
+    except SyntaxError as e:
+        return [Finding(display, e.lineno or 1, "RL000",
+                        f"syntax error: {e.msg}")]
+    sup = Suppressions(ctx.lines)
+    out: List[Finding] = []
+    for rid, (checker, _desc) in sorted(RULES.items()):
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        for finding in checker(ctx):
+            if not sup.suppressed(finding):
+                out.append(finding)
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               rule_ids: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every ``*.py`` under `paths`; returns unsuppressed findings
+    sorted by (path, line, rule)."""
+    findings: List[Finding] = []
+    cwd = os.getcwd()
+    for path in iter_python_files(paths):
+        display = os.path.relpath(path, cwd)
+        if display.startswith(".." + os.sep):
+            display = path
+        findings.extend(lint_file(path, rule_ids, display_path=display))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,791 @@
+"""The raylint rule set: framework-specific invariants, statically.
+
+Each rule targets a discipline the control plane depends on but that no
+runtime test can prove on paths it never executes (see docs/ANALYSIS.md
+for the catalog with real before/after examples):
+
+- RL001 deferred-reply-leak    — DEFERRED replies must always complete
+- RL002 blocking-under-lock    — nothing blocking under a control lock
+- RL003 raw-buffer-leak        — put_raw segments freed on every path
+- RL004 swallowed-exception    — broad excepts must log or re-raise
+- RL005 thread-leak            — threads daemonized or joined
+- RL006 jit-retrace-hazard     — XLA programs compiled once, cached
+- RL007 static-lock-order      — lock acquisition graph is acyclic
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    dotted,
+    is_lockish,
+    last_segment,
+    rule,
+    statements,
+    walk_excluding_nested_functions,
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _functions(ctx: FileContext) -> Iterator[ast.AST]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node
+
+
+def _calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in walk_excluding_nested_functions(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+# =====================================================================
+# RL001 deferred-reply-leak
+# =====================================================================
+#
+# The RPC server's contract (core/rpc.py): a handler that returns
+# DEFERRED owns the reply — some later code MUST call conn.reply /
+# conn.reply_raw with the parked msg id, or the caller hangs until its
+# client-side timeout.  Raising BEFORE the DEFERRED return is safe (the
+# server loop converts it to an error reply); the two statically
+# checkable leaks are:
+#
+#  (a) a completion closure (the code that runs later, off the server
+#      thread) that can raise before its reply call with no except/
+#      finally path that also replies — the parked caller hangs;
+#  (b) a `raise` after the handler has already parked (conn, msg_id) in
+#      a waiter structure — the server sends an error reply AND the
+#      waiter drain later replies again to the same msg id.
+
+
+def _returns_deferred(fn: ast.AST) -> Optional[int]:
+    for sub in walk_excluding_nested_functions(fn):
+        if (isinstance(sub, ast.Return)
+                and last_segment(dotted(sub.value)) == "DEFERRED"):
+            return sub.lineno
+    return None
+
+
+_REPLY_METHODS = {"reply", "reply_raw"}
+
+
+def _is_reply_call(call: ast.Call, reply_fn_names: Set[str]) -> bool:
+    name = dotted(call.func)
+    return (last_segment(name) in _REPLY_METHODS
+            or (name is not None and name in reply_fn_names))
+
+
+def _nested_functions(fn: ast.AST) -> List[ast.AST]:
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, _FUNC_NODES):
+            out.append(cur)
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+def _reply_fn_fixpoint(nested: List[ast.AST]) -> Set[str]:
+    """Names of nested functions that (transitively) issue a reply."""
+    names: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for nf in nested:
+            if nf.name in names:
+                continue
+            for call in _calls_in(nf):
+                if _is_reply_call(call, names):
+                    names.add(nf.name)
+                    changed = True
+                    break
+    return names
+
+
+def _completion_guarded(nf: ast.AST, reply_fn_names: Set[str]) -> bool:
+    """Every risky statement of a completion closure must sit inside a
+    try whose except/finally also replies (the worker.py idiom:
+    ``try: reply_ok(run()) except BaseException as e: reply_err(e)``)."""
+
+    def try_replies(t: ast.Try) -> bool:
+        for blk in list(t.handlers) + ([ast.Try(body=t.finalbody,
+                                                handlers=[], orelse=[],
+                                                finalbody=[])]
+                                       if t.finalbody else []):
+            body = blk.body if hasattr(blk, "body") else []
+            for stmt in statements(body):
+                for call in _calls_in(stmt):
+                    if _is_reply_call(call, reply_fn_names):
+                        return True
+        return False
+
+    def walk(body: Sequence[ast.stmt], guarded: bool) -> bool:
+        for stmt in body:
+            if isinstance(stmt, _FUNC_NODES):
+                continue
+            if isinstance(stmt, ast.Try):
+                inner_ok = try_replies(stmt)
+                if not walk(stmt.body, guarded or inner_ok):
+                    return False
+                for h in stmt.handlers:
+                    if not walk(h.body, guarded):
+                        return False
+                if not walk(stmt.finalbody, guarded):
+                    return False
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and _is_reply_call(stmt.value, reply_fn_names)):
+                continue  # the reply itself
+            has_call = any(True for _ in _calls_in(stmt))
+            if (has_call or isinstance(stmt, ast.Raise)) and not guarded:
+                return False
+            for field in ("body", "orelse"):
+                sub = getattr(stmt, field, None)
+                if sub and not walk(sub, guarded):
+                    return False
+        return True
+
+    return walk(nf.body, False)
+
+
+def _msgid_vars(fn: ast.AST) -> Set[str]:
+    out = {"current_msg_id"}
+    for sub in walk_excluding_nested_functions(fn):
+        if (isinstance(sub, ast.Assign)
+                and last_segment(dotted(sub.value)) == "current_msg_id"):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _registration_line(fn: ast.AST, msgid_vars: Set[str]) -> Optional[int]:
+    """Line of the first statement that stores a msg-id var into a waiter
+    structure (an .append/.add call or a subscript/attribute store whose
+    value mentions the var) — after this the reply is co-owned by the
+    drain path."""
+    for stmt in fn.body and statements(fn.body):
+        if isinstance(stmt, _FUNC_NODES):
+            continue
+        mentions = any(isinstance(n, ast.Name) and n.id in msgid_vars
+                       for n in ast.walk(stmt))
+        if not mentions:
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            if last_segment(dotted(stmt.value.func)) in ("append", "add",
+                                                         "put", "setdefault"):
+                return stmt.lineno
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, (ast.Subscript, ast.Attribute))
+                for t in stmt.targets):
+            return stmt.lineno
+    return None
+
+
+@rule("RL001", "deferred-reply-leak: a DEFERRED handler has a path that "
+               "neither replies nor fails the parked caller")
+def check_deferred_reply(ctx: FileContext) -> Iterable[Finding]:
+    for fn in _functions(ctx):
+        if ctx.enclosing_function(fn) is not None:
+            continue  # visit outermost handlers; closures checked within
+        deferred_line = _returns_deferred(fn)
+        if deferred_line is None:
+            continue
+        nested = _nested_functions(fn)
+        reply_fns = _reply_fn_fixpoint(nested)
+        for nf in nested:
+            if nf.name in reply_fns and not _completion_guarded(nf, reply_fns):
+                yield ctx.finding(
+                    nf, "RL001",
+                    f"completion path '{nf.name}' of a DEFERRED reply can "
+                    "raise before replying — the parked caller would hang; "
+                    "wrap it so every exception path also replies "
+                    "(try/except that sends the error)")
+        reg_line = _registration_line(fn, _msgid_vars(fn))
+        if reg_line is not None:
+            for sub in walk_excluding_nested_functions(fn):
+                if (isinstance(sub, ast.Raise)
+                        and reg_line < sub.lineno < deferred_line):
+                    yield ctx.finding(
+                        sub, "RL001",
+                        "raise after parking a DEFERRED waiter: the server "
+                        "sends an error reply AND the waiter drain later "
+                        "replies again to the same msg id — park last, or "
+                        "unregister the waiter before raising")
+
+
+# =====================================================================
+# RL002 blocking-under-lock
+# =====================================================================
+#
+# The static twin of lock_witness's watchdog: a blocking call under a
+# control-plane lock turns every other thread that needs the lock into a
+# hostage of the slow operation (and an RPC back to the lock holder
+# deadlocks outright).  The witness only sees executed interleavings;
+# this sees every path.
+
+_BLOCKING_LAST = {"sleep", "result", "call", "call_raw", "call_raw_into",
+                  "get_raw", "get_bytes", "allreduce", "allgather",
+                  "reducescatter", "barrier"}
+_SUBPROCESS_LAST = {"run", "Popen", "check_output", "check_call", "call"}
+
+
+def _thread_vars(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in walk_excluding_nested_functions(fn):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            callee = dotted(sub.value.func)
+            if last_segment(callee) == "Thread":
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _blocking_reason(call: ast.Call, thread_vars: Set[str],
+                     held_locks: Sequence[Optional[str]] = ()) -> Optional[str]:
+    name = dotted(call.func)
+    # A dotted name can be unavailable (`self._kv().call`) while the
+    # method name still is: fall back to the raw attribute.
+    if name is None and isinstance(call.func, ast.Attribute):
+        last = call.func.attr
+        name = f"<expr>.{last}"
+    else:
+        last = last_segment(name)
+    if name and name.startswith("subprocess.") and last in _SUBPROCESS_LAST:
+        return f"subprocess call {name}()"
+    if last in _BLOCKING_LAST:
+        if last == "call" and name is not None and "." not in name:
+            return None  # bare call() — not an RPC client method
+        return f"blocking call {name or last}()"
+    if last == "join" and name is not None:
+        recv = name.rsplit(".", 1)[0]
+        if recv in thread_vars or "thread" in recv.lower():
+            return f"thread join {name}()"
+    if last == "get":
+        for kw in call.keywords:
+            if kw.arg in ("timeout", "block"):
+                return f"blocking queue get {name}()"
+    if last == "wait" and (call.args or call.keywords):
+        # Argument-carrying waits (Event.wait(timeout), Future.wait(...))
+        # block under the lock like any other call. Condition.wait is
+        # exempt: it holds its own lock by contract and releases it while
+        # parked — recognized either by waiting on the very object the
+        # `with` holds, or by a condition-ish receiver name.
+        recv = (name or "").rsplit(".", 1)[0]
+        if recv in held_locks or "cond" in recv.lower():
+            return None
+        return f"blocking wait {name or last}()"
+    return None
+
+
+@rule("RL002", "blocking-under-lock: blocking API called while holding a "
+               "control-plane lock")
+def check_blocking_under_lock(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_names = [dotted(item.context_expr) for item in node.items
+                      if is_lockish(dotted(item.context_expr))]
+        if not lock_names:
+            continue
+        fn = ctx.enclosing_function(node)
+        tvars = _thread_vars(fn) if fn is not None else set()
+        for stmt in node.body:
+            if isinstance(stmt, _FUNC_NODES):
+                continue  # closure bodies run later, not under the lock
+            # Calls inside a NESTED lock-with are attributed to the
+            # innermost lock by that With's own pass of the outer walk —
+            # scanning them here too would duplicate every finding once
+            # per enclosing lock.
+            nested: set = set()
+            # walk_excluding_nested_functions yields descendants only, so
+            # include stmt itself: the nested lock-with is often the
+            # direct child statement of the outer body.
+            for sub in (stmt, *walk_excluding_nested_functions(stmt)):
+                if isinstance(sub, (ast.With, ast.AsyncWith)) and any(
+                        is_lockish(dotted(item.context_expr))
+                        for item in sub.items):
+                    for inner in sub.body:
+                        nested.update(walk_excluding_nested_functions(inner))
+            for sub in walk_excluding_nested_functions(stmt):
+                if sub in nested or not isinstance(sub, ast.Call):
+                    continue
+                reason = _blocking_reason(sub, tvars, lock_names)
+                if reason is not None:
+                    yield ctx.finding(
+                        sub, "RL002",
+                        f"{reason} while holding {lock_names[0]} — move "
+                        "the blocking work outside the lock (snapshot "
+                        "state under the lock, act on it after release)")
+
+
+# =====================================================================
+# RL003 raw-buffer-leak
+# =====================================================================
+#
+# put_raw/put_bytes mint a store segment with NO ObjectRef and therefore
+# no refcount GC — whoever holds the ObjectID owns the bytes until
+# free_raw.  A function that creates one and neither hands ownership off
+# nor guarantees the free on exception paths leaks a pinned segment per
+# failure, which under load exhausts the store (the exact leak class the
+# transfer plane's delete-on-failure paths exist to prevent).
+
+_ALLOC_LAST = {"put_raw", "put_bytes", "make_buffer", "create_buffer"}
+_FREE_LAST = {"free_raw", "free", "free_objects", "delete", "release"}
+
+
+def _name_mentioned(node: ast.AST, var: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in ast.walk(node))
+
+
+@rule("RL003", "raw-buffer-leak: put_raw segment not freed on every path")
+def check_raw_buffer_leak(ctx: FileContext) -> Iterable[Finding]:
+    for fn in _functions(ctx):
+        allocs: List[Tuple[str, ast.Assign]] = []
+        for sub in walk_excluding_nested_functions(fn):
+            if (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)
+                    and last_segment(dotted(sub.value.func)) in _ALLOC_LAST
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                allocs.append((sub.targets[0].id, sub))
+        for var, assign in allocs:
+            escaped = False
+            freed_in_finally = False
+            freed_anywhere = False
+            for sub in walk_excluding_nested_functions(fn):
+                if sub is assign or getattr(sub, "lineno", 0) < assign.lineno:
+                    continue
+                if isinstance(sub, ast.Return) and sub.value is not None \
+                        and _name_mentioned(sub.value, var):
+                    escaped = True
+                elif isinstance(sub, ast.Assign) \
+                        and _name_mentioned(sub.value, var) \
+                        and any(isinstance(t, (ast.Attribute, ast.Subscript))
+                                for t in sub.targets):
+                    # Stored into an attribute/container: ownership handed
+                    # to whatever owns that structure.
+                    escaped = True
+                elif isinstance(sub, ast.Call):
+                    callee = dotted(sub.func)
+                    last = last_segment(callee)
+                    mentioned = any(_name_mentioned(a, var)
+                                    for a in list(sub.args)
+                                    + [kw.value for kw in sub.keywords])
+                    if not mentioned:
+                        continue
+                    if last in _FREE_LAST:
+                        freed_anywhere = True
+                        for anc in ctx.ancestors(sub):
+                            if anc is fn:
+                                break
+                            if isinstance(anc, ast.Try) and any(
+                                    s.lineno <= sub.lineno <= (
+                                        getattr(s, "end_lineno", s.lineno)
+                                        or s.lineno)
+                                    for s in anc.finalbody):
+                                freed_in_finally = True
+                        continue
+                    # Any other call taking the id transfers ownership
+                    # (registry append, RPC carrying the id, constructor).
+                    escaped = True
+            if escaped:
+                continue
+            if not freed_anywhere:
+                yield ctx.finding(
+                    assign, "RL003",
+                    f"'{var}' holds a raw store segment that is never "
+                    "freed or handed off in this function — call "
+                    "free_raw in a finally, or transfer ownership")
+            elif not freed_in_finally:
+                yield ctx.finding(
+                    assign, "RL003",
+                    f"'{var}' holds a raw store segment freed only on the "
+                    "fall-through path — an exception between put_raw and "
+                    "the free leaks the segment; move the free into a "
+                    "finally")
+
+
+# =====================================================================
+# RL004 swallowed-exception
+# =====================================================================
+#
+# A bare `except:`/`except Exception:` that neither re-raises nor logs
+# can eat CollectiveError and task-cancellation signals — a rank death
+# becomes a silent wrong answer instead of an abort.  Scoped to the
+# packages where those signals travel (core/, collective/, inference/,
+# serve/); an intentional best-effort swallow must say so: either narrow
+# the type, log at debug, or carry a `# raylint: disable=RL004` (the
+# codebase's `# noqa: BLE001 — reason` convention is honored too).
+
+_RL004_PACKAGES = {"core", "collective", "inference", "serve"}
+_LOGGISH = ("log", "warn", "exception", "print", "reply", "fail", "abort",
+            "record", "error")
+
+
+def _in_scope_rl004(path: str) -> bool:
+    # Scope from the file's real location, not its display path: the
+    # display string is cwd-relative, and deriving scope from it made the
+    # same tree lint clean from the repo root but dirty from inside the
+    # package. The package root is the `ray_tpu` directory that actually
+    # carries an `__init__.py` (innermost wins, for checkouts nested
+    # under a directory that happens to be named ray_tpu).
+    parts = os.path.abspath(path).replace("\\", "/").split("/")
+    for idx in range(len(parts) - 1, -1, -1):
+        if parts[idx] != "ray_tpu":
+            continue
+        root = "/".join(parts[:idx + 1])
+        if os.path.isfile(os.path.join(root, "__init__.py")):
+            return (len(parts) > idx + 2
+                    and parts[idx + 1] in _RL004_PACKAGES)
+    return True  # fixtures and out-of-tree files: always checked
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(isinstance(n, ast.Name)
+               and n.id in ("Exception", "BaseException") for n in names)
+
+
+@rule("RL004", "swallowed-exception: broad except neither re-raises nor "
+               "logs (can eat CollectiveError/cancellation)")
+def check_swallowed_exception(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl004(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _broad_handler(node):
+            continue
+        line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) \
+            else ""
+        if "noqa" in line and "BLE001" in line:
+            continue
+        handled = False
+        for stmt in statements(node.body):
+            if isinstance(stmt, ast.Raise):
+                handled = True
+            for call in _calls_in(stmt):
+                name = (dotted(call.func) or "").lower()
+                if any(k in name for k in _LOGGISH):
+                    handled = True
+        if not handled:
+            yield ctx.finding(
+                node, "RL004",
+                "broad except swallows the error silently — re-raise, log "
+                "it, narrow the exception type, or annotate why it is safe")
+
+
+# =====================================================================
+# RL005 thread-leak
+# =====================================================================
+#
+# A non-daemon thread with no tracked join outlives shutdown() and holds
+# the interpreter (and the test suite) hostage; every long-lived loop in
+# this codebase is `daemon=True` plus an explicit stop signal.
+
+
+@rule("RL005", "thread-leak: Thread without daemon=True and no tracked join")
+def check_thread_leak(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or last_segment(dotted(node.func)) != "Thread":
+            continue
+        name = dotted(node.func)
+        if name not in ("threading.Thread", "Thread"):
+            continue
+        daemon_kw = next((kw for kw in node.keywords
+                          if kw.arg == "daemon"), None)
+        if daemon_kw is not None:
+            # daemon=False is exactly the leak this rule exists to flag;
+            # a non-constant value gets the benefit of the doubt.
+            if not isinstance(daemon_kw.value, ast.Constant) \
+                    or bool(daemon_kw.value.value):
+                continue
+        parent = ctx.parent(node)
+        target_names: List[str] = []
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                tname = dotted(tgt)
+                if tname:
+                    target_names.append(tname)
+        handled = False
+        fn = ctx.enclosing_function(node)
+        scope = fn if fn is not None else ctx.tree
+        if target_names:
+            for sub in ast.walk(ctx.tree if any("." in t
+                                                for t in target_names)
+                                else scope):
+                if isinstance(sub, ast.Call):
+                    callee = dotted(sub.func)
+                    if callee and last_segment(callee) == "join" \
+                            and callee.rsplit(".", 1)[0] in target_names:
+                        handled = True
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        tname = dotted(tgt)
+                        if tname and tname.endswith(".daemon") \
+                                and tname.rsplit(".", 1)[0] in target_names:
+                            handled = True
+        if not handled:
+            yield ctx.finding(
+                node, "RL005",
+                "thread is neither daemon=True nor joined — it will outlive "
+                "shutdown and pin the process; pass daemon=True or track "
+                "and join it")
+
+
+# =====================================================================
+# RL006 jit-retrace-hazard
+# =====================================================================
+#
+# `jax.jit(fn)` builds a fresh cache; constructing it inside a loop or a
+# per-step method compiles a new XLA program every call — the exact
+# failure the inference engine's compile-once counters guard at runtime.
+# jit objects belong at module scope, factory scope, or cached on self
+# behind an `is None` check.
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_FACTORY_PREFIXES = ("make", "build", "create", "get", "init", "setup",
+                     "compile", "_make", "_build", "_create", "_get",
+                     "_init", "_setup", "_compile", "__init__")
+_PERSTEP_NAMES = {"forward", "decode", "prefill", "generate", "sample"}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    return name in _JIT_NAMES or last_segment(name) in ("jit", "pjit")
+
+
+def _cached_behind_none_check(ctx: FileContext, call: ast.Call) -> bool:
+    for anc in ctx.ancestors(call):
+        if isinstance(anc, _FUNC_NODES):
+            return False
+        if isinstance(anc, ast.If):
+            test = ast.unparse(anc.test)
+            if "is None" in test or "not " in test:
+                return True
+    return False
+
+
+@rule("RL006", "jit-retrace-hazard: jax.jit/pjit constructed per call "
+               "instead of cached")
+def check_jit_retrace(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+            continue
+        in_loop = False
+        fn_name = None
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                in_loop = True
+            if isinstance(anc, _FUNC_NODES):
+                fn_name = anc.name
+                break
+        if in_loop and not _cached_behind_none_check(ctx, node):
+            yield ctx.finding(
+                node, "RL006",
+                "jax.jit constructed inside a loop — every iteration builds "
+                "a fresh trace cache and recompiles; hoist the jit to "
+                "module/factory scope")
+            continue
+        if fn_name is None:
+            continue
+        lowered = fn_name.lower()
+        if lowered.startswith(_FACTORY_PREFIXES):
+            continue
+        perstep = ("step" in lowered) or (lowered in _PERSTEP_NAMES)
+        if perstep and not _cached_behind_none_check(ctx, node):
+            yield ctx.finding(
+                node, "RL006",
+                f"jax.jit constructed inside per-step method '{fn_name}' — "
+                "each call recompiles; cache the jitted callable at "
+                "factory scope or on self behind an `is None` check")
+
+
+# =====================================================================
+# RL007 static-lock-order
+# =====================================================================
+#
+# The compile-time twin of lock_witness: per class, every lexically
+# nested `with lock:` acquisition (including one hop through self-method
+# calls) becomes an edge in a lock-order graph; a cycle is a lock-order
+# inversion that will deadlock under the right timing even though no
+# test ever produces that interleaving.  Self-edges are reported only
+# for locks known to be plain (non-reentrant) Locks.
+
+
+def _lock_key(cls_name: str, name: str) -> str:
+    if name.startswith("self."):
+        return f"{cls_name}.{name[len('self.'):]}"
+    return name
+
+
+def _class_lock_kinds(cls: ast.ClassDef) -> Dict[str, str]:
+    """self attr -> 'lock' | 'rlock' for `self._x = threading.Lock()`."""
+    kinds: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = last_segment(dotted(node.value.func))
+            if callee in ("Lock", "RLock"):
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name and name.startswith("self."):
+                        kinds[_lock_key(cls.name, name)] = callee.lower()
+    return kinds
+
+
+def _method_lock_info(cls: ast.ClassDef):
+    """Per method: directly acquired lock keys and called self-methods."""
+    methods: Dict[str, ast.AST] = {}
+    for node in cls.body:
+        if isinstance(node, _FUNC_NODES):
+            methods[node.name] = node
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, Set[str]] = {}
+    for mname, m in methods.items():
+        locks: Set[str] = set()
+        callees: Set[str] = set()
+        for sub in walk_excluding_nested_functions(m):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    name = dotted(item.context_expr)
+                    if is_lockish(name):
+                        locks.add(_lock_key(cls.name, name))
+            elif isinstance(sub, ast.Call):
+                callee = dotted(sub.func)
+                if callee and callee.startswith("self.") \
+                        and callee.count(".") == 1:
+                    callees.add(callee[len("self."):])
+        direct[mname] = locks
+        calls[mname] = callees
+    # Transitive may-acquire set per method (fixpoint over self-calls).
+    may: Dict[str, Set[str]] = {m: set(direct[m]) for m in methods}
+    changed = True
+    while changed:
+        changed = False
+        for m in methods:
+            for callee in calls[m]:
+                if callee in may and not may[callee] <= may[m]:
+                    may[m] |= may[callee]
+                    changed = True
+    return methods, may
+
+
+@rule("RL007", "static-lock-order: cyclic lock acquisition order")
+def check_lock_order(ctx: FileContext) -> Iterable[Finding]:
+    edges: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], int] = {}
+
+    def add_edge(a: str, b: str, line: int):
+        if a == b:
+            return
+        edges.setdefault(a, set())
+        if b not in edges[a]:
+            edges[a].add(b)
+            sites[(a, b)] = line
+
+    self_deadlocks: List[Tuple[str, int]] = []
+
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        kinds = _class_lock_kinds(cls)
+        methods, may = _method_lock_info(cls)
+        for mname, m in methods.items():
+            for w in walk_excluding_nested_functions(m):
+                if not isinstance(w, (ast.With, ast.AsyncWith)):
+                    continue
+                held = [_lock_key(cls.name, dotted(i.context_expr))
+                        for i in w.items
+                        if is_lockish(dotted(i.context_expr))]
+                if not held:
+                    continue
+                for sub in walk_excluding_nested_functions(
+                        ast.Module(body=w.body, type_ignores=[])):
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        for item in sub.items:
+                            name = dotted(item.context_expr)
+                            if is_lockish(name):
+                                inner = _lock_key(cls.name, name)
+                                for h in held:
+                                    if (inner == h and
+                                            kinds.get(h) == "lock"):
+                                        self_deadlocks.append(
+                                            (h, sub.lineno))
+                                    add_edge(h, inner, sub.lineno)
+                    elif isinstance(sub, ast.Call):
+                        callee = dotted(sub.func)
+                        if callee and callee.startswith("self.") \
+                                and callee.count(".") == 1:
+                            for inner in may.get(callee[len("self."):], ()):
+                                for h in held:
+                                    if (inner == h
+                                            and kinds.get(h) == "lock"):
+                                        self_deadlocks.append(
+                                            (h, sub.lineno))
+                                    add_edge(h, inner, sub.lineno)
+
+    for lock_name, line in self_deadlocks:
+        yield ctx.finding(
+            line, "RL007",
+            f"re-acquisition of non-reentrant lock {lock_name} while "
+            "already held — this deadlocks; use an _locked variant of the "
+            "callee or an RLock")
+
+    # Cycle detection: report each strongly connected component once.
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        for w_ in edges.get(v, ()):
+            if w_ not in index:
+                strongconnect(w_)
+                low[v] = min(low[v], low[w_])
+            elif on_stack.get(w_):
+                low[v] = min(low[v], index[w_])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w_ = stack.pop()
+                on_stack[w_] = False
+                comp.append(w_)
+                if w_ == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in list(edges):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        comp_set = set(comp)
+        edge_list = [(a, b) for (a, b) in sites
+                     if a in comp_set and b in comp_set]
+        line = min(sites[e] for e in edge_list)
+        order = " ; ".join(f"{a} -> {b} (line {sites[(a, b)]})"
+                           for a, b in sorted(edge_list))
+        yield ctx.finding(
+            line, "RL007",
+            f"lock-order cycle between {sorted(comp_set)}: {order} — pick "
+            "one global order and restructure the odd acquisition out")

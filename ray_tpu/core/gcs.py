@@ -35,7 +35,13 @@ from ray_tpu.core.common import (
 )
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
-from ray_tpu.core.rpc import DEFERRED, Connection, RpcClient, RpcServer
+from ray_tpu.core.rpc import (
+    DEFERRED,
+    Connection,
+    ConnectionLost,
+    RpcClient,
+    RpcServer,
+)
 from ray_tpu.exceptions import RaySystemError
 
 logger = logging.getLogger(__name__)
@@ -84,7 +90,7 @@ class Pubsub:
         for conn in targets:
             try:
                 conn.push("pubsub", {"channel": channel, "key": key, "message": message})
-            except Exception:
+            except (ConnectionLost, OSError):
                 dead.append(conn)
         for conn in dead:
             self.drop_connection(conn)
@@ -176,8 +182,9 @@ class GcsServer:
         if getattr(self, "_job_manager", None) is not None:
             try:
                 self._job_manager.shutdown()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — stop() must keep going
+                logger.warning("GCS stop: job manager shutdown failed",
+                               exc_info=True)
         if self._storage_path:
             try:
                 self._persist_tables()
@@ -935,8 +942,9 @@ class GcsServer:
                                       if o.binary() in defer]
             try:
                 self._raylet(node_id).call("delete_objects", msg, timeout=5)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — node may be dead; GC re-runs
+                logger.debug("delete_objects to %s failed", node_id,
+                             exc_info=True)
 
     def handle_set_node_resource(self, conn: Connection,
                                  data: Dict[str, Any]):
@@ -1244,8 +1252,9 @@ class GcsServer:
                     "kill_worker", {"worker_id": worker_id, "actor_id": actor_id,
                                     "reason": reason, "intended": True,
                                     "suppress_report": no_restart}, timeout=10)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — raylet may be dead already
+                logger.debug("kill_worker on %s failed", node_id,
+                             exc_info=True)
         if no_restart:
             self._actor_dead(actor_id, reason)
 
@@ -1313,7 +1322,9 @@ class GcsServer:
                         ok = False
                         break
                     prepared.append((node_id, bundle_index))
-                except Exception:
+                except Exception:  # noqa: BLE001 — any failure aborts the attempt
+                    logger.debug("prepare_bundle on %s failed", node_id,
+                                 exc_info=True)
                     ok = False
                     break
             if not ok:
@@ -1322,8 +1333,9 @@ class GcsServer:
                         self._raylet(node_id).call(
                             "cancel_bundle", {"pg_id": pg.pg_id,
                                               "bundle_index": bundle_index}, timeout=15)
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 — rollback is best-effort
+                        logger.debug("cancel_bundle on %s failed", node_id,
+                                     exc_info=True)
                 time.sleep(0.2)
                 continue
             for node_id, bundle_index in prepared:
@@ -1419,8 +1431,9 @@ class GcsServer:
                 self._raylet(node_id).call(
                     "return_bundle", {"pg_id": pg_id, "bundle_index": bundle_index},
                     timeout=15)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — node may be dead; resources die with it
+                logger.debug("return_bundle on %s failed", node_id,
+                             exc_info=True)
         self.pubsub.publish(CH_PG, pg_id.binary(), {"state": "REMOVED"})
 
     def handle_get_placement_group(self, conn: Connection, data: Dict[str, Any]):

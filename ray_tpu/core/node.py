@@ -8,6 +8,7 @@ adds more raylets (in-process or subprocess) for multi-node simulation.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Dict, Optional
@@ -15,6 +16,8 @@ from typing import Dict, Optional
 from ray_tpu.core.common import CPU, TPU
 from ray_tpu.core.gcs import GcsServer
 from ray_tpu.core.raylet import Raylet
+
+logger = logging.getLogger(__name__)
 
 
 def default_session_dir() -> str:
@@ -35,8 +38,8 @@ def detect_tpu_chips() -> int:
         accels = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
         if accels:
             return len(accels)
-    except Exception:
-        pass
+    except OSError:
+        pass  # /dev not readable in this sandbox: fall through to env probes
     # Relay-attached chip (no /dev/accel on the host): a PJRT tunnel env
     # means jax in THIS process tree can reach a chip, so the node must
     # advertise it — otherwise nothing can request TPU resources and
@@ -140,13 +143,15 @@ class Node:
         if self.client_server is not None:
             try:
                 self.client_server.stop()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — stop() must keep going
+                logger.warning("node stop: client server shutdown failed",
+                               exc_info=True)
         self.raylet.stop()
         if self.dashboard is not None:
             try:
                 self.dashboard.stop()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — stop() must keep going
+                logger.warning("node stop: dashboard shutdown failed",
+                               exc_info=True)
         if self.gcs is not None:
             self.gcs.stop()

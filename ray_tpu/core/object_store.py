@@ -77,8 +77,8 @@ def _untrack(shm: shared_memory.SharedMemory):
     the segment's lifetime; attaching processes must not unlink it at exit."""
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 — CPython-internal API; shape varies
+        logger.debug("resource_tracker detach failed", exc_info=True)
 
 
 def _segment_name(session_suffix: str, object_id: ObjectID) -> str:
@@ -295,7 +295,9 @@ class SharedMemoryStore:
                 import psutil
 
                 capacity_bytes = int(psutil.virtual_memory().total * 0.3)
-            except Exception:
+            except Exception:  # noqa: BLE001 — capacity probe is best-effort
+                logger.debug("psutil capacity probe failed; defaulting to "
+                             "2 GiB", exc_info=True)
                 capacity_bytes = 2 << 30
         self.capacity = capacity_bytes
         self._spill_dir = spill_dir or GLOBAL_CONFIG.object_spill_dir or "/tmp/ray_tpu_spill"
@@ -452,13 +454,13 @@ class SharedMemoryStore:
                 # of the same object fail forever with FileExistsError.
                 try:
                     entry.shm.close()
-                except Exception:
-                    pass
+                except (BufferError, OSError):
+                    pass  # exports still draining; unlink below regardless
                 if not skip_unlink:
                     try:
                         entry.shm.unlink()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # already unlinked (racing delete)
             if entry.spilled_path:
                 path, entry.spilled_path = entry.spilled_path, None
                 entry.pending_spill = None  # uploader sees the tombstone
@@ -660,8 +662,8 @@ class ObjectStoreClient:
             if shm is not None:
                 try:
                     shm.close()
-                except Exception:
-                    pass
+                except (BufferError, OSError):
+                    pass  # live exports keep the mapping; tracker is dropped
 
     def release_if_unused(self, object_id: ObjectID) -> bool:
         """Detach iff no deserialized value still aliases the segment.
@@ -689,6 +691,6 @@ class ObjectStoreClient:
             for shm in self._attached.values():
                 try:
                     shm.close()
-                except Exception:
-                    pass
+                except (BufferError, OSError):
+                    pass  # process exit reclaims the mapping anyway
             self._attached.clear()

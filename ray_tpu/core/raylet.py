@@ -42,6 +42,7 @@ from ray_tpu.core.object_store import ObjectStoreFullError, SharedMemoryStore
 from ray_tpu.core.rpc import (
     DEFERRED,
     Connection,
+    ConnectionLost,
     ReconnectingClient,
     RpcClient,
     RpcServer,
@@ -342,18 +343,18 @@ class WorkerPool:
             if h.proc is not None and h.proc.poll() is None:
                 try:
                     h.proc.terminate()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # already reaped
         deadline = time.monotonic() + 3
         for h in handles:
             if h.proc is not None:
                 try:
                     h.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
-                except Exception:
+                except subprocess.TimeoutExpired:
                     try:
                         h.proc.kill()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # exited between wait and kill
 
 
 # --------------------------------------------------------------------------- #
@@ -1117,7 +1118,9 @@ class Raylet:
             return True
         try:
             entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=5)
-        except Exception:
+        except Exception:  # noqa: BLE001 — unreachable GCS == not available
+            logger.debug("object_locations_get for %s failed", oid,
+                         exc_info=True)
             return False
         return bool(entry.get("known") and entry.get("inline") is not None)
 
@@ -1188,8 +1191,8 @@ class Raylet:
                         if stale.proc is not None and stale.proc.poll() is None:
                             try:
                                 stale.proc.terminate()
-                            except Exception:
-                                pass
+                            except OSError:
+                                pass  # already reaped
                 # keep resources held? No: release and retry when a worker registers.
                 self.resources.release(qt.spec.resources)
                 with self._lock:
@@ -1234,7 +1237,7 @@ class Raylet:
         self._record_task_event(spec, "RUNNING", worker)
         try:
             worker.conn.push("execute_task", {"spec": spec})
-        except Exception:
+        except (ConnectionLost, OSError):
             self._on_worker_dead(worker, "push failed")
 
     def _record_task_event(self, spec: TaskSpec, state: str,
@@ -1304,8 +1307,9 @@ class Raylet:
                 submitter.push("task_result",
                                {"task_id": spec.task_id, "results": results,
                                 "error": error_blob})
-            except Exception:
-                pass
+            except (ConnectionLost, OSError):
+                logger.debug("task_result push for %s dropped: submitter "
+                             "gone", spec.task_id, exc_info=True)
         if spec.actor_creation:
             # Dedicated actor worker: stays busy serving direct calls.
             pending = self._pending_actor_creates.pop(spec.actor_id, None)
@@ -1342,8 +1346,9 @@ class Raylet:
                                   {"object_id": oid, "node_id": self.node_id,
                                    "size": r["size"], "owner": spec.owner_address},
                                   timeout=10)
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 — GCS down; gossip repairs
+                    logger.debug("object_location_add for %s failed", oid,
+                                 exc_info=True)
                 self._on_object_local(oid)
 
     def handle_object_sealed(self, conn: Connection, data: Dict[str, Any]):
@@ -1453,8 +1458,9 @@ class Raylet:
                     submitter.push("task_result",
                                    {"task_id": spec.task_id, "results": [],
                                     "error": err, "crashed": True})
-                except Exception:
-                    pass
+                except (ConnectionLost, OSError):
+                    logger.debug("crash report for %s dropped: submitter "
+                                 "gone", spec.task_id, exc_info=True)
         if handle.is_actor and handle.actor_id is not None:
             if handle.actor_id in self._pending_actor_creates:
                 pending = self._pending_actor_creates.pop(handle.actor_id)
@@ -1465,8 +1471,9 @@ class Raylet:
                 self.gcs.call("actor_died",
                               {"actor_id": handle.actor_id, "reason": reason,
                                "intended": False}, timeout=5)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — GCS death detection covers it
+                logger.debug("actor_died report for %s failed",
+                             handle.actor_id, exc_info=True)
             # actor resources released on death
             if handle.current_task is None and handle.actor_id is not None:
                 pass
@@ -1534,8 +1541,8 @@ class Raylet:
             if worker.proc is not None and worker.proc.poll() is None:
                 try:
                     worker.proc.terminate()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # already reaped
             return {"status": "error", "error": "actor creation timed out"}
         result = pending["result"]
         if result.get("error") is not None:
@@ -1561,8 +1568,8 @@ class Raylet:
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already reaped
         elif handle.proc is None and handle.conn is not None:
             handle.conn.close()
         if handle.is_actor and handle.actor_id is not None:
@@ -1571,8 +1578,9 @@ class Raylet:
                               {"actor_id": handle.actor_id,
                                "reason": data.get("reason", "killed"),
                                "intended": False}, timeout=5)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — GCS death detection covers it
+                logger.debug("actor_died report for %s failed",
+                             handle.actor_id, exc_info=True)
         return {}
 
     # ------------------------------------------------------ object transfer
@@ -2094,7 +2102,10 @@ class Raylet:
                 # Serialized per-node egress: concurrent transfers share
                 # the one modeled link instead of sleeping in parallel.
                 with self._link_lock:
-                    time.sleep((end - offset) / self._chunk_serve_bw_bps)
+                    # Sleeping under the lock IS the model: concurrent
+                    # sends must serialize on the one emulated link.
+                    time.sleep(  # raylint: disable=RL002
+                        (end - offset) / self._chunk_serve_bw_bps)
             self._record_outbound(oid, puller, offset, end - offset, size)
             conn.reply_raw(msg_id, "pull_object_chunk",
                            _pack_chunk_reply({"st": "ok", "s": size},

@@ -234,8 +234,10 @@ class CoreRuntime:
                         self.gcs.call("object_location_add",
                                       {"object_id": oid, "inline": rec.error,
                                        "size": len(rec.error)}, timeout=10)
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 — rec.event below still
+                        # unblocks local waiters with the error
+                        logger.debug("error publication for %s failed", oid,
+                                     exc_info=True)
             rec.event.set()
             # Deferred publication: a ref of this (actor) task was passed
             # as a task dependency before the result arrived. Runs after
@@ -951,8 +953,8 @@ class CoreRuntime:
         try:
             self.raylet.call("worker_blocked" if blocked else "worker_unblocked", {},
                              timeout=5)
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 — CPU-oversubscription hint only
+            logger.debug("worker_(un)blocked notify failed", exc_info=True)
 
     @staticmethod
     def _maybe_raise(value: Any) -> Any:
@@ -1326,7 +1328,9 @@ class CoreRuntime:
             entry = self.gcs.call("object_locations_get", {"object_id": oid}, timeout=5)
             return bool(entry.get("known") and
                         (entry.get("inline") is not None or entry.get("nodes")))
-        except Exception:
+        except Exception:  # noqa: BLE001 — unreachable GCS == not available
+            logger.debug("object_locations_get for %s failed", oid,
+                         exc_info=True)
             return False
 
     # ------------------------------------------------------------- cleanup
@@ -1468,7 +1472,9 @@ class CoreRuntime:
             msg["defer_node"] = self.node_id
         try:
             resp = self.gcs.call("free_objects", msg, timeout=5)
-        except Exception:
+        except Exception:  # noqa: BLE001 — fall back to direct unlink
+            logger.debug("free_objects RPC failed; forgetting %d tracked "
+                         "segments", len(tracked), exc_info=True)
             for oid in tracked:
                 pool.forget(oid)
             return

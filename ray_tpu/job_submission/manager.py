@@ -23,6 +23,7 @@ class JobManager:
         self._log_dir = log_dir
         self._lock = threading.Lock()
         self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._closed = False
 
     # ---------------------------------------------------------------- API
 
@@ -30,17 +31,86 @@ class JobManager:
                runtime_env: Optional[Dict[str, Any]] = None,
                metadata: Optional[Dict[str, str]] = None) -> str:
         sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        runner = threading.Thread(target=self._run, args=(sid, runtime_env),
+                                  name=f"job-{sid[:12]}", daemon=True)
         with self._lock:
+            if self._closed:
+                # The RPC server keeps serving submits during GCS
+                # teardown (it stops AFTER job_manager.shutdown()); a
+                # job admitted here would spawn after the kill sweep and
+                # be orphaned when the process exits.
+                raise RuntimeError("job manager is shut down")
             if sid in self._jobs:
                 raise ValueError(f"submission_id {sid!r} already exists")
             self._jobs[sid] = {
                 "entrypoint": entrypoint, "status": JobStatus.PENDING,
                 "message": "", "start_time": None, "end_time": None,
                 "metadata": metadata or {}, "proc": None,
+                "runner": runner, "killer": None,
                 "log_path": os.path.join(self._log_dir, f"job-{sid}.log")}
-        threading.Thread(target=self._run, args=(sid, runtime_env),
-                         name=f"job-{sid[:12]}", daemon=True).start()
+        runner.start()
         return sid
+
+    @staticmethod
+    def _kill_group(proc: subprocess.Popen, grace_s: float = 3.0):
+        """SIGTERM the entrypoint's process group, then SIGKILL whatever
+        part of it outlives grace_s: a TERM-trapping driver must not
+        survive shutdown or park the waiting runner thread forever.
+
+        The direct child is the `sh -c` wrapper (shell=True), and its
+        death says nothing about the group — the shell dies on TERM
+        while a TERM-trapping python driver it spawned survives in the
+        same group. So the escalation is keyed on GROUP liveness, probed
+        with killpg(pgid, 0): while any member lives the pgid (== the
+        leader's pid, via start_new_session=True) cannot be recycled, so
+        a positive probe means the KILL lands on our group, never on a
+        stranger whose group reused a freed pid. The probe and the
+        signal cannot be fully atomic — the residual window is the
+        microseconds between them, within which the whole pid space
+        would have to wrap for the signal to land elsewhere."""
+        def _sig(sig, fallback):
+            try:
+                os.killpg(proc.pid, sig)
+            except OSError:
+                try:
+                    fallback()
+                except OSError:
+                    pass  # exited and reaped in between
+        _sig(15, proc.terminate)
+        if not JobManager._wait_group_dead(proc, grace_s):
+            _sig(9, proc.kill)
+            # Confirm the group is actually gone before returning:
+            # shutdown() joins this thread as its proof of kill delivery,
+            # and a caller that exits the process the moment we return
+            # must not race the SIGKILLed survivors' death. Bounded —
+            # SIGKILL cannot be trapped, so this only waits out the
+            # kernel teardown and init's zombie reap.
+            JobManager._wait_group_dead(proc, 2.0)
+        try:
+            proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            pass  # stuck in uninterruptible sleep past SIGKILL; stay bounded
+
+    @staticmethod
+    def _wait_group_dead(proc: subprocess.Popen, timeout_s: float) -> bool:
+        """Poll until no member of the entrypoint's process group remains
+        (killpg(pgid, 0) -> ESRCH), reaping the direct child along the
+        way. False if the group still has members after timeout_s."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                os.killpg(proc.pid, 0)
+            except OSError:
+                return True  # whole group exited (and was reaped)
+            if time.monotonic() >= deadline:
+                return False
+            if proc.returncode is None:
+                try:
+                    proc.wait(timeout=0.1)  # reap the shell + pace the poll
+                except subprocess.TimeoutExpired:
+                    pass
+            else:
+                time.sleep(0.05)  # child reaped; poll surviving group
 
     def _run(self, sid: str, runtime_env: Optional[Dict[str, Any]]):
         job = self._jobs[sid]
@@ -65,16 +135,29 @@ class JobManager:
             with open(job["log_path"], "wb") as logf:
                 with self._lock:
                     if job["status"] == JobStatus.STOPPED:
-                        # stop_job() won the race before the spawn: honor it.
+                        # stop() won the race before the spawn: honor it.
                         job["end_time"] = time.time()
                         return
-                    proc = subprocess.Popen(
-                        job["entrypoint"], shell=True, stdout=logf,
-                        stderr=subprocess.STDOUT, env=env, cwd=cwd,
-                        start_new_session=True)
-                    job["proc"] = proc
-                    job["status"] = JobStatus.RUNNING
-                    job["start_time"] = time.time()
+                # Spawn OUTSIDE the lock (raylint RL002): fork/exec can
+                # take hundreds of ms and would stall every status query
+                # and submit on the shared lock.
+                proc = subprocess.Popen(
+                    job["entrypoint"], shell=True, stdout=logf,
+                    stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                    start_new_session=True)
+                with self._lock:
+                    stopped = job["status"] == JobStatus.STOPPED
+                    if not stopped:
+                        job["proc"] = proc
+                        job["status"] = JobStatus.RUNNING
+                        job["start_time"] = time.time()
+                if stopped:
+                    # stop() raced the spawn and found no proc to kill:
+                    # the kill is ours to deliver.
+                    self._kill_group(proc)
+                    with self._lock:
+                        job["end_time"] = time.time()
+                    return
                 rc = proc.wait()
             with self._lock:
                 job["end_time"] = time.time()
@@ -120,16 +203,24 @@ class JobManager:
                 return False
             job["status"] = JobStatus.STOPPED
             proc = job["proc"]
-        if proc is not None and proc.poll() is None:
-            try:
+            killer = None
+            if proc is not None and proc.poll() is None:
                 # The entrypoint may have children (driver spawns workers
-                # elsewhere, but shell pipelines are local): kill the group.
-                os.killpg(os.getpgid(proc.pid), 15)
-            except Exception:  # noqa: BLE001
-                try:
-                    proc.terminate()
-                except Exception:  # noqa: BLE001
-                    pass
+                # elsewhere, but shell pipelines are local): kill the
+                # group, escalating to SIGKILL off-thread so a
+                # TERM-trapping driver cannot outlive its STOPPED status
+                # — and so this RPC-path caller never blocks on the grace
+                # period. Published under the SAME lock hold that flips
+                # the status: shutdown()'s waiter snapshot must never see
+                # a STOPPED job whose killer is still unrecorded, or the
+                # join that proves kill delivery silently skips it.
+                # (poll() is WNOHANG — no RL002 concern.)
+                killer = threading.Thread(
+                    target=self._kill_group, args=(proc,),
+                    name=f"job-kill-{sid[:12]}", daemon=True)
+                job["killer"] = killer
+        if killer is not None:
+            killer.start()
         return True
 
     def delete(self, sid: str) -> bool:
@@ -149,9 +240,42 @@ class JobManager:
             sids = list(self._jobs)
         return [d for sid in sids if (d := self.details(sid)) is not None]
 
-    def shutdown(self):
+    def shutdown(self, timeout_s: float = 10.0):
+        # PENDING included: a job whose spawn is still in flight gets
+        # marked STOPPED here, and the runner thread's post-spawn
+        # handshake (see _run) delivers the kill to the process group it
+        # just created — skipping it would orphan the entrypoint.
         with self._lock:
+            self._closed = True  # later submits raise instead of orphaning
             sids = [s for s, j in self._jobs.items()
-                    if j["status"] == JobStatus.RUNNING]
+                    if j["status"] in (JobStatus.PENDING, JobStatus.RUNNING)]
         for sid in sids:
             self.stop(sid)
+        # The signals are delivered off-thread (stop() must not block its
+        # RPC caller on the grace period), but shutdown() is the last
+        # exit ramp before the supervising process dies — returning with
+        # a daemon killer still in flight would orphan an entrypoint
+        # whose SIGTERM never got sent. Join the killer (stop() path) and
+        # the runner (PENDING-spawn handshake path + reap) of EVERY job,
+        # not just the ones this call stopped: a client stop() moments
+        # before shutdown leaves its killer mid-grace too. Joins on
+        # finished jobs' dead threads return immediately; the deadline
+        # bounds a wedged entrypoint past the SIGKILL escalation.
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            waiters = [t for j in self._jobs.values()
+                       for t in (j["killer"], j["runner"])
+                       if t is not None]
+        for t in waiters:
+            while True:
+                try:
+                    t.join(timeout=max(0.0, deadline - time.monotonic()))
+                    break
+                except RuntimeError:
+                    # Published in _jobs but not yet start()ed by its
+                    # spawning thread (submit/stop release the lock
+                    # before start()); the start is imminent — yield and
+                    # retry rather than skip its kill delivery.
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.01)

@@ -33,6 +33,11 @@ def _interpret() -> bool:
     return os.environ.get("RAY_TPU_PALLAS_INTERPRET") == "1"
 
 
+def _compiler_params_cls(pltpu):
+    # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams.
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
 def mha_reference(q, k, v, causal: bool = True,
                   scale: Optional[float] = None) -> jax.Array:
     """XLA reference attention. q,k,v: [batch, heads, seq, head_dim]."""
@@ -152,7 +157,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -307,7 +312,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -339,7 +344,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

@@ -96,13 +96,26 @@ class ServeController:
         # set_proxy_config; reconcile keeps one proxy per alive node.
         self._proxy_cfg: Optional[Dict[str, Any]] = None
         self._proxies: Dict[str, Any] = {}   # node hex -> proxy handle
-        # Checkpoint ordering: writes run off-loop, so two rapid snapshots
-        # (deploy then delete) could land out of order and persist stale
-        # state. A monotonic sequence taken on the loop thread is checked
-        # under _ckpt_lock so an older payload never overwrites a newer one.
+        # Checkpoint IO: one writer thread owns every KV round trip, so no
+        # lock is ever held across the RPC (raylint RL002 — the old design
+        # issued kv_put under _ckpt_lock, letting a slow GCS hold the
+        # teardown path, which shares the lock, hostage for the full RPC
+        # timeout). Ordering is latest-wins: a monotonic sequence taken on
+        # the loop thread plus a single pending slot — an older payload can
+        # never overwrite a newer one because the writer only ever sees the
+        # newest snapshot.
         self._ckpt_seq = 0
         self._ckpt_written = 0
+        self._ckpt_attempted = 0  # last seq the writer finished (ok or not)
         self._ckpt_lock = threading.Lock()
+        self._ckpt_cond = threading.Condition(self._ckpt_lock)
+        self._ckpt_pending: Optional[tuple] = None
+        self._ckpt_thread: Optional[threading.Thread] = None
+        # Writer liveness, flipped ONLY under _ckpt_cond: Thread.is_alive()
+        # stays True while the loop is unwinding after it decided to exit,
+        # so an enqueue racing that window would see a "live" writer that
+        # will never drain its payload.
+        self._ckpt_writer_alive = False
 
     # ------------------------------------------------- checkpoint/recovery
 
@@ -143,26 +156,94 @@ class ServeController:
             }
         payload = pickle.dumps(
             {"deployments": state, "proxy_cfg": self._proxy_cfg})
-        self._ckpt_seq += 1
-        seq = self._ckpt_seq
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            self._write_ckpt(payload, seq)
-            return
-        loop.run_in_executor(None, self._write_ckpt, payload, seq)
+        self._enqueue_ckpt(payload)
 
-    def _write_ckpt(self, payload: bytes, seq: int) -> None:
+    def _enqueue_ckpt(self, payload: Optional[bytes]) -> int:
+        """Queue one checkpoint write (None = delete) for the writer
+        thread; only the newest snapshot is kept. Returns its sequence
+        number so callers can wait for durability."""
+        with self._ckpt_cond:
+            self._ckpt_seq += 1
+            seq = self._ckpt_seq
+            self._ckpt_pending = (seq, payload)
+            if not self._ckpt_writer_alive:
+                self._ckpt_writer_alive = True
+                thread = threading.Thread(
+                    target=self._ckpt_writer_loop, name="serve-ckpt",
+                    daemon=True)
+                try:
+                    thread.start()
+                except BaseException:
+                    # start() can fail under thread exhaustion; leaving
+                    # alive=True would wedge checkpointing forever (every
+                    # later enqueue would see a "live" writer that does
+                    # not exist).
+                    self._ckpt_writer_alive = False
+                    raise
+                self._ckpt_thread = thread
+            self._ckpt_cond.notify_all()
+        return seq
+
+    def _ckpt_writer_loop(self) -> None:
+        """Single checkpoint writer: drains the pending slot and issues
+        the KV RPC with no lock held — deploys, long-polls and teardown
+        never stall behind a slow GCS."""
         try:
-            with self._ckpt_lock:
+            self._ckpt_writer_run()
+        finally:
+            # Normally the clean-exit path below already flipped this
+            # under the cond; the finally covers anything else escaping
+            # the loop (e.g. KeyboardInterrupt delivered to this thread)
+            # so a dead writer can never keep alive=True and silently
+            # stop all future checkpoints. Identity-guarded: after a
+            # clean exit a NEW writer may already be registered, and its
+            # liveness must not be clobbered by the old thread's unwind.
+            with self._ckpt_cond:
+                if self._ckpt_thread is threading.current_thread():
+                    self._ckpt_writer_alive = False
+                    self._ckpt_cond.notify_all()
+
+    def _ckpt_writer_run(self) -> None:
+        while True:
+            with self._ckpt_cond:
+                while self._ckpt_pending is None:
+                    if self._shutdown:
+                        # Exit decision and liveness flip are atomic under
+                        # the cond: a concurrent enqueue either saw
+                        # alive=True and its payload is in the pending slot
+                        # we just checked, or sees False and starts a
+                        # fresh writer.
+                        self._ckpt_writer_alive = False
+                        return
+                    self._ckpt_cond.wait(timeout=1.0)
+                seq, payload = self._ckpt_pending
+                self._ckpt_pending = None
                 if seq <= self._ckpt_written:
-                    return  # a newer snapshot already persisted
-                self._kv().call("kv_put", {"key": self.CKPT_KEY,
-                                           "value": payload})
-                self._ckpt_written = seq
-        except Exception:  # noqa: BLE001 — best effort; next change retries
-            logger.warning("serve: controller checkpoint failed",
-                           exc_info=True)
+                    continue
+            try:
+                if payload is None:
+                    self._kv().call("kv_del", {"key": self.CKPT_KEY})
+                else:
+                    self._kv().call("kv_put", {"key": self.CKPT_KEY,
+                                               "value": payload})
+            except Exception:  # noqa: BLE001 — best effort; next change retries
+                logger.warning("serve: controller checkpoint failed",
+                               exc_info=True)
+                with self._ckpt_cond:
+                    # Record the attempt and wake waiters even on failure:
+                    # _drop_checkpoint's bounded wait must return as soon
+                    # as the outcome is known, not burn its full timeout
+                    # against a fast-failing (dead) GCS.
+                    if seq > self._ckpt_attempted:
+                        self._ckpt_attempted = seq
+                    self._ckpt_cond.notify_all()
+                continue
+            with self._ckpt_cond:
+                if seq > self._ckpt_written:
+                    self._ckpt_written = seq
+                if seq > self._ckpt_attempted:
+                    self._ckpt_attempted = seq
+                self._ckpt_cond.notify_all()
 
     async def restore(self) -> bool:
         """Rebuild state from the KV checkpoint after a controller death:
@@ -207,16 +288,15 @@ class ServeController:
         return True
 
     def _drop_checkpoint(self) -> None:
-        # Under _ckpt_lock, and advancing the sequence past every queued
-        # writer: a stale _write_ckpt landing after the delete would
-        # resurrect torn-down deployments on the next controller restart.
-        self._ckpt_seq += 1
-        try:
-            with self._ckpt_lock:
-                self._ckpt_written = self._ckpt_seq
-                self._kv().call("kv_del", {"key": self.CKPT_KEY})
-        except Exception:  # noqa: BLE001
-            pass
+        # The delete takes a sequence number past every queued write, so a
+        # stale snapshot landing after it can never resurrect torn-down
+        # deployments on the next controller restart. Best-effort bounded
+        # wait for durability: teardown should not return with the delete
+        # still queued, but a dead GCS must not hang it either.
+        seq = self._enqueue_ckpt(None)
+        with self._ckpt_cond:
+            self._ckpt_cond.wait_for(lambda: self._ckpt_attempted >= seq,
+                                     timeout=5.0)
 
     # ---------------------------------------------------------------- API
     # All public methods are async so every mutation runs on the actor's

@@ -20,6 +20,7 @@ step() {
   fi
 }
 
+step "raylint" python -m ray_tpu.analysis ray_tpu/
 step "pytest tests/" python -m pytest tests/ -q
 step "multichip dryrun (8 virtual devices)" \
   env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -38,6 +38,29 @@ if "jax" in sys.modules:
 
 import pytest  # noqa: E402
 
+# Opt-in cluster-wide sanitizer run: RAY_TPU_LOCK_WITNESS=1 installs the
+# lock-order witness (with hang watchdog) BEFORE any cluster fixture
+# creates a lock, so every tier-1 test doubles as a race-detection pass.
+# The session teardown below then fails the run on any recorded cycle.
+WITNESS_ENABLED = os.environ.get("RAY_TPU_LOCK_WITNESS") == "1"
+if WITNESS_ENABLED:
+    from ray_tpu.util import lock_witness
+
+    lock_witness.install(watchdog_s=float(
+        os.environ.get("RAY_TPU_LOCK_WITNESS_WATCHDOG", "60")))
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_session_gate():
+    yield
+    if WITNESS_ENABLED:
+        from ray_tpu.util import lock_witness
+
+        rep = lock_witness.report()
+        assert rep.cycles == [], (
+            "lock-order cycles recorded during the suite:\n"
+            + "\n".join(rep.cycles))
+
 
 @pytest.fixture(scope="module")
 def ray_start_shared():

@@ -166,6 +166,150 @@ def test_job_stop_and_failure_status(ray_start_regular):
     client.close()
 
 
+def _leaked_pids(mark: str):
+    """Pids whose /proc cmdline carries `mark`. The job-manager leak
+    tests put the mark INSIDE the `python -c` source so it lands in the
+    grandchild's argv — a shell-comment mark dies with the sh wrapper
+    and the scan would pass vacuously. (A zombie has an empty cmdline,
+    so a killed-but-unreaped process cannot false-positive.)"""
+    import os
+
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()
+        except OSError:
+            continue  # exited while scanning
+        if mark.encode() in cmdline:
+            pids.append(pid)
+    return pids
+
+
+def test_job_manager_shutdown_kills_inflight_spawn(tmp_path):
+    """shutdown() racing submit() must never orphan an entrypoint: a job
+    still PENDING (spawn in flight on the runner thread) is marked
+    STOPPED, and the runner's post-spawn handshake delivers the kill to
+    the process group it just created (manager.py _run)."""
+    import uuid
+
+    from ray_tpu.job_submission import JobStatus
+    from ray_tpu.job_submission.manager import JobManager
+
+    mark = "jmorph_" + uuid.uuid4().hex[:12]
+    jm = JobManager(gcs_address="127.0.0.1:1", log_dir=str(tmp_path))
+    # First batch gets a head start (likely RUNNING when shutdown lands),
+    # second batch is submitted immediately before it (likely still
+    # PENDING mid-spawn) — both sides of the race in one pass.
+    sids = [jm.submit(f"{sys.executable} -c "
+                      f"'import time; time.sleep(45)  # {mark}'")
+            for _ in range(2)]
+    time.sleep(0.3)
+    sids += [jm.submit(f"{sys.executable} -c "
+                       f"'import time; time.sleep(45)  # {mark}'")
+             for _ in range(2)]
+    jm.shutdown()
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        details = [jm.details(s) for s in sids]
+        if all(d["status"] == JobStatus.STOPPED and d["end_time"]
+               for d in details) and not _leaked_pids(mark):
+            break
+        time.sleep(0.2)
+    details = [jm.details(s) for s in sids]
+    assert all(d["status"] == JobStatus.STOPPED for d in details), details
+    assert all(d["end_time"] for d in details), details
+    assert _leaked_pids(mark) == []
+
+
+def test_job_manager_shutdown_waits_for_kill_delivery(tmp_path):
+    """shutdown() must not return while the off-thread kill handshake is
+    still in flight: the caller (GcsServer.stop) exits the process right
+    after, and an unjoined daemon killer dies with it — its SIGTERM
+    never sent, the entrypoint orphaned. A TERM-trapping driver is the
+    worst case: delivery needs the full grace period + SIGKILL."""
+    import uuid
+
+    from ray_tpu.job_submission import JobStatus
+    from ray_tpu.job_submission.manager import JobManager
+
+    mark = "jmjoin_" + uuid.uuid4().hex[:12]
+    jm = JobManager(gcs_address="127.0.0.1:1", log_dir=str(tmp_path))
+    sid = jm.submit(
+        f'{sys.executable} -c "import signal, time; '
+        f'signal.signal(signal.SIGTERM, signal.SIG_IGN); '
+        f'time.sleep(60)  # {mark}"')
+    deadline = time.monotonic() + 10
+    while jm.details(sid)["status"] == JobStatus.PENDING and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    # Give the driver time to install its SIGTERM trap — otherwise the
+    # group TERM kills it before the trap exists and the escalation path
+    # under test never has to fire.
+    time.sleep(1.2)
+    jm.shutdown()
+    # No grace window here: by the time shutdown() returns, the group
+    # must be dead and reaped (killer joined), not merely signaled.
+    leaked = _leaked_pids(mark)
+    assert leaked == [], f"entrypoint outlived shutdown(): {leaked}"
+
+
+def test_job_manager_submit_after_shutdown_raises(tmp_path):
+    """The GCS RPC server keeps serving submits while it tears down
+    (server.stop() runs AFTER job_manager.shutdown()); a submit admitted
+    then would spawn after the kill sweep and be orphaned on process
+    exit. It must be refused instead."""
+    import pytest
+
+    from ray_tpu.job_submission.manager import JobManager
+
+    jm = JobManager(gcs_address="127.0.0.1:1", log_dir=str(tmp_path))
+    jm.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        jm.submit("echo too-late")
+
+
+def test_job_manager_stop_escalates_past_sigterm_trap(tmp_path):
+    """stop() on an entrypoint that ignores SIGTERM must escalate to a
+    group SIGKILL after the grace period — otherwise the driver outlives
+    its STOPPED status. The driver is a python GRANDCHILD under the
+    sh -c wrapper: the shell dies on TERM, so the escalation must key on
+    group liveness, not on the direct child — and the leak scan must
+    look for the grandchild's argv (in-code mark), not the shell's."""
+    import uuid
+
+    from ray_tpu.job_submission import JobStatus
+    from ray_tpu.job_submission.manager import JobManager
+
+    mark = "jmtrap_" + uuid.uuid4().hex[:12]
+    jm = JobManager(gcs_address="127.0.0.1:1", log_dir=str(tmp_path))
+    sid = jm.submit(
+        f'{sys.executable} -c "import signal, time; '
+        f'signal.signal(signal.SIGTERM, signal.SIG_IGN); '
+        f'time.sleep(60)  # {mark}"')
+    deadline = time.monotonic() + 10
+    while jm.details(sid)["status"] == JobStatus.PENDING and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    time.sleep(1.2)  # let the driver install its trap before the TERM
+    assert jm.stop(sid)
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        d = jm.details(sid)
+        if d["status"] == JobStatus.STOPPED and d["end_time"] \
+                and not _leaked_pids(mark):
+            break
+        time.sleep(0.2)
+    d = jm.details(sid)
+    assert d["status"] == JobStatus.STOPPED, d
+    assert d["end_time"], "runner never unparked: SIGKILL escalation missing"
+    assert _leaked_pids(mark) == [], "TERM-trapping driver outlived the SIGKILL"
+
+
 # --------------------------------------------------------------------------- #
 # Task events / timeline / CLI
 # --------------------------------------------------------------------------- #

@@ -167,6 +167,7 @@ def test_batch_predictor_scores_dataset(ray_start_shared, tmp_path):
         np.testing.assert_allclose(got[i], expected[i], rtol=0.1, atol=0.02)
 
 
+@pytest.mark.slow  # ~20s: spawns a gloo process group and trains for real
 def test_torch_trainer_ddp_gloo(ray_start_shared, tmp_path):
     """TorchTrainer forms a gloo process group across workers and DDP
     synchronizes gradients (reference TorchTrainer / _TorchBackend)."""

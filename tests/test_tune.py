@@ -73,6 +73,7 @@ def test_tuner_fit_random_search(ray_start_shared, tmp_path):
     assert "config/lr" in df.columns and len(df) == 2
 
 
+@pytest.mark.slow  # ~26s: 10 trials through the 50ms controller poll loop
 def test_tuner_asha_10_trials(ray_start_shared, tmp_path):
     from ray_tpu.train.config import RunConfig
 
@@ -157,6 +158,7 @@ def test_trainer_as_trainable_through_tuner(ray_start_shared, tmp_path):
     assert results.get_best_result().config["lr"] == 0.3
 
 
+@pytest.mark.slow  # ~14s: population rounds through the controller poll loop
 def test_pbt_exploits(ray_start_shared, tmp_path):
     from ray_tpu.train.checkpoint import Checkpoint
     from ray_tpu.train.config import RunConfig
@@ -185,6 +187,7 @@ def test_pbt_exploits(ray_start_shared, tmp_path):
     assert best.metrics["score"] > 0
 
 
+@pytest.mark.slow  # ~13s: laggard trial must run long enough to be stopped
 def test_median_stopping_rule_stops_laggard(ray_start_shared, tmp_path):
     """Trials well under the field's median stop early (reference
     median_stopping_rule.py)."""
@@ -215,6 +218,7 @@ def test_median_stopping_rule_stops_laggard(ray_start_shared, tmp_path):
     assert min(by_level[0.0]) < 12
 
 
+@pytest.mark.slow  # ~20s: full bracket of trials through the poll loop
 def test_hyperband_scheduler_halves(ray_start_shared, tmp_path):
     """HyperBand brackets cut under-performers at their milestones while
     the best survive to max_t."""
