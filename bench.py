@@ -588,8 +588,11 @@ def _envelope_main(n_tasks: int, n_actors: int, n_pgs: int, n_refs: int,
         t0 = _time.perf_counter()
         actors = []
         # Waves: an unbounded spawn storm can outrun worker registration
-        # on small hosts; waves of pool size still measure steady rate.
-        wave = 8
+        # on small hosts; with the worker forge, spawns are ~10-20ms
+        # forks, so wider waves (16, up from 8) measure pipelining rather
+        # than convoying — cold-fallback hosts still fit registration in
+        # the raised lease window.
+        wave = 16
         for start in range(0, n_actors, wave):
             batch = [A.options(num_cpus=0.01).remote()
                      for _ in range(min(wave, n_actors - start))]
@@ -664,6 +667,29 @@ def _envelope_main(n_tasks: int, n_actors: int, n_pgs: int, n_refs: int,
         out["envelope_broadcast_node_s"] = node_done_s
         out["envelope_broadcast_gb_s"] = (
             arr.nbytes * len(nodes) / dt / 1e9)
+
+        # Worker-spawn microbench: forge fork vs cold exec, timed from
+        # the spawn call to worker registration (the moment the worker
+        # can take work). Runs LAST, after a settle pause — measuring it
+        # mid-envelope folds the cluster's own churn into the number.
+        del arr
+        _time.sleep(2.0)
+        head = cluster.raylets[0]
+
+        def timed_spawn(kind: str) -> float:
+            t0 = _time.perf_counter()
+            h = head.pool.spawn_worker(env_extra={}, kind=kind)
+            ok = h.registered.wait(120)
+            dt = (_time.perf_counter() - t0) * 1e3
+            assert ok and h.conn is not None, f"{kind} spawn never registered"
+            head.pool.mark_dead(h.worker_id)  # keep the pool unchanged
+            h.proc.terminate()
+            return dt
+
+        if head.forge is not None and head.forge.wait_ready(30):
+            forge_ms = sorted(timed_spawn("forge") for _ in range(3))
+            out["worker_spawn_forge_ms"] = round(forge_ms[1], 1)
+        out["worker_spawn_cold_ms"] = round(timed_spawn("cold"), 1)
     finally:
         cluster.shutdown()
     return out
@@ -954,6 +980,16 @@ def bench_serve(quick: bool) -> dict:
 
         out["serve_echo_http_rps"] = n_http_echo / _asyncio.run(
             echo_load(n_http_echo))
+
+        # Replica scale-up latency: redeploy at +N replicas and time until
+        # every new replica is RUNNING. Each replica is an actor, so this
+        # is the serving-facing view of worker spawn latency — replica
+        # cold-start regressions (forge loss, import creep) surface here.
+        scale_n = 2 if quick else 6
+        t0 = time.perf_counter()
+        serve.run(Echo.options(num_replicas=2 + scale_n).bind())
+        out["serve_scaleup_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out["serve_scaleup_replicas"] = scale_n
     finally:
         serve.delete("Echo")
 

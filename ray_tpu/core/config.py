@@ -54,6 +54,18 @@ _flag("worker_lease_timeout_ms", int, 60000,
       "Max time waiting for a worker lease (covers a cold worker spawn: "
       "a fresh interpreter importing jax can take >30s on a loaded host)")
 _flag("worker_pool_prestart", int, 0, "Number of workers to prestart per node")
+_flag("worker_forge_enabled", _parse_bool, True,
+      "Per-node forkserver template ('worker forge'): a process that "
+      "preimports the worker module set once and fork()s fully-imported "
+      "workers on demand in ~10-20ms, instead of paying exec + imports "
+      "per spawn. Cold exec spawn remains the fallback (and the only "
+      "path for fork-incompatible grants, e.g. TPU chip env)")
+_flag("worker_forge_preimports", str, "ray_tpu.core.worker,numpy",
+      "Comma-separated modules the forge template preimports. Must stay "
+      "fork-safe: no module here may start threads or initialize an XLA "
+      "backend client at import time (the forge refuses to fork "
+      "otherwise). Add 'jax' when workers are jax-heavy and its import "
+      "is known thread-free in your build")
 _flag("worker_idle_timeout_ms", int, 60000, "Idle worker reap timeout")
 _flag("max_pending_lease_requests", int, 10, "In-flight lease requests per scheduling key")
 _flag("object_inline_max_bytes", int, 100 * 1024, "Objects at or below this size travel inline through the control plane")

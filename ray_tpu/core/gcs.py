@@ -106,7 +106,13 @@ class GcsServer:
         self.server.on_disconnect = self._on_disconnect
         self.pubsub = Pubsub()
         self._lock = threading.RLock()
-        self._exec = ThreadPoolExecutor(max_workers=8, thread_name_prefix="gcs-bg")
+        # Sized for actor-create bursts: each in-flight create parks one
+        # thread for the whole worker spawn + __init__ (see
+        # _schedule_actor), and with forge forks a node absorbs dozens of
+        # creates concurrently — 8 threads re-serialized what the raylet
+        # had just pipelined.
+        self._exec = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="gcs-bg")
 
         # Tables
         self.nodes: Dict[NodeID, NodeInfo] = {}
@@ -366,8 +372,23 @@ class GcsServer:
     def _health_check_loop(self):
         period = GLOBAL_CONFIG.health_check_period_ms / 1000.0
         threshold = GLOBAL_CONFIG.health_check_failure_threshold
+        last_tick = time.time()
         while not self._stopped.wait(period):
             now = time.time()
+            # Self-clocked grace: when THIS loop was descheduled well past
+            # its period (CPU convoy during create storms, suspended VM),
+            # the raylets' heartbeat threads starved with it — wall-clock
+            # heartbeat age is then evidence of host-wide stall, not of
+            # node death. Credit the stall to every node before judging,
+            # so liveness detection measures the NODES, not the scheduler.
+            stall = (now - last_tick) - period
+            last_tick = now
+            if stall > period:
+                with self._lock:
+                    for info in self.nodes.values():
+                        info.last_heartbeat = min(
+                            now, info.last_heartbeat + stall)
+                continue
             dead = []
             with self._lock:
                 for info in self.nodes.values():
@@ -1054,6 +1075,13 @@ class GcsServer:
         """Async actor creation: record, schedule in background, publish state."""
         spec = data["spec"]  # TaskSpec with actor_creation=True
         actor_id = spec.actor_id
+        if data.get("subscribe"):
+            # Piggybacked state subscription: one round trip instead of a
+            # subscribe + register pair — during create bursts each extra
+            # sync RPC serializes on the caller's GCS connection while
+            # this process is GIL-saturated, and the subscription MUST be
+            # in place before scheduling can publish ALIVE anyway.
+            self.pubsub.subscribe(conn, CH_ACTOR, actor_id.binary())
         info = ActorInfo(
             actor_id=actor_id,
             job_id=spec.job_id,
@@ -1156,6 +1184,15 @@ class GcsServer:
             for info in self.nodes.values():
                 if info.state != "ALIVE":
                     continue
+                # Admission control for create bursts: a node absorbing
+                # more concurrent creations than it has cores just convoys
+                # the worker inits (and the whole burst's latency) — park
+                # the surplus in _schedule_actor's retry loop instead.
+                # Worker spawns are cheap (forge forks) but worker INIT is
+                # CPU-bound, so the cap tracks the node's CPU count.
+                cap = max(2.0, info.resources_total.get("CPU", 0.0))
+                if self._inflight_creates.get(info.node_id, 0) >= cap:
+                    continue
                 avail = info.resources_available
                 need = getattr(spec, "placement_resources", None) or spec.resources
                 if all(avail.get(r, 0.0) >= amt for r, amt in need.items()):
@@ -1177,15 +1214,30 @@ class GcsServer:
             # in flight count toward utilization: heartbeats lag, and N
             # concurrent creations would otherwise all pick the same
             # node before its load report catches up.
-            def utilization(n: NodeInfo) -> float:
+            def base_utilization(n: NodeInfo) -> float:
                 total = sum(n.resources_total.values()) or 1.0
                 avail = sum(n.resources_available.values())
-                inflight = self._inflight_creates.get(n.node_id, 0)
-                return (total - avail) / total + 0.1 * inflight
+                return (total - avail) / total
+
+            def utilization(n: NodeInfo) -> float:
+                return base_utilization(n) + \
+                    0.1 * self._inflight_creates.get(n.node_id, 0)
 
             packable = [n for n in candidates if utilization(n) < 0.5]
             if packable:
-                return max(packable, key=utilization).node_id
+                # Rank by RESOURCE utilization MINUS an in-flight-create
+                # penalty. Counting inflight positively (as the threshold
+                # gate does) made a create burst self-attracting: every
+                # create chased the node with the most creates, one
+                # worker forge absorbed the whole burst's forks while the
+                # other templates idled — and the winner kept winning as
+                # its resident actors nudged its base utilization up. The
+                # penalty spreads a burst across nodes while keeping
+                # steady-state packing (idle periods have no inflight).
+                return max(packable, key=lambda n: (
+                    base_utilization(n)
+                    - 0.1 * self._inflight_creates.get(n.node_id, 0)
+                )).node_id
             return min(candidates, key=utilization).node_id
 
     def _on_actor_failure(self, info: ActorInfo, reason: str):

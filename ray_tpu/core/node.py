@@ -139,7 +139,18 @@ class Node:
     def node_id(self):
         return self.raylet.node_id
 
+    @property
+    def worker_forge(self):
+        """This node's forkserver template handle (None when
+        `worker_forge_enabled` is off) — see docs/WORKER_POOL.md."""
+        return self.raylet.forge
+
     def shutdown(self):
+        # Teardown order matters for process hygiene: raylet.stop() kills
+        # the pool's workers first, then detaches from the worker forge —
+        # no worker survives the node (asserted by the /proc-scan orphan
+        # tests). The forge template itself is process-shared and lingers
+        # for the next cluster, self-exiting on idle or parent death.
         if self.client_server is not None:
             try:
                 self.client_server.stop()

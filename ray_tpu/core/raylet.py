@@ -15,6 +15,10 @@ Differences from the reference, deliberate for the TPU design:
 - TPU chips are node resources; a worker granted TPU resources gets
   `TPU_VISIBLE_CHIPS`/`JAX_PLATFORMS` env so exactly one JAX process per
   host owns the local chips (see SURVEY.md §7 "TPU process model").
+- Worker spawning is two-path: a per-node forkserver template (the worker
+  forge, core/worker_forge.py) forks fully-imported workers in ~10-20ms
+  for fork-compatible grants; cold `exec` spawn remains the fallback and
+  the TPU-grant path. See docs/WORKER_POOL.md.
 """
 
 from __future__ import annotations
@@ -47,6 +51,7 @@ from ray_tpu.core.rpc import (
     RpcClient,
     RpcServer,
 )
+from ray_tpu.core.worker_forge import ForgeUnavailable, WorkerForge
 from ray_tpu.exceptions import RaySystemError
 
 logger = logging.getLogger(__name__)
@@ -150,8 +155,17 @@ class WorkerHandle:
     worker_id: WorkerID
     pid: int
     conn: Optional[Connection] = None
-    proc: Optional[subprocess.Popen] = None
+    # subprocess.Popen for cold spawns, worker_forge._ForgedProc (same
+    # poll/wait/terminate/kill surface) for forge forks.
+    proc: Optional[Any] = None
     state: str = "starting"          # starting | idle | busy | dead
+    # How the process came to be: "forge" (forked from the warm template)
+    # or "cold" (exec + full imports).
+    spawn_kind: str = "cold"
+    # Set when the worker registers its connection — and on death, so
+    # spawn-waiters (actor creation) wake on either outcome instead of
+    # polling.
+    registered: threading.Event = field(default_factory=threading.Event)
     current_task: Optional[TaskSpec] = None
     is_actor: bool = False
     actor_id: Optional[ActorID] = None
@@ -177,26 +191,33 @@ class WorkerPool:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._starting = 0
         self.max_workers = max_workers
+        # Spawn-path accounting (bench/tests assert the forge engages).
+        self.spawn_counts: Dict[str, int] = {"forge": 0, "cold": 0}
         # Crash-loop guard: consecutive startup deaths throttle respawns.
         self.consecutive_startup_failures = 0
         self.last_startup_failure = 0.0
 
-    def spawn_worker(self, env_extra: Optional[Dict[str, str]] = None) -> WorkerHandle:
-        worker_id = WorkerID.from_random()
-        env = dict(os.environ)
-        env.update(GLOBAL_CONFIG.to_env())
+    def _spawn_env_delta(self, worker_id: WorkerID,
+                         env_extra: Optional[Dict[str, str]]
+                         ) -> Dict[str, str]:
+        """Worker-specific env on top of this raylet's own environment —
+        the full spawn env for a cold exec is os.environ + this delta; a
+        forge fork applies ONLY the delta (the template already inherited
+        the raylet env at forge start)."""
+        delta: Dict[str, str] = {}
+        delta.update(GLOBAL_CONFIG.to_env())
         if "RAY_TPU_GRANTED_TPU" not in (env_extra or {}):
-            # CPU-only worker: drop the site-level accelerator-plugin
-            # trigger (a sitecustomize that registers the TPU backend
-            # imports jax at interpreter start — ~2 s of CPU per spawn,
-            # measured 10x the rest of worker startup) and pin jax to CPU
-            # so user code touching jax cannot grab chips another process
-            # owns. Chip access flows through TPU resource grants only
-            # (see module docstring "TPU note").
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["RAY_TPU_JAX_PLATFORM"] = "cpu"
-        env.update(env_extra or {})
+            # CPU-only worker: pin jax to CPU so user code touching jax
+            # cannot grab chips another process owns. Chip access flows
+            # through TPU resource grants only (module docstring "TPU
+            # note"). The cold path additionally drops the site-level
+            # accelerator-plugin trigger below (a sitecustomize that
+            # registers the TPU backend imports jax at interpreter start —
+            # ~2s of CPU per spawn); the forge template was started
+            # without it.
+            delta["JAX_PLATFORMS"] = "cpu"
+            delta["RAY_TPU_JAX_PLATFORM"] = "cpu"
+        delta.update(env_extra or {})
         # Workers must resolve ray_tpu (and the driver's modules) even when
         # the driver got them via sys.path manipulation rather than an
         # installed package: propagate package root + cwd on PYTHONPATH.
@@ -204,30 +225,97 @@ class WorkerPool:
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
         extra_paths = [pkg_root, os.getcwd()]
-        existing = env.get("PYTHONPATH", "")
+        # A grant-supplied PYTHONPATH (runtime_env env_vars) overrides the
+        # raylet's own, exactly as env_extra overrode os.environ in the
+        # flat-env spawn — dropping it would lose the user's module roots.
+        existing = delta.get("PYTHONPATH") or os.environ.get("PYTHONPATH", "")
         parts = [p for p in extra_paths if p] + ([existing] if existing else [])
-        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_RAYLET_ADDRESS"] = self._raylet.server.address
-        env["RAY_TPU_GCS_ADDRESS"] = self._raylet.gcs_address
-        env["RAY_TPU_NODE_ID"] = self._raylet.node_id.hex()
-        env["RAY_TPU_SESSION"] = self._raylet.session_suffix
-        env["RAY_TPU_SESSION_DIR"] = self._raylet.session_dir
+        delta["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        delta["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        delta["RAY_TPU_RAYLET_ADDRESS"] = self._raylet.server.address
+        delta["RAY_TPU_GCS_ADDRESS"] = self._raylet.gcs_address
+        delta["RAY_TPU_NODE_ID"] = self._raylet.node_id.hex()
+        delta["RAY_TPU_SESSION"] = self._raylet.session_suffix
+        delta["RAY_TPU_SESSION_DIR"] = self._raylet.session_dir
+        return delta
+
+    def forge_available(self, env_extra: Optional[Dict[str, str]]) -> bool:
+        """Would a spawn for this grant take the millisecond fork path?"""
+        forge = self._raylet.forge
+        return (forge is not None and forge.alive
+                and WorkerForge.compatible(env_extra or {}))
+
+    def spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
+                     kind: Optional[str] = None) -> WorkerHandle:
+        """Start a worker process: forge fork when the template is up and
+        the grant is fork-compatible, cold exec otherwise. `kind` pins the
+        path ("forge" raises ForgeUnavailable instead of falling back —
+        bench/test hook). Never called with the pool or raylet lock held:
+        the forge spawn is a socket round trip."""
+        worker_id = WorkerID.from_random()
+        delta = self._spawn_env_delta(worker_id, env_extra)
         log_dir = os.path.join(self._raylet.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
-            env=env,
-            stdout=out,
-            stderr=subprocess.STDOUT,
-            cwd=os.getcwd(),
-        )
-        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        log_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out")
+        # Register the handle BEFORE the process exists: a forge fork can
+        # connect and register within ~10ms, faster than this (possibly
+        # GIL-starved) thread gets scheduled again after the spawn reply —
+        # a post-spawn insert would make the raylet refuse its own
+        # worker's registration.
+        handle = WorkerHandle(worker_id=worker_id, pid=0, proc=None,
+                              spawn_kind="cold")
         handle.granted_env = env_extra or {}
         with self._lock:
             self._workers[worker_id] = handle
             self._starting += 1
+        forge = self._raylet.forge
+        proc = None
+        try:
+            if kind != "cold" and forge is not None \
+                    and WorkerForge.compatible(env_extra or {}):
+                try:
+                    proc = forge.spawn(delta, os.getcwd(), log_path)
+                    handle.spawn_kind = "forge"
+                except ForgeUnavailable as e:
+                    if kind == "forge":
+                        raise
+                    logger.debug("forge spawn unavailable (%s): cold "
+                                 "fallback", e)
+                    forge.restart_async()
+            elif kind == "forge":
+                raise ForgeUnavailable(
+                    "forge disabled or env fork-incompatible")
+            if proc is None:
+                env = dict(os.environ)
+                if "RAY_TPU_GRANTED_TPU" not in (env_extra or {}):
+                    env.pop("PALLAS_AXON_POOL_IPS", None)
+                env.update(delta)
+                out = open(log_path, "ab")
+                proc = subprocess.Popen(
+                    [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+                out.close()  # Popen holds its own dup
+        except BaseException:
+            # No process came to be: unwind the optimistic registration.
+            self.mark_dead(worker_id)
+            raise
+        handle.pid = proc.pid
+        handle.proc = proc
+        with self._lock:
+            self.spawn_counts[handle.spawn_kind] += 1
+        # Event-driven exit detection from birth (satellite of the forge
+        # work): cold spawns get a waiter thread; forge forks are covered
+        # by the template's exit-event stream.
+        self._raylet._watch_worker(handle)
+        if proc.poll() is not None and handle.state != "dead":
+            # Exit raced the spawn reply (the forge's event stream cannot
+            # attribute a pid the pool hadn't seen yet): reap here.
+            self._raylet._on_worker_dead(
+                handle, f"process exited with code {proc.returncode}")
         return handle
 
     def on_worker_registered(self, worker_id: WorkerID, conn: Connection,
@@ -243,7 +331,8 @@ class WorkerPool:
                 handle.state = "idle"
                 handle.last_idle = time.monotonic()
             self.consecutive_startup_failures = 0
-            return handle
+        handle.registered.set()
+        return handle
 
     def pop_idle(self, required_env: Optional[Dict[str, str]] = None
                  ) -> Optional[WorkerHandle]:
@@ -301,6 +390,25 @@ class WorkerPool:
                        if h.state != "dead"
                        and (include_actors or not h.is_actor))
 
+    def supply(self, want: Dict[str, str]) -> Tuple[int, int, int]:
+        """Worker supply for a grant: (idle leasable workers matching the
+        env, starting workers matching the env, live task workers) — the
+        inputs of the spawn-ahead deficit computation. Starting workers
+        are filtered by grant: an unrelated slow spawn (a TPU worker's
+        cold start) must not satisfy THIS grant's demand and suppress its
+        spawn. The global starting count (`num_starting`) still governs
+        the cold convoy cap."""
+        with self._lock:
+            idle = sum(1 for h in self._workers.values()
+                       if h.state == "idle" and not h.is_actor
+                       and h.granted_env == want and not h.oom_kill_reason)
+            starting = sum(1 for h in self._workers.values()
+                           if h.state == "starting"
+                           and h.granted_env == want)
+            alive = sum(1 for h in self._workers.values()
+                        if h.state != "dead" and not h.is_actor)
+            return idle, starting, alive
+
     def mark_dead(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
         with self._lock:
             handle = self._workers.get(worker_id)
@@ -317,7 +425,9 @@ class WorkerPool:
                         "worker logs in %s. Respawns are throttled to one "
                         "per 5s until a worker starts successfully.", log_dir)
             handle.state = "dead"
-            return handle
+        # Wake spawn-waiters (actor creation) parked on registration.
+        handle.registered.set()
+        return handle
 
     def spawn_allowed(self) -> bool:
         with self._lock:
@@ -531,6 +641,11 @@ class Raylet:
         # (~0.3s, was ~2s — see spawn_worker), so wider spawn bursts stop
         # convoying; still capped to keep small hosts responsive.
         self._spawn_parallelism = max(1, min(4, cpus))
+        # Forge forks skip the import bill but each child's runtime INIT
+        # is still ~50ms of CPU — an unbounded fork burst convoys those
+        # inits and starves everything else on the node, so forge spawns
+        # get their own (much wider) cap instead of none.
+        self._forge_spawn_parallelism = max(4, cpus * 2)
         self.labels = labels or {}
         self._lock = threading.RLock()
         self._queue: deque[QueuedTask] = deque()
@@ -604,6 +719,13 @@ class Raylet:
         self._node_info: Optional[NodeInfo] = None
         self._peer_clients: Dict[str, RpcClient] = {}
         self._threads: List[threading.Thread] = []
+        # Worker forge (forkserver template) — started in start() when
+        # enabled; spawn_worker falls back to cold exec while it is down.
+        self.forge: Optional[WorkerForge] = None
+        # Per-process waiter threads for cold-spawned workers (event-driven
+        # death detection; the 2s reaper loop stays as anti-entropy).
+        self._proc_waiters: List[threading.Thread] = []
+        self._proc_waiters_lock = threading.Lock()
         # Granted worker leases: lease_id -> {worker, resources, conn}
         # (reference `leased_workers_` in node_manager.h).
         self._leases: Dict[bytes, Dict[str, Any]] = {}
@@ -612,6 +734,19 @@ class Raylet:
 
     def start(self):
         self.server.start()
+        if GLOBAL_CONFIG.worker_forge_enabled:
+            try:
+                self.forge = WorkerForge(
+                    self.session_dir, self.session_suffix,
+                    self.node_id.hex(),
+                    on_worker_exit=self._on_forge_worker_exit)
+                self.forge.start()  # template readies in the background
+            except Exception:  # noqa: BLE001 — forge is an optimization
+                # e.g. unwritable tmpdir, fork/exec failure: the node must
+                # still come up — every spawn just takes the cold path.
+                logger.warning("worker forge failed to start; cold spawns "
+                               "only", exc_info=True)
+                self.forge = None
         self._node_info = NodeInfo(
             node_id=self.node_id,
             address=self.server.address,
@@ -628,6 +763,7 @@ class Raylet:
         loops = [
             ("raylet-dispatch", self._dispatch_loop),
             ("raylet-heartbeat", self._heartbeat_loop),
+            ("raylet-gcs-sync", self._gcs_sync_loop),
             ("raylet-reaper", self._reaper_loop),
         ]
         if GLOBAL_CONFIG.resource_delta_min_interval_ms > 0:
@@ -656,11 +792,63 @@ class Raylet:
             self.memory_monitor.stop()
         self._dispatch_event.set()
         self.pool.kill_all()
+        if self.forge is not None:
+            # After kill_all (every known worker got its signal first):
+            # detach from the shared template — it lingers for the next
+            # cluster in this process and self-exits on idle/parent death.
+            # An in-flight fork the pool never saw dies on its own when
+            # its registration against this stopped raylet fails.
+            self.forge.stop()
+        with self._proc_waiters_lock:
+            waiters = list(self._proc_waiters)
+            self._proc_waiters.clear()
+        for t in waiters:
+            t.join(timeout=2.0)
         self.server.stop()
         self.gcs.close()
         for c in self._peer_clients.values():
             c.close()
         self.store.shutdown()
+
+    # ------------------------------------------------ worker exit watchers
+
+    def _watch_worker(self, handle: WorkerHandle):
+        """Event-driven dead-worker detection: a per-process waiter thread
+        for cold spawns (blocked in waitpid, zero-cost until exit); forge
+        forks are covered by the template's exit-event stream. Failed
+        spawns fail fast instead of waiting out the 2s reaper poll, which
+        stays as anti-entropy."""
+        if handle.spawn_kind != "cold":
+            return
+        t = threading.Thread(target=self._proc_waiter, args=(handle,),
+                             name=f"worker-wait-{handle.pid}", daemon=True)
+        with self._proc_waiters_lock:
+            self._proc_waiters = [x for x in self._proc_waiters
+                                  if x.is_alive()]
+            self._proc_waiters.append(t)
+        t.start()
+
+    def _proc_waiter(self, handle: WorkerHandle):
+        try:
+            handle.proc.wait()
+        except Exception:  # noqa: BLE001 — proc already reaped elsewhere
+            return
+        if self._stopped.is_set() or handle.state == "dead":
+            return
+        self._on_worker_dead(
+            handle, f"process exited with code {handle.proc.returncode}")
+
+    def _on_forge_worker_exit(self, pid: int, code: int):
+        """Forge exit-event stream: a forked worker died (its waitpid
+        lives in the template process)."""
+        if self._stopped.is_set():
+            return
+        with self.pool._lock:
+            handle = next((h for h in self.pool._workers.values()
+                           if h.pid == pid and h.state != "dead"), None)
+        if handle is not None:
+            self._on_worker_dead(handle,
+                                 f"process exited with code {code}")
 
     def _register_with_gcs(self, client):
         """Announce this node and (re)establish its subscriptions. Called at
@@ -711,6 +899,10 @@ class Raylet:
                 pass
 
     def _heartbeat_loop(self):
+        """Pure liveness beat. Anything slow (task-event flush, object
+        re-announcements) lives in _gcs_sync_loop: sharing this loop with
+        a 5s-timeout flush once delayed the next beat past the GCS health
+        window during create storms — a false node death under load."""
         period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
         while not self._stopped.wait(period):
             try:
@@ -733,6 +925,19 @@ class Raylet:
                     # A GCS that restarted without persisted node state (or
                     # that marked us dead during the outage): re-announce.
                     self._register_with_gcs(self.gcs)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                logger.warning("heartbeat to GCS failed", exc_info=True)
+
+    def _gcs_sync_loop(self):
+        """Anti-entropy GCS sync (split off the heartbeat loop so its
+        bounded-but-slow RPCs can never delay a liveness beat): failed
+        object announcements, stale partial-location removals, and the
+        task-event flush."""
+        period = GLOBAL_CONFIG.raylet_heartbeat_period_ms / 1000.0
+        while not self._stopped.wait(period):
+            try:
                 with self._lock:
                     unannounced = list(self._unannounced_objects.items())
                     self._unannounced_objects.clear()
@@ -768,9 +973,16 @@ class Raylet:
                             self._stale_partials.discard(oid)
                     except Exception:  # noqa: BLE001 — retry next beat,
                         break          # same stall rationale as above
+                # Bounded flush batches: after a 20k-task storm a raylet
+                # holds tens of thousands of buffered events, and one
+                # giant pickled add_task_events monopolizes the (shared,
+                # GIL-bound) control plane for seconds right when the
+                # next phase's work needs it. The deque sheds oldest on
+                # overflow, so draining over several beats loses nothing.
                 with self._lock:
-                    events = list(self._task_event_buffer)
-                    self._task_event_buffer.clear()
+                    events = [self._task_event_buffer.popleft()
+                              for _ in range(min(
+                                  2000, len(self._task_event_buffer)))]
                 if events:
                     try:
                         self.gcs.call("add_task_events", {"events": events},
@@ -786,7 +998,7 @@ class Raylet:
             except Exception:
                 if self._stopped.is_set():
                     return
-                logger.warning("heartbeat to GCS failed", exc_info=True)
+                logger.warning("GCS sync failed", exc_info=True)
 
     def _reaper_loop(self):
         # Reap idle workers beyond the prestart target and poll dead processes.
@@ -1172,27 +1384,17 @@ class Raylet:
             env = self._env_for(qt.spec)
             worker = self.pool.pop_idle(env)
             if worker is None:
-                # Throttle concurrent spawns: Python worker startup is CPU
-                # bound (~2s of imports); parallel cold starts convoy on small
-                # hosts. Pool size targets the node's CPU count (reference
-                # worker_pool.h:347 prestarts one worker per core).
-                if (self.pool.num_starting() < self._spawn_parallelism
-                        and self.pool.num_alive(include_actors=False)
-                        < self.pool.max_workers
-                        and self.pool.spawn_allowed()):
-                    self.pool.spawn_worker(env_extra=env)
-                elif self.pool.num_alive(include_actors=False) \
-                        >= self.pool.max_workers:
-                    # Pool full of env-incompatible workers: retire one so a
-                    # compatible worker can be spawned on the next pass.
-                    stale = self.pool.pop_idle_mismatched(env)
-                    if stale is not None:
-                        self._on_worker_dead(stale, "retired (env mismatch)")
-                        if stale.proc is not None and stale.proc.poll() is None:
-                            try:
-                                stale.proc.terminate()
-                            except OSError:
-                                pass  # already reaped
+                # Spawn-ahead: size the spawn burst to the queued demand
+                # for this grant (this task + dep-free queue head), so a
+                # task burst pipelines its worker starts instead of
+                # trickling one spawn per dispatch pass.
+                with self._lock:
+                    pending_specs = [q2.spec for q2 in self._queue
+                                     if not q2.deps_remaining]
+                    del pending_specs[self._DISPATCH_SCAN_LIMIT:]
+                demand = 1 + sum(1 for s in pending_specs
+                                 if self._env_for(s) == env)
+                self._spawn_for_demand(env, demand)
                 # keep resources held? No: release and retry when a worker registers.
                 self.resources.release(qt.spec.resources)
                 with self._lock:
@@ -1208,6 +1410,42 @@ class Raylet:
             else:
                 self._dispatch_to(worker, qt)
             progressed = True
+
+    def _spawn_for_demand(self, env: Dict[str, str], demand: int):
+        """Spawn-ahead hysteresis: bring (idle + starting) worker supply
+        for this grant up to the queued demand. Spawn-kind-aware — forge
+        forks skip the import bill so they get the wide
+        `_forge_spawn_parallelism` cap; cold exec spawns keep the tight
+        `_spawn_parallelism` cap (parallel interpreter starts are CPU
+        bound and convoy on small hosts; pool size still targets the
+        node's CPU count, reference worker_pool.h:347 prestarts one
+        worker per core). Starting workers count as supply, so bursts
+        never over-spawn past demand, and the caps pace a burst to the
+        node instead of convoying every child's runtime init at once."""
+        while not self._stopped.is_set():
+            idle, starting, alive = self.pool.supply(env)
+            if alive >= self.pool.max_workers:
+                # Pool full of env-incompatible workers: retire one so a
+                # compatible worker can be spawned on the next pass.
+                stale = self.pool.pop_idle_mismatched(env)
+                if stale is None:
+                    return
+                self._on_worker_dead(stale, "retired (env mismatch)")
+                if stale.proc is not None and stale.proc.poll() is None:
+                    try:
+                        stale.proc.terminate()
+                    except OSError:
+                        pass  # already reaped
+                continue
+            if idle + starting >= demand or not self.pool.spawn_allowed():
+                return
+            # The convoy cap is GLOBAL (every starting interpreter shares
+            # the node's cores), while the deficit above is per-grant.
+            cap = self._forge_spawn_parallelism \
+                if self.pool.forge_available(env) else self._spawn_parallelism
+            if self.pool.num_starting() >= cap:
+                return
+            self.pool.spawn_worker(env_extra=env)
 
     def _env_for(self, spec: TaskSpec) -> Dict[str, str]:
         env: Dict[str, str] = {}
@@ -1513,21 +1751,36 @@ class Raylet:
             worker = self.pool.spawn_worker(env_extra=env)
         worker.is_actor = True
         worker.actor_id = spec.actor_id
-        pending = {"event": threading.Event(), "result": None}
+        pending = {"event": threading.Event(), "result": None, "env": env}
         self._pending_actor_creates[spec.actor_id] = pending
-        # Wait for registration, then dispatch the creation task.
+        # Spawn-ahead hysteresis for create bursts: in-flight creates on
+        # this node (each arrives on its own GCS connection) are queued
+        # demand — prespawn so the next creates find registered idle
+        # workers instead of serializing their own starts. Only creates
+        # with the SAME grant count: a prespawned worker can serve only
+        # an env-matching create.
+        with self._lock:
+            inflight = sum(1 for p in self._pending_actor_creates.values()
+                           if p.get("env") == env)
+        if inflight > 1:
+            self._spawn_for_demand(env, inflight - 1)
+        # Wait for registration (event-driven: `registered` is set on
+        # register AND on death — no 10ms poll; the 0.5s slice is pure
+        # anti-entropy against a lost event).
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0
-        while worker.conn is None and time.monotonic() < deadline:
-            if worker.proc.poll() is not None:
-                self.resources.release(placement)
-                self._pending_actor_creates.pop(spec.actor_id, None)
-                return {"status": "error",
-                        "error": f"actor worker exited at startup "
-                                 f"(code {worker.proc.returncode})"}
-            time.sleep(0.01)
+        while worker.conn is None and worker.state != "dead":
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            worker.registered.wait(min(remaining, 0.5))
         if worker.conn is None:
             self.resources.release(placement)
             self._pending_actor_creates.pop(spec.actor_id, None)
+            if worker.state == "dead" or (worker.proc is not None
+                                          and worker.proc.poll() is not None):
+                return {"status": "error",
+                        "error": f"actor worker exited at startup "
+                                 f"(code {worker.proc.returncode})"}
             return {"status": "error", "error": "actor worker failed to register"}
         worker.state = "busy"
         qt = QueuedTask(spec=spec, submitter=conn)
@@ -2375,6 +2628,9 @@ class Raylet:
                 "queued": len(self._queue),
                 "running": len(self._running),
                 "workers": self.pool.num_alive(),
+                "worker_spawns": dict(self.pool.spawn_counts),
+                "forge_alive": bool(self.forge is not None
+                                    and self.forge.alive),
                 "resources_total": total,
                 "resources_available": avail,
                 "store": self.store.stats(),

@@ -115,6 +115,10 @@ class CoreRuntime:
         self._actor_states: Dict[bytes, Dict[str, Any]] = {}
         self._env_cache = None  # lazy runtime_env.EnvCache
         self._actor_events: Dict[bytes, threading.Event] = defaultdict(threading.Event)
+        # Actor ids whose register_actor this runtime pipelined and whose
+        # first state push hasn't landed yet (see create_actor /
+        # wait_for_actor: suppresses the per-poll directory query).
+        self._created_pending: set = set()
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
         self._free_buffer: List[ObjectID] = []
         self._free_timer: Optional[threading.Timer] = None
@@ -321,6 +325,7 @@ class CoreRuntime:
             actor_key = data["key"]
             with self._lock:
                 self._actor_states[actor_key] = data["message"]
+                self._created_pending.discard(actor_key)
                 self._actor_events[actor_key].set()
                 client = self._actor_clients.get(actor_key)
                 if client is not None and data["message"].get("state") != "ALIVE":
@@ -778,8 +783,34 @@ class CoreRuntime:
 
     def create_actor(self, spec: TaskSpec) -> ActorID:
         spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)
-        self.gcs.call("subscribe", {"channel": "ACTOR", "key": spec.actor_id.binary()})
-        self.gcs.call("register_actor", {"spec": spec})
+        key = spec.actor_id.binary()
+        # One RPC, subscription piggybacked (the GCS subscribes this
+        # connection before scheduling, so the ALIVE publish can't be
+        # missed). Named actors stay synchronous: a name conflict must
+        # raise HERE (reference semantics). Anonymous creates pipeline —
+        # send-and-go, so a burst of N creates costs N sends instead of
+        # N serialized GCS round trips; registration failures surface as
+        # ActorDiedError on first use via the actor-state machinery.
+        if spec.actor_name:
+            self.gcs.call("register_actor", {"spec": spec, "subscribe": True})
+            return spec.actor_id
+        with self._lock:
+            self._created_pending.add(key)
+
+        def cb(env, _payload):
+            err = env.get("e") or ("GCS connection lost during actor "
+                                   "registration" if env.get("_lost") else None)
+            if err is None:
+                return
+            with self._lock:
+                self._created_pending.discard(key)
+                self._actor_states[key] = {"state": "DEAD", "address": None,
+                                           "reason": str(err),
+                                           "error_blob": None}
+                self._actor_events[key].set()
+
+        self.gcs.call_async("register_actor", {"spec": spec,
+                                               "subscribe": True}, cb)
         return spec.actor_id
 
     def _prepare_runtime_env(self, renv):
@@ -797,10 +828,25 @@ class CoreRuntime:
     def wait_for_actor(self, actor_id: ActorID, timeout: float = 120.0) -> str:
         key = actor_id.binary()
         deadline = time.monotonic() + timeout
+        # For actors THIS runtime just registered, the subscription rides
+        # the register RPC and the ALIVE push is guaranteed to arrive —
+        # querying the directory in the wait loop only adds an RPC per
+        # 0.5s poll slice per pending actor (an RPC storm during create
+        # bursts). Query immediately for foreign actors (named lookups,
+        # deserialized handles); for locally-created ones the directory
+        # query is anti-entropy after a grace period.
+        with self._lock:
+            locally_created = key in self._created_pending
+        # Foreign actors keep the old 0.5s poll cadence — THIS runtime has
+        # no pubsub subscription for them, so the directory query is the
+        # only progress signal.
+        requery = 5.0 if locally_created else 0.5
+        next_query = time.monotonic() + (requery if locally_created else 0.0)
         while time.monotonic() < deadline:
             with self._lock:
                 state = self._actor_states.get(key)
-            if state is None:
+            if state is None and time.monotonic() >= next_query:
+                next_query = time.monotonic() + requery
                 info = self.gcs.call("get_actor_info", {"actor_id": actor_id})
                 if info["known"]:
                     state = {"state": info["state"], "address": info["address"],
@@ -810,6 +856,8 @@ class CoreRuntime:
                         with self._lock:
                             self._actor_states[key] = state
             if state is not None:
+                with self._lock:
+                    self._created_pending.discard(key)
                 if state["state"] == "ALIVE" and state.get("address"):
                     return state["address"]
                 if state["state"] == "DEAD":

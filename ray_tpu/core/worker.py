@@ -586,6 +586,31 @@ class WorkerRuntime(CoreRuntime):
         threading.Thread(target=lambda: (os._exit(0)), daemon=True).start()
 
 
+def forked_main():
+    """Entry for forge-forked workers (core/worker_forge.py): the template
+    already paid the module imports, so this only resets per-process state
+    the fork duplicated — RNG streams (two forked workers must not draw
+    identical randomness from the template's inherited state; framework
+    ids reseed themselves via the pid-keyed PRNG in ids._random_bytes)
+    and the template's logging handlers (main()'s basicConfig would
+    otherwise be a no-op and worker logs would carry the forge's
+    formatting) — then runs the normal main. The granted env vars were
+    applied by the forge child before this call."""
+    import random
+
+    random.seed()  # fresh entropy, not the template's inherited state
+    np = sys.modules.get("numpy")
+    if np is not None:
+        # Legacy global stream (new-style Generators are per-use). Seeded
+        # from the just-reseeded stdlib RNG: the no-arg form gathers OS
+        # entropy and costs ~30ms per fork — pure spawn-latency tax.
+        np.random.seed(random.getrandbits(32))
+    root = logging.getLogger()
+    for h in root.handlers[:]:
+        root.removeHandler(h)
+    main()
+
+
 def main():
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
