@@ -1158,6 +1158,92 @@ def bench_inference(quick: bool) -> dict:
     return out
 
 
+def bench_tracing(quick: bool) -> dict:
+    """Tracing-plane overhead: tier-1-class task throughput and serve
+    echo RPS with tracing OFF vs ON (sampling 1.0). `tracing_overhead_pct`
+    is the regression gate for span additions on the hot path — the
+    disabled path must stay guard-check-only (off-vs-off run-to-run noise
+    bounds what "unmeasurable" means on this sandbox), and the enabled
+    path cheap enough to leave on in benches. A-B-A ordering (off, on,
+    off) so ambient drift shows up as disagreement between the two
+    baselines instead of being billed to tracing."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.observability import tracing as _tracing
+
+    n_tasks = 300 if quick else 2000
+    n_echo = 100 if quick else 1000
+
+    def _clear_overrides():
+        GLOBAL_CONFIG._overrides.pop("tracing_enabled", None)
+        GLOBAL_CONFIG._overrides.pop("trace_sample_rate", None)
+        _tracing.refresh_from_config()
+
+    def run_once(enabled: bool) -> dict:
+        ray_tpu.shutdown()
+        _clear_overrides()
+        sc = {"tracing_enabled": True, "trace_sample_rate": 1.0} \
+            if enabled else None
+        ray_tpu.init(num_cpus=4, _system_config=sc)
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(32)])  # warm pool/leases
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n_tasks)])
+        tps = n_tasks / (time.perf_counter() - t0)
+
+        @serve.deployment(num_replicas=1, max_concurrent_queries=64)
+        class TraceEcho:
+            def __call__(self, payload):
+                return payload
+
+        handle = serve.run(TraceEcho.bind())
+        ray_tpu.get([handle.remote(i) for i in range(16)])
+        t0 = time.perf_counter()
+        ray_tpu.get([handle.remote(i) for i in range(n_echo)])
+        rps = n_echo / (time.perf_counter() - t0)
+        # Full serve teardown (not delete): the process-global router must
+        # not survive into the next off/on cluster of this A-B-A run.
+        serve.shutdown()
+        ray_tpu.shutdown()
+        _clear_overrides()
+        return {"tasks": tps, "rps": rps}
+
+    off_a = run_once(False)
+    on = run_once(True)
+    off_b = run_once(False)
+    base_tasks = max(off_a["tasks"], off_b["tasks"])
+    base_rps = max(off_a["rps"], off_b["rps"])
+    out = {
+        "tasks_per_s_tracing_off": round(base_tasks, 1),
+        "tasks_per_s_tracing_on": round(on["tasks"], 1),
+        "serve_echo_rps_tracing_off": round(base_rps, 1),
+        "serve_echo_rps_tracing_on": round(on["rps"], 1),
+        "tracing_off_noise_pct": round(
+            abs(off_a["tasks"] - off_b["tasks"])
+            / max(off_a["tasks"], off_b["tasks"]) * 100.0, 2),
+        "tracing_off_noise_serve_pct": round(
+            abs(off_a["rps"] - off_b["rps"])
+            / max(off_a["rps"], off_b["rps"]) * 100.0, 2),
+        "tracing_overhead_pct": round(max(0.0, (base_tasks - on["tasks"])
+                                          / base_tasks * 100.0), 2),
+        "tracing_overhead_serve_pct": round(
+            max(0.0, (base_rps - on["rps"]) / base_rps * 100.0), 2),
+    }
+    if out["tracing_overhead_pct"] > max(20.0,
+                                         3 * out["tracing_off_noise_pct"]):
+        # Well past both the budget and the ambient noise: flag it so the
+        # bench trajectory (and reviewers) can't miss a hot-path tax.
+        out["tracing_overhead_regression"] = True
+        print(f"WARNING: tracing overhead {out['tracing_overhead_pct']}% "
+              f"exceeds the regression budget", file=sys.stderr)
+    return out
+
+
 def main(out=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1167,6 +1253,7 @@ def main(out=None):
     ap.add_argument("--skip-serve", action="store_true")
     ap.add_argument("--skip-inference", action="store_true")
     ap.add_argument("--skip-envelope", action="store_true")
+    ap.add_argument("--skip-tracing", action="store_true")
     args = ap.parse_args()
 
     import ray_tpu
@@ -1251,6 +1338,11 @@ def main(out=None):
             extra.update(bench_collective(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["collective_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_tracing:
+        try:
+            extra.update(bench_tracing(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["tracing_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

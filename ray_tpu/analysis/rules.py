@@ -11,6 +11,7 @@ for the catalog with real before/after examples):
 - RL005 thread-leak            — threads daemonized or joined
 - RL006 jit-retrace-hazard     — XLA programs compiled once, cached
 - RL007 static-lock-order      — lock acquisition graph is acyclic
+- RL008 span-leak              — tracing spans always end()ed
 """
 
 from __future__ import annotations
@@ -789,3 +790,92 @@ def check_lock_order(ctx: FileContext) -> Iterable[Finding]:
             line, "RL007",
             f"lock-order cycle between {sorted(comp_set)}: {order} — pick "
             "one global order and restructure the odd acquisition out")
+
+
+# =====================================================================
+# RL008 span-leak
+# =====================================================================
+#
+# Tracing contract (ray_tpu/observability/tracing.py): a span returned by
+# `tracer.start_span(...)` must be ENDED — end() records it into the
+# flight recorder and restores the previous trace context.  An un-ended
+# span silently corrupts the trace tree: its children re-parent to it
+# forever (the contextvar never resets) and the span itself never reaches
+# the GCS.  Statically enforceable discipline:
+#
+#   with tracer.start_span("name") as span: ...        # preferred
+#   span = tracer.start_span("name"); try: ... finally: span.end()
+#
+# Anything else — a bare expression statement, an assignment whose name
+# is neither `with`-entered later nor `.end()`ed inside a `finally` of
+# the same function — is flagged.  Detection is by the CALL SHAPE
+# (`<anything>.start_span(...)` or a bare `start_span(...)`), so
+# `get_tracer().start_span(...)` — the dominant production form, whose
+# receiver is itself a call and has no dotted name — is covered.
+# Factory helpers that `return` a started span annotate the call with
+# `# raylint: disable=RL008` (the caller is then the owner).
+
+
+def _is_start_span(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr == "start_span"
+    return isinstance(call.func, ast.Name) and call.func.id == "start_span"
+
+
+def _span_closer_names(fn: ast.AST) -> Set[str]:
+    """Names that provably end their span somewhere in `fn`: `x` with an
+    `x.end(...)` call inside a finally block, or `x` used as a bare
+    `with x:` context expression (the guarded-assign idiom:
+    ``span = NOOP; if enabled: span = start_span(...)`` then
+    ``with span:``). Nested defs excluded — they run on another frame."""
+    names: Set[str] = set()
+    for sub in walk_excluding_nested_functions(fn):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                if isinstance(item.context_expr, ast.Name):
+                    names.add(item.context_expr.id)
+            continue
+        if not isinstance(sub, ast.Try) or not sub.finalbody:
+            continue
+        for stmt in sub.finalbody:
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = dotted(call.func)
+                if name and name.endswith(".end"):
+                    names.add(name[: -len(".end")])
+    return names
+
+
+@rule("RL008", "span-leak: start_span not context-managed or end()ed "
+               "in a finally")
+def rl008_span_leak(ctx: FileContext) -> Iterable[Finding]:
+    for fn in _functions(ctx):
+        closers: Optional[Set[str]] = None  # computed lazily per function
+        for call in _calls_in(fn):
+            if not _is_start_span(call):
+                continue
+            parent = ctx.parent(call)
+            # `with ... start_span(...) [as s]:` — the context manager
+            # ends the span on every path.
+            if isinstance(parent, ast.withitem):
+                continue
+            if isinstance(parent, ast.Assign) \
+                    and len(parent.targets) == 1:
+                target = dotted(parent.targets[0])
+                if target is not None:
+                    if closers is None:
+                        closers = _span_closer_names(fn)
+                    if target in closers:
+                        continue
+                    yield ctx.finding(
+                        call, "RL008",
+                        f"span assigned to {target!r} is neither entered "
+                        "with `with` nor end()ed in a finally block — an "
+                        "un-ended span corrupts the trace tree (context "
+                        "never restored)")
+                    continue
+            yield ctx.finding(
+                call, "RL008",
+                "start_span() result discarded — the span can never be "
+                "ended; use it as a context manager")

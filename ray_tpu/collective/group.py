@@ -46,6 +46,7 @@ from ray_tpu.collective.buffer import (
     REDUCE_UFUNCS,
     tree_index,
 )
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -245,6 +246,17 @@ class CollectiveGroup:
             f"collective '{self.name}' {what} failed: {resp}",
             None, self.name))
 
+    def _op_span(self, name: str, seq: int, **attrs):
+        """Span for one collective op on this rank (no-op singleton when
+        tracing is off); a stalled/aborted op shows up as an errored span
+        with the group/rank/seq attribution."""
+        if not _tracing._ENABLED:
+            return _tracing.NOOP_SPAN
+        # Factory: every caller uses the result as a context manager.
+        return _tracing.get_tracer().start_span(  # raylint: disable=RL008
+            name, attrs={"group": self.name, "rank": self.rank,
+                         "seq": seq, **attrs})
+
     def _call(self, method: str, data: Dict[str, Any], what: str,
               timeout: float) -> Dict[str, Any]:
         data = {"name": self.name, "epoch": self.epoch, **data}
@@ -325,15 +337,17 @@ class CollectiveGroup:
         packed = PackedTree(value, self.world_size)
         if self.world_size == 1:
             return packed.unpack()
-        if packed.total_bytes < GLOBAL_CONFIG.collective_ring_min_bytes:
-            self._allreduce_fanin(seq, packed, ufunc)
-        else:
-            self._allreduce_ring(seq, packed, ufunc)
-        # Every allreduce ends with the fence — including the all-inline
-        # fan-in: ops are bulk-synchronous by contract, and a rank that
-        # returned (and may destroy()/leave()) while a peer's take is
-        # still parked would abort that peer mid-op.
-        self._sync(seq)
+        with self._op_span("collective.allreduce", seq,
+                           nbytes=packed.total_bytes, op=op):
+            if packed.total_bytes < GLOBAL_CONFIG.collective_ring_min_bytes:
+                self._allreduce_fanin(seq, packed, ufunc)
+            else:
+                self._allreduce_ring(seq, packed, ufunc)
+            # Every allreduce ends with the fence — including the
+            # all-inline fan-in: ops are bulk-synchronous by contract, and
+            # a rank that returned (and may destroy()/leave()) while a
+            # peer's take is still parked would abort that peer mid-op.
+            self._sync(seq)
         return packed.unpack(mean_divisor=self.world_size if mean else None)
 
     def _allreduce_fanin(self, seq: int, packed: PackedTree, ufunc):
@@ -418,12 +432,13 @@ class CollectiveGroup:
         seq = self._begin_op()
         if self.world_size == 1:
             return [value]
-        self._post_value(f"{seq}:ag:{self.rank}", value,
-                         consumers=self.world_size - 1)
-        out = [value if peer == self.rank
-               else self._take_value(f"{seq}:ag:{peer}")
-               for peer in range(self.world_size)]
-        self._sync(seq)
+        with self._op_span("collective.allgather", seq):
+            self._post_value(f"{seq}:ag:{self.rank}", value,
+                             consumers=self.world_size - 1)
+            out = [value if peer == self.rank
+                   else self._take_value(f"{seq}:ag:{peer}")
+                   for peer in range(self.world_size)]
+            self._sync(seq)
         return out
 
     def broadcast(self, value: Any, src_rank: int = 0) -> Any:
@@ -433,13 +448,14 @@ class CollectiveGroup:
         seq = self._begin_op()
         if self.world_size == 1:
             return value
-        if self.rank == src_rank:
-            self._post_value(f"{seq}:bc", value,
-                             consumers=self.world_size - 1)
-            out = value
-        else:
-            out = self._take_value(f"{seq}:bc")
-        self._sync(seq)
+        with self._op_span("collective.broadcast", seq, src=src_rank):
+            if self.rank == src_rank:
+                self._post_value(f"{seq}:bc", value,
+                                 consumers=self.world_size - 1)
+                out = value
+            else:
+                out = self._take_value(f"{seq}:bc")
+            self._sync(seq)
         return out
 
     def reducescatter(self, value: Any, op: str = "sum") -> Any:
@@ -454,9 +470,10 @@ class CollectiveGroup:
 
     def barrier(self) -> None:
         seq = self._begin_op()
-        self._call("collective_barrier",
-                   {"seq": f"user:{seq}", "rank": self.rank},
-                   "barrier", self._stall)
+        with self._op_span("collective.barrier", seq):
+            self._call("collective_barrier",
+                       {"seq": f"user:{seq}", "rank": self.rank},
+                       "barrier", self._stall)
 
     # ------------------------------------------------------------ teardown
 

@@ -132,6 +132,21 @@ _flag("collective_ring_min_bytes", int, 256 * 1024,
       "Flat buffers below this total size allreduce via direct fan-in "
       "(latency-bound regime); at or above, the bandwidth-optimal ring "
       "reduce-scatter/all-gather runs over the transfer plane")
+_flag("tracing_enabled", _parse_bool, False,
+      "Distributed tracing plane: cross-process spans recorded into a "
+      "per-process flight recorder and flushed to the GCS. Disabled path "
+      "is a guard check only (no allocation per call site)")
+_flag("trace_sample_rate", float, 1.0,
+      "Head-based sampling: probability a new root span starts a "
+      "recorded trace. Propagated with the trace context, so a whole "
+      "request is in or out together")
+_flag("trace_buffer_spans", int, 4096,
+      "Per-process flight-recorder capacity in spans; the buffer drops "
+      "oldest (counting drops) so tracing memory is bounded under span "
+      "storms. Spans that recorded an error survive drop-oldest")
+_flag("trace_gcs_max_spans", int, 50000,
+      "GCS-side trace store capacity in spans (drop-oldest with a "
+      "counter); bounds /api/timeline and /api/traces memory")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")

@@ -133,8 +133,15 @@ class GcsServer:
         self.borrower_index: Dict[str, set] = {}
         # Task events ring buffer for the state API / timeline
         self.task_events: deque = deque(maxlen=GLOBAL_CONFIG.task_events_max_buffer)
-        # Metric snapshots per reporting process (TTL-expired)
+        # Metric snapshots per reporting process (expired when the
+        # reporter stops flushing or its node dies)
         self.metrics: Dict[str, Dict[str, Any]] = {}
+        self._stale_reporters_total = 0
+        # Trace store: spans flushed by every process's MetricsPusher
+        # (piggybacked on metrics_report). Bounded drop-oldest; serves
+        # /api/traces/<id>, /api/timeline and the observability CLI.
+        self.trace_spans: deque = deque()
+        self.trace_dropped = 0
         # Per-node queued-but-unsatisfiable resource shapes (autoscaler feed)
         self.node_demand: Dict[NodeID, List[Dict[str, float]]] = {}
         # Last streamed resource-delta version per node (stale-drop).
@@ -1565,22 +1572,58 @@ class GcsServer:
     # ------------------------------------------------------- metrics export
 
     _METRICS_TTL_S = 30.0
+    # A reporter is stale after this many missed flush periods (it sends
+    # its period with every report), or immediately once its node is DEAD
+    # — a dead worker/replica must not serve its last snapshot from
+    # /metrics forever.
+    _METRICS_STALE_PERIODS = 5
 
     def handle_metrics_report(self, conn: Connection, data: Dict[str, Any]):
         """A process pushed its metric registry snapshot (reference
-        metrics_agent.py:375 harvest path)."""
+        metrics_agent.py:375 harvest path) — and, piggybacked on the same
+        cadence, its tracing flight-recorder spans."""
+        spans = data.get("spans")
         with self._lock:
             self.metrics[data["reporter"]] = {
-                "metrics": data["metrics"], "ts": data.get("ts", time.time())}
+                "metrics": data["metrics"], "ts": data.get("ts", time.time()),
+                "period": data.get("period_s"), "node": data.get("node")}
+            if spans:
+                cap = max(1, GLOBAL_CONFIG.trace_gcs_max_spans)
+                proc = data["reporter"]
+                for span in spans:
+                    span["proc"] = proc
+                    while len(self.trace_spans) >= cap:
+                        self.trace_spans.popleft()
+                        self.trace_dropped += 1
+                    self.trace_spans.append(span)
+            self.trace_dropped += int(data.get("spans_dropped") or 0)
         return {}
 
     def _live_metrics(self) -> Dict[str, List]:
-        cutoff = time.time() - self._METRICS_TTL_S
+        now = time.time()
         with self._lock:
-            stale = [r for r, e in self.metrics.items() if e["ts"] < cutoff]
+            dead_nodes = {n.node_id.hex() for n in self.nodes.values()
+                          if n.state != "ALIVE"}
+            stale = []
+            for r, e in self.metrics.items():
+                ttl = max(self._METRICS_TTL_S,
+                          self._METRICS_STALE_PERIODS
+                          * float(e.get("period") or 0.0))
+                if e["ts"] < now - ttl or (e.get("node") in dead_nodes
+                                           and e.get("node")):
+                    stale.append(r)
             for r in stale:
                 del self.metrics[r]
-            return {r: e["metrics"] for r, e in self.metrics.items()}
+            self._stale_reporters_total += len(stale)
+            out = {r: e["metrics"] for r, e in self.metrics.items()}
+            # Synthetic GCS-side gauge: how many reporter snapshots have
+            # been expired as stale over this GCS's lifetime.
+            out["gcs"] = [{
+                "name": "metrics_stale_reporters", "kind": "gauge",
+                "description": "metric reporter snapshots expired as stale "
+                               "(reporter stopped flushing or node died)",
+                "series": [[[], float(self._stale_reporters_total)]]}]
+            return out
 
     def handle_metrics_snapshot(self, conn: Connection, data=None):
         return self._live_metrics()
@@ -1589,6 +1632,36 @@ class GcsServer:
         from ray_tpu.util.metrics import render_prometheus
 
         return {"text": render_prometheus(self._live_metrics())}
+
+    # ------------------------------------------------------- trace export
+
+    def handle_trace_get(self, conn: Connection, data: Dict[str, Any]):
+        """Every stored span of one trace (the /api/traces/<id> feed)."""
+        trace_id = data["trace_id"]
+        with self._lock:
+            spans = [s for s in self.trace_spans
+                     if s.get("trace_id") == trace_id]
+        return {"spans": spans}
+
+    def handle_trace_timeline(self, conn: Connection, data=None):
+        """Spans for the Chrome-trace timeline. `window_s` keeps only
+        spans that ended within the last window; `limit` caps the span
+        count (newest win) so a huge trace buffer cannot OOM the JSON
+        encoder downstream."""
+        data = data or {}
+        window = data.get("window_s")
+        limit = data.get("limit")
+        with self._lock:
+            spans = list(self.trace_spans)
+            dropped = self.trace_dropped
+        if window:
+            cutoff = time.time() - float(window)
+            spans = [s for s in spans if (s.get("end") or 0) >= cutoff]
+        truncated = 0
+        if limit is not None and len(spans) > int(limit):
+            truncated = len(spans) - int(limit)
+            spans = spans[-int(limit):]
+        return {"spans": spans, "dropped": dropped, "truncated": truncated}
 
     def handle_add_task_events(self, conn: Connection, data: Dict[str, Any]):
         with self._lock:

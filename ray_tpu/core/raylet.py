@@ -53,6 +53,7 @@ from ray_tpu.core.rpc import (
 )
 from ray_tpu.core.worker_forge import ForgeUnavailable, WorkerForge
 from ray_tpu.exceptions import RaySystemError
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -265,11 +266,20 @@ class WorkerPool:
         handle = WorkerHandle(worker_id=worker_id, pid=0, proc=None,
                               spawn_kind="cold")
         handle.granted_env = env_extra or {}
+        spawn_span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            # Roots its own trace (spawns are demand-driven, not tied to
+            # one request); the kind attr lands once the path is known.
+            spawn_span = _tracing.get_tracer().start_span(
+                "worker.spawn",
+                attrs={"worker": worker_id.hex()[:12],
+                       "node": self._raylet.node_id.hex()[:12]})
         with self._lock:
             self._workers[worker_id] = handle
             self._starting += 1
         forge = self._raylet.forge
         proc = None
+        spawn_err: Optional[str] = None
         try:
             if kind != "cold" and forge is not None \
                     and WorkerForge.compatible(env_extra or {}):
@@ -299,10 +309,14 @@ class WorkerPool:
                     cwd=os.getcwd(),
                 )
                 out.close()  # Popen holds its own dup
-        except BaseException:
+        except BaseException as e:
             # No process came to be: unwind the optimistic registration.
+            spawn_err = f"{type(e).__name__}: {e}"
             self.mark_dead(worker_id)
             raise
+        finally:
+            spawn_span.set_attr("kind", handle.spawn_kind)
+            spawn_span.end(error=spawn_err)
         handle.pid = proc.pid
         handle.proc = proc
         with self._lock:
@@ -1470,6 +1484,16 @@ class Raylet:
         spec = qt.spec
         worker.current_task = spec
         worker.task_started = time.monotonic()
+        if _tracing._ENABLED:
+            # Queue-time span, reconstructed at dispatch: a child of the
+            # task's span so "where did the latency go" shows raylet
+            # queueing separately from execution.
+            now = time.monotonic()
+            _tracing.get_tracer().record_span(
+                "raylet.queue", _tracing.epoch_of(qt.queued_at),
+                _tracing.epoch_of(now), parent_ctx=spec.trace_ctx,
+                attrs={"task": spec.name,
+                       "node": self.node_id.hex()[:12]})
         with self._lock:
             self._running[spec.task_id.binary()] = (spec, worker)
         self._record_task_event(spec, "RUNNING", worker)
@@ -1948,6 +1972,13 @@ class Raylet:
         with self._lock:
             self._active_pulls[oid] = state
         ok = False
+        plan: Dict[str, Any] = {}
+        pull_span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            pull_span = _tracing.get_tracer().start_span(
+                "object.pull",
+                attrs={"object": oid.hex()[:16], "size": size,
+                       "node": self.node_id.hex()[:12]})
         try:
             from ray_tpu._native import copy_at
 
@@ -1974,13 +2005,17 @@ class Raylet:
             # disjoint early chunk sets are what make the partial-holder
             # swarm actually drain load off the seed.
             random.shuffle(work)
-            plan = {
+            plan.update({
                 "lock": threading.Lock(),
                 "work": deque(work),
                 "completed": len(state.done),
                 "last_progress": time.monotonic(),
                 "abort": None,
-            }
+            })
+            if pull_span is not _tracing.NOOP_SPAN:
+                # Per-chunk annotations (bounded): chunk workers append
+                # (idx, ms, source) samples under plan["lock"].
+                plan["trace_chunks"] = []
             # Stall-based abort, not a fixed bandwidth floor: as long as
             # chunks keep landing the pull may take as long as it takes
             # (a healthy 10 MB/s WAN link must not be declared dead);
@@ -2014,6 +2049,11 @@ class Raylet:
                         self._unannounced_objects[oid] = size
             return ok
         finally:
+            if pull_span is not _tracing.NOOP_SPAN:
+                pull_span.set_attr("chunks", max(1, -(-size // chunk_bytes)))
+                pull_span.set_attr("chunk_samples",
+                                   plan.get("trace_chunks") or [])
+            pull_span.end(error=None if ok else "pull failed or aborted")
             with self._lock:
                 self._active_pulls.pop(oid, None)
             if not ok:
@@ -2099,7 +2139,14 @@ class Raylet:
                     with plan["lock"]:
                         plan["completed"] += 1
                         completed = plan["completed"]
-                        plan["last_progress"] = time.monotonic()
+                        now_mono = time.monotonic()
+                        chunks = plan.get("trace_chunks")
+                        if chunks is not None and len(chunks) < 32:
+                            chunks.append(
+                                [idx, round((now_mono
+                                             - plan["last_progress"]) * 1e3,
+                                            2), addr])
+                        plan["last_progress"] = now_mono
                     if completed % refetch_every == 0:
                         # Pick up sources that appeared mid-pull.
                         self._refresh_pull_peers(oid, peers, my_hex)

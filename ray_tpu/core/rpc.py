@@ -29,6 +29,7 @@ import msgpack
 from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.exceptions import RaySystemError
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -265,6 +266,13 @@ class RpcServer:
                 method = envelope["m"]
                 handler = self._handlers.get(method)
                 resp_env = {"i": envelope["i"], "k": "resp", "m": method}
+                # Restore the caller's trace context for the handler (the
+                # server half of wire propagation); reset after — this
+                # connection thread serves many unrelated requests.
+                trace_tok = None
+                wire_t = envelope.get("t")
+                if wire_t is not None:
+                    trace_tok = _tracing.activate_wire(wire_t)
                 try:
                     raw = self._raw_handlers.get(method)
                     if raw is not None:
@@ -290,6 +298,9 @@ class RpcServer:
                                  e, exc_info=True)
                     resp_env["e"] = f"{type(e).__name__}: {e}"
                     out = b""
+                finally:
+                    if trace_tok is not None:
+                        _tracing.deactivate(trace_tok)
                 _send_msg(conn.sock, resp_env, out, conn.send_lock)
         except (ConnectionLost, OSError) as e:
             close_reason = f"{type(e).__name__}: {e}"
@@ -541,9 +552,13 @@ class RpcClient:
                     callback({"e": "connection lost", "_lost": True}, b"")
                 return
         payload = serialization.dumps_ctrl(data)
+        env = {"i": msg_id, "k": "req", "m": method}
+        if _tracing._ENABLED:
+            t = _tracing.wire_ctx()
+            if t is not None:
+                env["t"] = t
         try:
-            _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method},
-                      payload, self._send_lock)
+            _send_msg(self._sock, env, payload, self._send_lock)
         except OSError as e:
             self._closed.set()
             with self._pending_lock:
@@ -570,8 +585,13 @@ class RpcClient:
             slot["sink"] = sink
         with self._pending_lock:
             self._pending[msg_id] = slot
+        env = {"i": msg_id, "k": "req", "m": method}
+        if _tracing._ENABLED:
+            t = _tracing.wire_ctx()
+            if t is not None:
+                env["t"] = t
         try:
-            _send_msg(self._sock, {"i": msg_id, "k": "req", "m": method}, payload, self._send_lock)
+            _send_msg(self._sock, env, payload, self._send_lock)
         except OSError as e:
             self._closed.set()
             raise ConnectionLost(str(e))

@@ -164,23 +164,25 @@ class CoreRuntime:
         self._closed = False
         # Worker-side execution context (set by worker loop while running)
         self.executing_task: Optional[TaskSpec] = None
-        # Span propagation (reference tracing_helper.py:35-81): the trace
-        # context of the currently-executing task; child submissions
-        # inherit it. A ContextVar, not threading.local: async actor
-        # methods interleave on ONE event-loop thread, and each asyncio
-        # task needs its own copy (a thread-local would let concurrent
-        # async calls clobber each other's trace).
-        import contextvars
+        # Span propagation (reference tracing_helper.py:35-81) lives in
+        # the process-global tracing module (ray_tpu.observability): the
+        # context of the currently-executing task flows into child
+        # submissions, RPC framing, and spans. Re-read the tracing flags
+        # here so workers pick them up from the propagated env.
+        from ray_tpu.observability import tracing as _tracing_mod
 
-        self._trace_cv = contextvars.ContextVar(
-            f"rtpu_trace_{id(self)}", default=None)
+        _tracing_mod.refresh_from_config()
         # Metrics flush: user Counters/Gauges/Histograms in this process
-        # surface at the GCS (rendered by /metrics on the dashboard).
+        # surface at the GCS (rendered by /metrics on the dashboard);
+        # trace spans from the flight recorder piggyback on the same
+        # cadence. `node` lets the GCS expire this reporter when the
+        # owning node dies.
         from ray_tpu.util.metrics import MetricsPusher
 
         self._metrics_pusher = MetricsPusher(
             self.gcs, reporter_id=("driver-" if is_driver else "worker-")
-            + self.worker_id.hex()[:12])
+            + self.worker_id.hex()[:12],
+            node=node_id.hex() if node_id is not None else None)
         self._metrics_pusher.start()
         # Drivers receive worker stdout/stderr over the LOG channel
         # (reference log_to_driver).
@@ -560,19 +562,17 @@ class CoreRuntime:
                                r["object_id"])
 
     def child_trace_ctx(self) -> Dict[str, str]:
-        """A fresh span for a task being submitted from this context: same
-        trace as the currently-executing task (or a new root trace), with
-        the current span as parent."""
-        current = self._trace_cv.get()
-        span_id = os.urandom(8).hex()
-        if current:
-            return {"trace_id": current["trace_id"], "span_id": span_id,
-                    "parent_span_id": current["span_id"]}
-        return {"trace_id": os.urandom(16).hex(), "span_id": span_id,
-                "parent_span_id": None}
+        """A fresh span context for a task being submitted from this
+        context: same trace as the currently-executing task (or a new
+        root, head-sampled), with the current span as parent."""
+        from ray_tpu.observability import tracing
+
+        return tracing.child_spec_ctx()
 
     def set_trace_ctx(self, ctx: Optional[Dict[str, str]]):
-        self._trace_cv.set(ctx)
+        from ray_tpu.observability import tracing
+
+        tracing.set_current(ctx)
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         if spec.trace_ctx is None:

@@ -37,6 +37,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.rpc import DEFERRED, Connection, RpcServer
 from ray_tpu.core.runtime import CoreRuntime
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -244,8 +245,15 @@ class WorkerRuntime(CoreRuntime):
         self.executing_task = spec
         # Children submitted by the body join this task's trace.
         self.set_trace_ctx(spec.trace_ctx)
+        # The span ADOPTS the spec's ids: the submitter minted them, so
+        # the executed span and the caller's parent edge line up.
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "task.run", ctx=spec.trace_ctx, attrs={"task": spec.name})
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
+        trace_err: Optional[str] = None
         try:
             if getattr(self, "_env_setup_error", None):
                 from ray_tpu.exceptions import RuntimeEnvSetupError
@@ -270,10 +278,12 @@ class WorkerRuntime(CoreRuntime):
                        for oid, v in zip(spec.return_ids(), values)]
         except BaseException as e:  # noqa: BLE001 - worker must survive user errors
             error_blob = serialization.serialize_exception(e, spec.name)
+            trace_err = f"{type(e).__name__}: {e}"
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 self._stopping.set()
         finally:
             self.executing_task = None
+            span.end(error=trace_err)
             self.set_trace_ctx(None)
         return results, error_blob
 
@@ -446,6 +456,10 @@ class WorkerRuntime(CoreRuntime):
                        {"err": serialization.serialize_exception(e, name)})
 
         if asyncio.iscoroutinefunction(getattr(method, "__func__", method)):
+            # Trace context crosses into the loop automatically:
+            # run_coroutine_threadsafe schedules via call_soon_threadsafe,
+            # which snapshots THIS thread's contextvars (set by the RPC
+            # server from the envelope's wire context).
             async def run_async():
                 try:
                     reply_ok(await method(*args, **kwargs))
@@ -453,8 +467,17 @@ class WorkerRuntime(CoreRuntime):
                     reply_err(e)
             asyncio.run_coroutine_threadsafe(run_async(), self._async_loop)
         else:
+            # Executor threads do NOT inherit contextvars: hand the wire
+            # trace context across explicitly (None when tracing is off).
+            tctx = _tracing.capture()
+
             def run():
                 try:
+                    if _tracing._ENABLED:
+                        # Unconditional when tracing: also CLEARS any
+                        # stale context a previous request left on this
+                        # pooled executor thread.
+                        _tracing.set_current(tctx)
                     reply_ok(method(*args, **kwargs))
                 except BaseException as e:  # noqa: BLE001 — delivered to caller
                     reply_err(e)
@@ -517,7 +540,13 @@ class WorkerRuntime(CoreRuntime):
     def _run_actor_method(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
+        trace_err: Optional[str] = None
         self.set_trace_ctx(spec.trace_ctx)
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "actor.call", ctx=spec.trace_ctx,
+                attrs={"method": spec.method_name})
         try:
             if spec.method_name == "__ray_terminate__":
                 self._graceful_exit(conn, spec)
@@ -529,7 +558,9 @@ class WorkerRuntime(CoreRuntime):
                        for oid, v in zip(spec.return_ids(), values)]
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
+            trace_err = f"{type(e).__name__}: {e}"
         finally:
+            span.end(error=trace_err)
             self.set_trace_ctx(None)
             with self._reply_lock:
                 self._actor_calls.pop(spec.task_id.binary(), None)
@@ -538,7 +569,13 @@ class WorkerRuntime(CoreRuntime):
     async def _run_actor_method_async(self, conn: Connection, spec: TaskSpec, method):
         results: List[Dict[str, Any]] = []
         error_blob: Optional[bytes] = None
+        trace_err: Optional[str] = None
         self.set_trace_ctx(spec.trace_ctx)
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "actor.call", ctx=spec.trace_ctx,
+                attrs={"method": spec.method_name})
         try:
             args, kwargs = self._resolve_args(spec)
             out = await method(*args, **kwargs)
@@ -552,9 +589,12 @@ class WorkerRuntime(CoreRuntime):
 
             error_blob = serialization.serialize_exception(
                 TaskCancelledError(spec.task_id), spec.name)
+            trace_err = "TaskCancelledError"
         except BaseException as e:  # noqa: BLE001
             error_blob = serialization.serialize_exception(e, spec.name)
+            trace_err = f"{type(e).__name__}: {e}"
         finally:
+            span.end(error=trace_err)
             self.set_trace_ctx(None)
             with self._reply_lock:
                 self._actor_calls.pop(spec.task_id.binary(), None)

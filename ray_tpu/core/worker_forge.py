@@ -871,8 +871,12 @@ class WorkerForge:
               log_path: str) -> _ForgedProc:
         """Fork a fully-imported worker; returns its Popen-like handle.
         Raises ForgeUnavailable (caller falls back to cold spawn)."""
-        reply = self._call({"c": "spawn", "env": env_delta, "cwd": cwd,
-                            "log": log_path})
+        from ray_tpu.observability import tracing as _tracing
+
+        with _tracing.get_tracer().start_span("forge.fork") as span:
+            reply = self._call({"c": "spawn", "env": env_delta, "cwd": cwd,
+                                "log": log_path})
+            span.set_attr("pid", reply.get("pid"))
         pid = reply["pid"]
         proc = _ForgedProc(pid, self, self.generation)
         with self._state_lock:

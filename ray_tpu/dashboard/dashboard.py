@@ -22,7 +22,8 @@ td,th{{border:1px solid #999;padding:4px 8px;text-align:left}}</style></head>
 <h3>Actors</h3>{actors}
 <h3>Jobs</h3>{jobs}
 <p><a href="/metrics">/metrics</a> · <a href="/api/nodes">/api/nodes</a> ·
-<a href="/api/actors">/api/actors</a> · <a href="/api/jobs">/api/jobs</a></p>
+<a href="/api/actors">/api/actors</a> · <a href="/api/jobs">/api/jobs</a> ·
+<a href="/api/timeline">/api/timeline</a></p>
 </body></html>"""
 
 
@@ -86,24 +87,48 @@ class DashboardServer:
 
     # -------------------------------------------------------------- routes
 
+    _PAGE_CALL_TIMEOUT_S = 5.0
+
+    def _gather(self, gcs, methods):
+        """Fan the page's GCS calls out in parallel, each with its own
+        timeout — one slow/stuck table must not make `/` hang forever or
+        serialize four round trips. Failures degrade to empty sections."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(method):
+            try:
+                # RpcClient multiplexes message ids, so concurrent calls
+                # share the one GCS connection safely.
+                return gcs.call(method, timeout=self._PAGE_CALL_TIMEOUT_S)
+            except Exception as e:  # noqa: BLE001 — render what we have
+                logger.warning("dashboard: %s failed: %s", method, e)
+                return None
+        with ThreadPoolExecutor(max_workers=len(methods)) as pool:
+            return list(pool.map(one, methods))
+
     def _route(self, req: BaseHTTPRequestHandler):
-        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        from urllib.parse import parse_qs, urlsplit
+
+        parts = urlsplit(req.path)
+        path = parts.path.rstrip("/") or "/"
+        query = parse_qs(parts.query)
         gcs = self._client()
         if path == "/":
             import html
 
-            nodes = gcs.call("get_nodes")
-            actors = gcs.call("get_actors")
-            jobs = gcs.call("get_jobs") + gcs.call("list_jobs")
-            res = gcs.call("cluster_resources")
+            nodes, actors, jobs, subs, res = self._gather(
+                gcs, ["get_nodes", "get_actors", "get_jobs", "list_jobs",
+                      "cluster_resources"])
             page = _PAGE.format(
                 resources=html.escape(
                     json.dumps(res, indent=2, default=str)),
-                nodes=_table(nodes, ["NodeID", "Alive", "RayletAddress"]),
-                actors=_table(actors, ["ActorID", "ClassName", "State",
-                                       "Name"]),
-                jobs=_table(jobs, ["JobID", "submission_id", "State",
-                                   "status", "Entrypoint", "entrypoint"]))
+                nodes=_table(nodes or [], ["NodeID", "Alive",
+                                           "RayletAddress"]),
+                actors=_table(actors or [], ["ActorID", "ClassName",
+                                             "State", "Name"]),
+                jobs=_table((jobs or []) + (subs or []),
+                            ["JobID", "submission_id", "State",
+                             "status", "Entrypoint", "entrypoint"]))
             self._send(req, 200, page.encode(), "text/html")
         elif path == "/metrics":
             text = gcs.call("metrics_prometheus")["text"]
@@ -118,8 +143,31 @@ class DashboardServer:
                              "submissions": gcs.call("list_jobs")})
         elif path == "/api/cluster_resources":
             self._json(req, gcs.call("cluster_resources"))
+        elif path.startswith("/api/traces/"):
+            from ray_tpu.observability import span_tree
+
+            trace_id = path[len("/api/traces/"):]
+            resp = gcs.call("trace_get", {"trace_id": trace_id})
+            self._json(req, span_tree(resp.get("spans") or [], trace_id))
+        elif path == "/api/timeline":
+            from ray_tpu.observability import chrome_trace_events
+
+            # ?window=SECONDS and ?limit=N cap the export server-side so
+            # a huge trace buffer cannot OOM the JSON encoder.
+            window = query.get("window", [None])[0]
+            limit = query.get("limit", [None])[0]
+            resp = gcs.call("trace_timeline", {
+                "window_s": float(window) if window else None,
+                "limit": int(limit) if limit else self._TIMELINE_MAX_SPANS})
+            out = chrome_trace_events(resp.get("spans") or [])
+            out["spanDropCount"] = resp.get("dropped", 0)
+            out["spanTruncated"] = resp.get("truncated", 0)
+            self._json(req, out)
         else:
             self._send(req, 404, b"not found", "text/plain")
+
+    # Default span cap for /api/timeline when no ?limit= is given.
+    _TIMELINE_MAX_SPANS = 20000
 
     @staticmethod
     def _send(req, code: int, body: bytes, ctype: str):

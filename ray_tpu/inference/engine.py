@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.inference.kv_cache import BlockManager
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -84,8 +85,12 @@ class Request:
     error: Optional[str] = None
     preemptions: int = 0
     submitted_at: float = 0.0
+    admitted_at: Optional[float] = None    # first batch-slot admission
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Trace context captured at submission: the engine's queue/prefill/
+    # decode phase spans (a TTFT decomposition) re-parent to it.
+    trace_ctx: Optional[Dict] = None
     # Scheduler-internal:
     slot: Optional[int] = None
     processed: int = 0                # tokens written into the KV cache
@@ -227,7 +232,8 @@ class InferenceEngine:
                 prompt=prompt, max_new_tokens=max_new_tokens,
                 arrival=next(self._arrival_seq),
                 on_token=on_token, on_finish=on_finish,
-                submitted_at=time.monotonic())
+                submitted_at=time.monotonic(),
+                trace_ctx=_tracing.capture())
             self._live[rid] = req
             # Arrivals are strictly increasing: append preserves the
             # sorted-by-arrival invariant (_preempt_one re-sorts for its
@@ -320,6 +326,8 @@ class InferenceEngine:
             req.slot = free_slots[0]
             req.state = PREFILL
             req.processed = 0
+            if req.admitted_at is None:
+                req.admitted_at = time.monotonic()
             if req.generated:
                 self._recomputed_tokens += req.total_to_prefill
             self._slots[req.slot] = req
@@ -344,6 +352,12 @@ class InferenceEngine:
         victim.cur_token = None
         victim.preemptions += 1
         self._preemptions += 1
+        if _tracing._ENABLED:
+            now = _tracing.epoch_of(time.monotonic())
+            _tracing.get_tracer().record_span(
+                "engine.preempt", now, now, parent_ctx=victim.trace_ctx,
+                attrs={"request": victim.request_id,
+                       "tokens_generated": len(victim.generated)})
         self._waiting.append(victim)
         self._waiting.sort(key=lambda r: r.arrival)
         return True
@@ -514,6 +528,7 @@ class InferenceEngine:
             req.slot = None
         self._live.pop(req.request_id, None)
         self._fire(req, ("finish", None), emissions)
+        self._record_phase_spans(req)
 
     def fail_all(self, error: str) -> int:
         """Abort every scheduled and waiting request with `error` (the
@@ -576,6 +591,39 @@ class InferenceEngine:
         self._slots[req.slot] = None
         req.slot = None
         self._fire(req, ("finish", None), emissions)
+        self._record_phase_spans(req)
+
+    def _record_phase_spans(self, req: Request):
+        """TTFT decomposition, recorded once per finished request under
+        its captured trace context: engine.queue (submit -> first
+        admission), engine.prefill (admission -> first token),
+        engine.decode (first token -> finish). With engine.preempt
+        markers in between, a timeline answers "where did this request's
+        latency go" per phase."""
+        if not _tracing._ENABLED or req.trace_ctx is None:
+            return
+        tracer = _tracing.get_tracer()
+        eo = _tracing.epoch_of
+        end = req.finished_at if req.finished_at is not None \
+            else time.monotonic()
+        attrs = {"request": req.request_id}
+        tracer.record_span(
+            "engine.queue", eo(req.submitted_at),
+            eo(req.admitted_at if req.admitted_at is not None else end),
+            parent_ctx=req.trace_ctx, attrs=attrs, error=req.error)
+        if req.admitted_at is not None:
+            tracer.record_span(
+                "engine.prefill", eo(req.admitted_at),
+                eo(req.first_token_at if req.first_token_at is not None
+                   else end),
+                parent_ctx=req.trace_ctx,
+                attrs=dict(attrs, prompt_tokens=len(req.prompt)))
+        if req.first_token_at is not None:
+            tracer.record_span(
+                "engine.decode", eo(req.first_token_at), eo(end),
+                parent_ctx=req.trace_ctx,
+                attrs=dict(attrs, tokens=len(req.generated),
+                           preemptions=req.preemptions))
 
     # --------------------------------------------------------------- stats
 

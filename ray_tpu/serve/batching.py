@@ -22,6 +22,8 @@ import asyncio
 import functools
 from typing import Any, Callable, List, Optional, Set
 
+from ray_tpu.observability import tracing as _tracing
+
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
@@ -48,7 +50,10 @@ class _BatchQueue:
         fut = asyncio.get_running_loop().create_future()
         self._pending.add(fut)
         fut.add_done_callback(self._pending.discard)
-        self._queue.put_nowait((item, fut))
+        # Trace context rides with the item: the flusher coroutine runs
+        # outside any request context, so the batch span re-parents to
+        # the first batched request's trace.
+        self._queue.put_nowait((item, fut, _tracing.capture()))
         return await fut
 
     def stop(self) -> int:
@@ -90,14 +95,30 @@ class _BatchQueue:
                     break
             items = [b[0] for b in batch]
             futures = [b[1] for b in batch]
+            span = _tracing.NOOP_SPAN
+            if _tracing._ENABLED:
+                # Parent to the first batched request's SAMPLED context —
+                # the flusher task itself inherited whatever context was
+                # current when it was first created (not this batch's
+                # trace), and an unsampled request's context would
+                # no-op the span even when a sampled request shares the
+                # batch.
+                ctx = next((b[2] for b in batch
+                            if b[2] is not None and b[2].get("sampled")),
+                           None)
+                if ctx is not None:
+                    span = _tracing.get_tracer().start_span(
+                        "serve.batch", child_of=ctx,
+                        attrs={"batch_size": len(items)})
             try:
-                results = self._fn(items)
-                if asyncio.iscoroutine(results):
-                    results = await results
-                if len(results) != len(items):
-                    raise RuntimeError(
-                        f"@serve.batch function returned {len(results)} "
-                        f"results for a batch of {len(items)}")
+                with span:
+                    results = self._fn(items)
+                    if asyncio.iscoroutine(results):
+                        results = await results
+                    if len(results) != len(items):
+                        raise RuntimeError(
+                            f"@serve.batch function returned {len(results)} "
+                            f"results for a batch of {len(items)}")
                 for fut, res in zip(futures, results):
                     if not fut.done():
                         fut.set_result(res)

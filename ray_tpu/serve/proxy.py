@@ -23,6 +23,8 @@ import logging
 import threading
 from typing import Optional
 
+from ray_tpu.observability import tracing as _tracing
+
 logger = logging.getLogger(__name__)
 
 
@@ -89,6 +91,23 @@ class HTTPProxy:
         return self._port
 
     async def _handle(self, request):
+        # Root (or traceparent-continued) span for the whole HTTP
+        # request: this is where serve traces begin. W3C propagation in:
+        # clients set `traceparent`; the context then flows proxy ->
+        # router -> replica -> engine over RPC framing and task specs.
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "serve.http",
+                child_of=_tracing.parse_traceparent(
+                    request.headers.get("traceparent")),
+                attrs={"method": request.method, "path": request.path})
+        with span:
+            resp = await self._handle_inner(request)
+            span.set_attr("status", getattr(resp, "status", None))
+            return resp
+
+    async def _handle_inner(self, request):
         from aiohttp import web
 
         path = "/" + request.match_info["tail"]
@@ -308,6 +327,16 @@ class ReplicaDispatcher:
 
     async def dispatch(self, loop, deployment: str, method: str,
                        args: tuple):
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "serve.dispatch", attrs={"deployment": deployment})
+        with span:
+            return await self._dispatch_traced(loop, deployment, method,
+                                               args, span)
+
+    async def _dispatch_traced(self, loop, deployment: str, method: str,
+                               args: tuple, span):
         from ray_tpu.core import serialization
 
         version = self._router._version
@@ -322,8 +351,16 @@ class ReplicaDispatcher:
             for rid in list(self._light_clients):
                 if rid not in live:
                     self._light_clients.pop(rid, None)
-        choice = self._router.reserve(deployment)
+        route_span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            route_span = _tracing.get_tracer().start_span(
+                "serve.route", attrs={"deployment": deployment})
+        with route_span:
+            choice = self._router.reserve(deployment)
+            route_span.set_attr("replica",
+                                choice[0] if choice is not None else None)
         if choice is not None:
+            span.set_attr("lane", "light")
             replica_id, handle = choice
             # Slot ownership: exactly one of (this coroutine, the late
             # callback) releases. On timeout the REPLICA IS STILL RUNNING
@@ -384,6 +421,7 @@ class ReplicaDispatcher:
                     # non-idempotent work.
                     raise
                 _release_once()  # cb never registered: we still own it
+                span.set_attr("lane", "heavy")
                 return await self._dispatch_heavy(loop, deployment, method,
                                                   args)
             if env.get("_lost"):
@@ -400,12 +438,14 @@ class ReplicaDispatcher:
                 # safe to fall back to the heavy path, which queues and
                 # retries properly.
                 self._light_clients.pop(replica_id, None)
+                span.set_attr("lane", "heavy")
                 return await self._dispatch_heavy(loop, deployment, method,
                                                   args)
             data = serialization.loads(payload)
             if data.get("err") is not None:
                 raise serialization.deserialize_exception(data["err"])
             return serialization.deserialize(data["r"])
+        span.set_attr("lane", "heavy")
         return await self._dispatch_heavy(loop, deployment, method, args)
 
     async def _dispatch_heavy(self, loop, deployment: str, method: str,

@@ -17,6 +17,8 @@ import inspect
 import time
 from typing import Any, Dict
 
+from ray_tpu.observability import tracing as _tracing
+
 
 class Replica:
     """Generic wrapper actor; instantiated via ActorClass options with
@@ -69,6 +71,20 @@ class Replica:
         if self._draining:
             raise RuntimeError(
                 f"replica of {self._deployment} is draining")
+        # Replica-side span: the trace context arrived over the light
+        # lane's RPC framing or the heavy path's task spec.
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "serve.replica", attrs={"deployment": self._deployment,
+                                        "method": method_name,
+                                        "replica": self._replica_id})
+        with span:
+            return await self._handle_request_inner(method_name, args,
+                                                    kwargs)
+
+    async def _handle_request_inner(self, method_name: str, args,
+                                    kwargs) -> Any:
         self._ongoing += 1
         try:
             method = getattr(self._user, method_name)
@@ -131,7 +147,14 @@ class Replica:
         (serve.ingress) get a full ASGI scope; plain deployments get the
         decoded JSON payload, preserving the simple wire format."""
         if self._asgi_app is not None:
-            return await self._handle_asgi(request)
+            span = _tracing.NOOP_SPAN
+            if _tracing._ENABLED:
+                span = _tracing.get_tracer().start_span(
+                    "serve.replica", attrs={"deployment": self._deployment,
+                                            "method": "asgi",
+                                            "replica": self._replica_id})
+            with span:
+                return await self._handle_asgi(request)
         body = request.get("body") or b""
         if body:
             import json

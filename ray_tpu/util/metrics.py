@@ -22,14 +22,21 @@ class _Registry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, "Metric"] = {}
 
-    def register(self, metric: "Metric"):
+    def register(self, metric: "Metric") -> "Optional[Metric]":
+        """Register `metric`; if a same-name same-type metric already
+        exists, KEEP it and return it so the new instance adopts its
+        series — re-constructing a metric (e.g. a re-created deployment)
+        must not silently reset the accumulated time series."""
         with self._lock:
             existing = self._metrics.get(metric.name)
-            if existing is not None and type(existing) is not type(metric):
-                raise ValueError(
-                    f"metric {metric.name!r} already registered as "
-                    f"{type(existing).__name__}")
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{type(existing).__name__}")
+                return existing
             self._metrics[metric.name] = metric
+            return None
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -62,7 +69,15 @@ class Metric:
         self._default_tags: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._series: Dict[_TagKey, float] = {}
-        GLOBAL_REGISTRY.register(self)
+        existing = GLOBAL_REGISTRY.register(self)
+        if existing is not None:
+            self._adopt(existing)
+
+    def _adopt(self, existing: "Metric"):
+        """Share state with the registry's canonical instance: increments
+        on this (re-constructed) metric land in the existing series."""
+        self._lock = existing._lock
+        self._series = existing._series
 
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
@@ -114,6 +129,14 @@ class Histogram(Metric):
         # snapshot the registry the instant the metric appears in it.
         self._hist: Dict[_TagKey, dict] = {}
         super().__init__(name, description, tag_keys)
+
+    def _adopt(self, existing: "Metric"):
+        if getattr(existing, "boundaries", None) != self.boundaries:
+            raise ValueError(
+                f"histogram {self.name!r} already registered with "
+                f"boundaries {existing.boundaries}")
+        super()._adopt(existing)
+        self._hist = existing._hist
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = _tag_tuple(tags, self._default_tags)
@@ -197,10 +220,20 @@ def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
 
 
 class MetricsPusher:
-    def __init__(self, gcs_client, reporter_id: str, period_s: float = 2.0):
+    """Flushes this process's metric registry AND its tracing flight
+    recorder to the GCS on one cadence (one RPC carries both — the
+    tracing plane piggybacks here instead of adding its own thread).
+
+    `node` is the owning node's hex id when known: the GCS uses it to
+    expire this reporter's snapshot the moment the node dies, instead of
+    serving a ghost series from /metrics forever."""
+
+    def __init__(self, gcs_client, reporter_id: str, period_s: float = 2.0,
+                 node: "Optional[str]" = None):
         self._gcs = gcs_client
         self._id = reporter_id
         self._period = period_s
+        self._node = node
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="metrics-push", daemon=True)
@@ -213,15 +246,26 @@ class MetricsPusher:
             self.flush()
 
     def flush(self):
+        from ray_tpu.observability import tracing
+
+        spans, dropped = tracing.drain_for_flush()
         try:
             snap = GLOBAL_REGISTRY.snapshot()
-            if not snap:
+            if not snap and not spans and not dropped:
                 return
-            self._gcs.call("metrics_report",
-                           {"reporter": self._id, "metrics": snap,
-                            "ts": time.time()}, timeout=5)
+            payload = {"reporter": self._id, "metrics": snap,
+                       "ts": time.time(), "period_s": self._period,
+                       "node": self._node}
+            if spans or dropped:
+                payload["spans"] = spans
+                payload["spans_dropped"] = dropped
+            self._gcs.call("metrics_report", payload, timeout=5)
         except Exception:  # noqa: BLE001 — metrics are best-effort, and a
-            pass  # single bad snapshot must not kill the flusher thread
+            # single bad snapshot must not kill the flusher thread.
+            # Metrics re-snapshot next period, but the DRAINED spans
+            # would be gone: put them (and their drop count) back so a
+            # GCS hiccup delays trace delivery instead of losing it.
+            tracing.RECORDER.restore(spans, dropped)
 
     def stop(self):
         self._stop.set()

@@ -730,3 +730,94 @@ def test_package_clean():
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, (
         "raylint found regressions:\n" + proc.stdout + proc.stderr)
+
+
+# ------------------------------------------------------------------ RL008
+
+RL008_BAD_DISCARDED = """
+    def serve_request(tracer, payload):
+        tracer.start_span("serve.request")
+        return handle(payload)
+"""
+
+RL008_BAD_NO_FINALLY = """
+    def serve_request(tracer, payload):
+        span = tracer.start_span("serve.request")
+        result = handle(payload)
+        span.end()
+        return result
+"""
+
+RL008_GOOD_WITH = """
+    def serve_request(tracer, payload):
+        with tracer.start_span("serve.request") as span:
+            span.set_attr("size", len(payload))
+            return handle(payload)
+"""
+
+RL008_GOOD_FINALLY = """
+    def serve_request(tracer, payload):
+        span = tracer.start_span("serve.request")
+        try:
+            return handle(payload)
+        finally:
+            span.end()
+"""
+
+RL008_BAD_CHAINED = """
+    def serve_request(payload):
+        get_tracer().start_span("serve.request")
+        return handle(payload)
+"""
+
+RL008_GOOD_GUARDED_ASSIGN = """
+    def serve_request(payload):
+        span = NOOP_SPAN
+        if ENABLED:
+            span = get_tracer().start_span("serve.request")
+        with span:
+            return handle(payload)
+"""
+
+
+def test_rl008_flags_discarded_span(tmp_path):
+    findings = lint_src(tmp_path, RL008_BAD_DISCARDED, rules=["RL008"])
+    assert rule_ids(findings) == ["RL008"]
+
+
+def test_rl008_sees_chained_receiver_call_shape(tmp_path):
+    # `get_tracer().start_span(...)` has no dotted name (the receiver is
+    # itself a call) — the rule must match on the attribute shape, or
+    # the dominant production form would be invisible.
+    findings = lint_src(tmp_path, RL008_BAD_CHAINED, rules=["RL008"])
+    assert rule_ids(findings) == ["RL008"]
+
+
+def test_rl008_quiet_on_guarded_assign_then_with(tmp_path):
+    # The instrumentation idiom: NOOP default, conditional real span,
+    # one `with span:` entering whichever it is.
+    assert lint_src(tmp_path, RL008_GOOD_GUARDED_ASSIGN,
+                    rules=["RL008"]) == []
+
+
+def test_rl008_flags_end_outside_finally(tmp_path):
+    # A straight-line span.end() is skipped whenever handle() raises:
+    # the trace context never resets and the span never records.
+    findings = lint_src(tmp_path, RL008_BAD_NO_FINALLY, rules=["RL008"])
+    assert rule_ids(findings) == ["RL008"]
+
+
+def test_rl008_quiet_on_context_manager(tmp_path):
+    assert lint_src(tmp_path, RL008_GOOD_WITH, rules=["RL008"]) == []
+
+
+def test_rl008_quiet_on_finally_end(tmp_path):
+    assert lint_src(tmp_path, RL008_GOOD_FINALLY, rules=["RL008"]) == []
+
+
+def test_rl008_suppression_for_factories(tmp_path):
+    src = """
+    def make_span(tracer, name):
+        return tracer.start_span(name)  # raylint: disable=RL008
+    """
+    assert lint_src(tmp_path, src, rules=["RL008"]) == []
