@@ -929,6 +929,248 @@ def bench_collective(quick: bool) -> dict:
     return out
 
 
+async def _read_http_response(reader):
+    """Minimal keep-alive response read (headers + content-length body)
+    shared by both lean bench clients — one copy of the parsing."""
+    hdr = await reader.readuntil(b"\r\n\r\n")
+    clen = 0
+    for line in hdr.split(b"\r\n"):
+        if line[:15].lower() == b"content-length:":
+            clen = int(line[15:])
+    if clen:
+        await reader.readexactly(clen)
+
+
+def _lean_http_load(port: int, path: str, n: int, conns: int,
+                    body: bytes = b"7") -> float:
+    """Closed-loop HTTP load from a lean raw-socket keep-alive client
+    (one in-flight request per connection, minimal response parsing).
+    Returns requests/s. Deliberately not aiohttp: the client must cost
+    less than the server or the bench measures the client."""
+    import asyncio as _asyncio
+
+    req = ((f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+
+    async def run():
+        async def worker(count):
+            reader, writer = await _asyncio.open_connection("127.0.0.1",
+                                                            port)
+            try:
+                for _ in range(count):
+                    writer.write(req)
+                    await writer.drain()
+                    await _read_http_response(reader)
+            finally:
+                writer.close()
+        t0 = time.perf_counter()
+        await _asyncio.gather(*(worker(n // conns) for _ in range(conns)))
+        return (n // conns) * conns / (time.perf_counter() - t0)
+
+    return _asyncio.run(run())
+
+
+def _poisson_http_load(port: int, path: str, rate: float, duration_s: float,
+                       conns: int = 32, body: bytes = b"7") -> dict:
+    """Open-loop Poisson arrivals at `rate` req/s for `duration_s`:
+    arrivals do NOT wait for completions (the millions-of-users shape —
+    a slow server accumulates in-flight work instead of throttling the
+    offered load). Returns p50/p99 latency and the achieved rate."""
+    import asyncio as _asyncio
+    import random as _random
+
+    req = ((f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+
+    async def run():
+        pool: _asyncio.Queue = _asyncio.Queue()
+        for _ in range(conns):
+            pool.put_nowait(await _asyncio.open_connection("127.0.0.1",
+                                                           port))
+        lats, errors = [], 0
+
+        async def one():
+            # The pool slot ALWAYS goes back (a None marks a dead slot
+            # re-dialed lazily) — a reconnect failure escaping here would
+            # shrink the pool and crash the gather.
+            nonlocal errors
+            t0 = time.perf_counter()  # latency includes conn-pool wait
+            rw = await pool.get()
+            if rw is None:
+                try:
+                    rw = await _asyncio.open_connection("127.0.0.1", port)
+                except Exception:  # noqa: BLE001 — server still down
+                    errors += 1
+                    pool.put_nowait(None)
+                    return
+            reader, writer = rw
+            try:
+                writer.write(req)
+                await writer.drain()
+                await _read_http_response(reader)
+                lats.append(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — count and replace the conn
+                errors += 1
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    reader, writer = await _asyncio.open_connection(
+                        "127.0.0.1", port)
+                except Exception:  # noqa: BLE001 — re-dial next use
+                    pool.put_nowait(None)
+                    return
+            pool.put_nowait((reader, writer))
+
+        # Arrival times drawn up front, launched in due batches: a
+        # per-arrival asyncio.sleep() cannot tick faster than ~1k/s under
+        # load, which would silently throttle the offered rate.
+        rng = _random.Random(0)
+        arrivals, t = [], 0.0
+        while True:
+            t += rng.expovariate(rate)
+            if t >= duration_s:
+                break
+            arrivals.append(t)
+        tasks = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(arrivals):
+            now = time.perf_counter() - t0
+            while i < len(arrivals) and arrivals[i] <= now:
+                tasks.append(_asyncio.create_task(one()))
+                i += 1
+            if i < len(arrivals):
+                await _asyncio.sleep(
+                    max(0.0, arrivals[i] - (time.perf_counter() - t0)))
+        await _asyncio.gather(*tasks)
+        while not pool.empty():
+            _, writer = pool.get_nowait()
+            writer.close()
+        lats.sort()
+
+        def pct(p):
+            return lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3 \
+                if lats else None
+
+        return {"p50_ms": pct(0.50), "p99_ms": pct(0.99),
+                "achieved_rps": len(lats) / duration_s, "errors": errors}
+
+    return _asyncio.run(run())
+
+
+def bench_serve_fastpath(quick: bool) -> dict:
+    """Serve fast data plane (ISSUE 8): closed-loop proxy capacity,
+    Poisson open-loop latency, the zero-pickle/zero-leak proofs, and the
+    scale-to-zero cold-start round trip."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    out: dict = {}
+
+    # Normalization anchor: same-run trivial-task throughput (the sandbox
+    # is CPU-shares-throttled with high ambient variance — serve numbers
+    # are only comparable across rounds relative to this).
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    n_norm = 150 if quick else 400
+    ray_tpu.get([_noop.remote() for _ in range(32)])
+    t0 = time.perf_counter()
+    ray_tpu.get([_noop.remote() for _ in range(n_norm)])
+    out["serve_fastpath_tasks_per_s"] = round(
+        n_norm / (time.perf_counter() - t0), 1)
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=64)
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    serve.run(Echo.bind())
+    try:
+        port = serve.http_port()
+        proxy = ray_tpu.get_actor("SERVE_PROXY", namespace="serve")
+        c0 = ray_tpu.get(proxy.counters.remote())
+        _lean_http_load(port, "/Echo", 256, 16)  # warm
+        n = 1500 if quick else 6400
+        out["serve_proxy_rps"] = round(
+            _lean_http_load(port, "/Echo", n, 64), 1)
+        c1 = ray_tpu.get(proxy.counters.remote())
+        raw = c1["raw_requests"] - c0["raw_requests"]
+        frames = c1["raw_frames"] - c0["raw_frames"]
+        # Zero-copy proof: every request since c0 rode raw frames; none
+        # fell back to the pickle lanes.
+        out["serve_fastpath_pickle_free"] = bool(
+            raw >= n and c1["fallback_requests"] == c0["fallback_requests"])
+        out["serve_fastpath_reqs_per_frame"] = round(raw / max(frames, 1), 2)
+
+        # Open-loop Poisson at ~60% of measured capacity: the latency
+        # distribution under sustained arrivals.
+        rate = max(100.0, 0.6 * out["serve_proxy_rps"])
+        res = _poisson_http_load(port, "/Echo", rate,
+                                 4.0 if quick else 10.0)
+        out["serve_poisson_offered_rps"] = round(rate, 1)
+        out["serve_poisson_achieved_rps"] = round(res["achieved_rps"], 1)
+        out["serve_poisson_p50_ms"] = round(res["p50_ms"], 2) \
+            if res["p50_ms"] is not None else None
+        out["serve_poisson_p99_ms"] = round(res["p99_ms"], 2) \
+            if res["p99_ms"] is not None else None
+        out["serve_poisson_errors"] = res["errors"]
+    finally:
+        serve.delete("Echo")
+
+    # Scale-to-zero: deploys parked (0 replicas); the first request wakes
+    # the controller, cold-starts a replica through the forge, and is
+    # served from the proxy's park buffer.
+    @serve.deployment(
+        max_concurrent_queries=16,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=0, max_replicas=1, upscale_delay_s=0.1,
+            downscale_delay_s=1.0))
+    class ColdEcho:
+        def __call__(self, payload):
+            return payload
+
+    serve.run(ColdEcho.bind())
+    try:
+        port = serve.http_port()
+        st = serve.status().get("ColdEcho", {})
+        assert st.get("target") == 0 and not st.get("replicas"), \
+            f"scale-to-zero deployment did not park: {st}"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ColdEcho",
+            data=_json.dumps({"cold": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            resp.read()
+        out["serve_coldstart_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        st = serve.status().get("ColdEcho", {})
+        out["serve_coldstart_controller_ms"] = st.get("cold_start_ms")
+    finally:
+        serve.delete("ColdEcho")
+        serve.shutdown()
+
+    # Zero leaked raw buffers: the raw frame lane never touches the
+    # store, and nothing else on the serve path may leak unsealed
+    # segments either.
+    try:
+        out["serve_store_unsealed_after"] = \
+            ray_tpu._global_node.raylet.store.stats()["num_unsealed"]
+    except Exception:  # noqa: BLE001 — store introspection is best effort
+        pass
+    return out
+
+
 def bench_serve(quick: bool) -> dict:
     import concurrent.futures
     import json as _json
@@ -956,30 +1198,14 @@ def bench_serve(quick: bool) -> dict:
 
         port = serve.http_port()
 
-        n_http_echo = 100 if quick else 500
-        # Async client (keep-alive, one thread): measures the serving
-        # stack, not a thread-per-request client's own overhead.
-        import asyncio as _asyncio
-
-        async def echo_load(n: int) -> float:
-            import aiohttp
-
-            url = f"http://127.0.0.1:{port}/Echo"
-            sem = _asyncio.Semaphore(16)
-            async with aiohttp.ClientSession() as session:
-
-                async def one(i):
-                    async with sem:
-                        async with session.post(url, json=i) as resp:
-                            await resp.read()
-
-                await one(0)  # warm the route + connection pool
-                t0 = time.perf_counter()
-                await _asyncio.gather(*(one(i) for i in range(n)))
-                return time.perf_counter() - t0
-
-        out["serve_echo_http_rps"] = n_http_echo / _asyncio.run(
-            echo_load(n_http_echo))
+        n_http_echo = 500 if quick else 4000
+        # Lean keep-alive client (raw sockets, minimal parsing): measures
+        # the serving stack's capacity, not the client library's own CPU
+        # — an aiohttp client saturates its half of the sandbox's two
+        # cores around ~3.7k rps and would cap the number.
+        _lean_http_load(port, "/Echo", 128, 16)  # warm route + conns
+        out["serve_echo_http_rps"] = round(
+            _lean_http_load(port, "/Echo", n_http_echo, 64), 1)
 
         # Replica scale-up latency: redeploy at +N replicas and time until
         # every new replica is RUNNING. Each replica is an actor, so this
@@ -990,6 +1216,11 @@ def bench_serve(quick: bool) -> dict:
         serve.run(Echo.options(num_replicas=2 + scale_n).bind())
         out["serve_scaleup_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
         out["serve_scaleup_replicas"] = scale_n
+        # Soft regression flag vs the PR-5 forge numbers (~90-170ms spawn
+        # + promotion per replica): flag, don't fail — the sandbox's
+        # ambient variance is high.
+        out["serve_scaleup_regressed"] = \
+            out["serve_scaleup_ms"] / max(scale_n, 1) > 800.0
     finally:
         serve.delete("Echo")
 
@@ -1320,6 +1551,10 @@ def main(out=None):
             extra.update(bench_serve(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["serve_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_serve_fastpath(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["serve_fastpath_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_inference:
         try:
             extra.update(bench_inference(args.quick))

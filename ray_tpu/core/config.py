@@ -147,6 +147,24 @@ _flag("trace_buffer_spans", int, 4096,
 _flag("trace_gcs_max_spans", int, 50000,
       "GCS-side trace store capacity in spans (drop-oldest with a "
       "counter); bounds /api/timeline and /api/traces memory")
+_flag("serve_fastpath_enabled", _parse_bool, True,
+      "Serve fast data plane: proxies forward request/response bodies as "
+      "raw-bytes frames straight to the replica's direct RPC server (no "
+      "pickle round trip), coalescing concurrent requests to the same "
+      "replica into one multiplexed frame. Off = classic light/heavy lanes")
+_flag("serve_coalesce_max_requests", int, 64,
+      "Max requests packed into one serve fast-lane frame; requests "
+      "arriving in the same event-loop tick coalesce up to this count")
+_flag("serve_coalesce_max_bytes", int, 1 << 20,
+      "Max total body bytes per coalesced serve fast-lane frame; a "
+      "request pushing the pending batch past this flushes it first")
+_flag("serve_park_max_bytes", int, 8 << 20,
+      "Scale-to-zero buffer cap: total request-body bytes a proxy may "
+      "hold for a parked (0-replica) deployment while its replica "
+      "cold-starts; beyond this new requests fail fast instead of queuing")
+_flag("serve_park_timeout_s", float, 30.0,
+      "Scale-to-zero wait horizon: how long a buffered request waits for "
+      "a parked deployment's cold-started replica before failing")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")

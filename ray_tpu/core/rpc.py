@@ -535,6 +535,24 @@ class RpcClient:
         client. Without, the response is dropped (fire-and-forget). This is
         the submission fast path: N tasks cost N sends, not N round trips.
         """
+        self._async_send(method, serialization.dumps_ctrl(data), callback)
+
+    def call_raw_async(self, method: str, payload,
+                       callback: Callable[[dict, bytes], None]):
+        """Pipelined raw-bytes request against a `register_raw` handler:
+        `payload` (bytes or a list of buffer parts) travels verbatim — no
+        pickle framing on either side. Same callback contract as
+        call_async. This is the serve fast lane's transport: a coalesced
+        request frame costs one send, and the reply frame's bytes reach
+        the callback without an intermediate decode."""
+        self._async_send(method, payload, callback)
+
+    def _async_send(self, method: str, payload,
+                    callback: Optional[Callable[[dict, bytes], None]]):
+        """Shared pipelined-send core: pending-slot registration, the
+        closed-between-check-and-insert drain race, and the OSError
+        double-delivery guard live HERE once — both async entry points
+        differ only in payload framing."""
         if self._closed.is_set():
             raise ConnectionLost(
                 f"{self._name}: connection to {self.address} is closed")
@@ -551,7 +569,6 @@ class RpcClient:
                 if slot is not None:
                     callback({"e": "connection lost", "_lost": True}, b"")
                 return
-        payload = serialization.dumps_ctrl(data)
         env = {"i": msg_id, "k": "req", "m": method}
         if _tracing._ENABLED:
             t = _tracing.wire_ctx()
@@ -565,8 +582,9 @@ class RpcClient:
                 slot = self._pending.pop(msg_id, None)
             if callback is not None and slot is None:
                 # The reader's drain already delivered the loss to the
-                # callback; raising here would make ReconnectingClient
-                # resend with the same callback and fire it twice.
+                # callback; raising here would make the caller (e.g.
+                # ReconnectingClient) resend with the same callback and
+                # fire it twice.
                 return
             raise ConnectionLost(str(e))
 

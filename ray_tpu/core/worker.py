@@ -56,6 +56,9 @@ class WorkerRuntime(CoreRuntime):
         self.direct_server.register("actor_call", self._handle_actor_call)
         self.direct_server.register("actor_call_light",
                                     self._handle_actor_call_light)
+        self.direct_server.register_raw("serve_raw", self._handle_serve_raw)
+        self.direct_server.register_raw("serve_stream",
+                                        self._handle_serve_stream)
         self.direct_server.register("direct_call", self._handle_direct_call)
         self.direct_server.register("direct_call_batch",
                                     self._handle_direct_call_batch)
@@ -483,6 +486,63 @@ class WorkerRuntime(CoreRuntime):
                     reply_err(e)
             self._actor_executor.submit(run)
         return DEFERRED
+
+    def _dispatch_serve_raw(self, conn: Connection, payload: bytes,
+                            method: str, hook_name: str):
+        """Shared core of the serve fast-lane raw handlers: hand the raw
+        frame to the actor instance's dispatch hook on its asyncio loop
+        and reply with the raw parts it returns.
+
+        Reply discipline (raylint RL001): pre-schedule failures raise
+        BEFORE the DEFERRED return — the server loop converts them to an
+        error reply (the fast lane reads that as provably-not-executed
+        and falls back). Once the coroutine is scheduled, IT owns the
+        reply: every exit path of `run` replies, errors included (an
+        error frame, not a transport error — user-code failures ride
+        inside the frame so one bad request cannot poison a coalesced
+        batch)."""
+        from ray_tpu.serve import dataplane
+
+        mid = conn.current_msg_id
+        inst = self.actor_instance
+        loop = self._async_loop
+        hook = getattr(inst, hook_name, None) if inst is not None else None
+        if hook is None or loop is None:
+            raise RuntimeError(
+                f"actor is not a serve replica (no {hook_name})")
+        view = memoryview(payload)
+
+        async def run():
+            try:
+                parts = await hook(view)
+            except BaseException as e:  # noqa: BLE001 — delivered as error frame
+                try:
+                    conn.reply_raw(mid, method,
+                                   dataplane.encode_error_frame(e))
+                except Exception:  # noqa: BLE001 — caller gone; its client
+                    pass           # delivers the loss
+                return
+            try:
+                conn.reply_raw(mid, method, parts)
+            except Exception:  # noqa: BLE001 — caller gone mid-reply
+                pass
+
+        asyncio.run_coroutine_threadsafe(run(), loop)
+        return DEFERRED
+
+    def _handle_serve_raw(self, conn: Connection, payload: bytes):
+        """Serve fast-lane request frame: raw bytes end to end (no pickle
+        of request/response bodies). The frame carries 1..N coalesced
+        requests; the replica's dispatch hook answers them all in one
+        reply frame."""
+        return self._dispatch_serve_raw(conn, payload, "serve_raw",
+                                        "__serve_raw_dispatch__")
+
+    def _handle_serve_stream(self, conn: Connection, payload: bytes):
+        """Serve fast-lane stream pull: drains a replica-side stream
+        queue as raw chunk frames (the token-stream consumer path)."""
+        return self._dispatch_serve_raw(conn, payload, "serve_stream",
+                                        "__serve_stream_raw__")
 
     def _try_cancel_actor_call(self, tid: bytes, fut, caller_conn: Connection,
                                spec: TaskSpec) -> bool:

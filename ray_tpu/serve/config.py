@@ -27,7 +27,7 @@ class AutoscalingConfig:
     `serve/_private/autoscaling_policy.py:127`).
     """
 
-    min_replicas: int = 1
+    min_replicas: int = 1  # 0 enables scale-to-zero (deploys parked)
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
@@ -55,7 +55,12 @@ class DeploymentConfig:
 
     def initial_replicas(self) -> int:
         if self.autoscaling is not None:
-            return max(self.autoscaling.min_replicas, 1)
+            # min_replicas=0 deploys PARKED: the route exists with zero
+            # replicas and the first request cold-starts one through the
+            # controller's wake path (scale-to-zero).
+            if self.autoscaling.min_replicas <= 0:
+                return 0
+            return self.autoscaling.min_replicas
         return self.num_replicas
 
 

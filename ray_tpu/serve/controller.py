@@ -57,6 +57,9 @@ class _ReplicaInfo:
         self.started_at = time.time()
         # Last user_config version pushed to this replica (0 = never).
         self.user_config_version = 0
+        # Placement, reported by the replica's ping: published in the
+        # routing table so routers can prefer co-located replicas.
+        self.node_hex = ""
 
 
 class _DeploymentInfo:
@@ -79,6 +82,12 @@ class _DeploymentInfo:
         self.pressure_since: Optional[float] = None
         self.idle_since: Optional[float] = None
         self.last_health_check = 0.0
+        # Scale-to-zero: when the last router wake arrived (downscale
+        # hysteresis), when the in-flight cold start began, and the last
+        # measured cold-start latency (wake -> first RUNNING replica).
+        self.last_wake_at = 0.0
+        self.cold_start_t0: Optional[float] = None
+        self.last_cold_start_ms: Optional[float] = None
 
 
 class ServeController:
@@ -356,12 +365,39 @@ class ServeController:
                 running = sum(1 for r in info.replicas
                               if r.state == REPLICA_RUNNING)
                 # Autoscaled deployments are ready at one replica; fixed
-                # deployments wait for the full target.
-                need = 1 if info.config.autoscaling else info.target
+                # deployments wait for the full target; scale-to-zero
+                # (min_replicas=0) deployments deploy parked — ready with
+                # zero replicas, the first request cold-starts one.
+                auto = info.config.autoscaling
+                if auto is not None:
+                    need = 0 if auto.min_replicas == 0 else 1
+                else:
+                    need = info.target
                 if running >= need:
                     return True
             await asyncio.sleep(0.05)
         return False
+
+    async def wake_deployment(self, name: str) -> bool:
+        """Scale-to-zero wake: a router saw a request for a parked
+        deployment. Spawns the first replica IMMEDIATELY (not on the next
+        reconcile tick — every tick is ~100ms of cold-start budget) and
+        arms the downscale hysteresis so the autoscaler cannot re-park
+        the deployment before the buffered request lands."""
+        info = self._deployments.get(name)
+        if info is None:
+            return False
+        info.last_wake_at = time.time()
+        info.idle_since = None
+        if info.target < 1:
+            info.target = 1
+        if not info.replicas:
+            if info.cold_start_t0 is None:
+                info.cold_start_t0 = time.time()
+            logger.info("serve: waking %s (scale-to-zero cold start)", name)
+            info.replicas.append(self._start_replica(name, info))
+            self._checkpoint()
+        return True
 
     async def get_routing_table(self) -> tuple:
         return self._version, self._routing_table
@@ -393,6 +429,7 @@ class ServeController:
                 "replicas": {
                     r.replica_id: r.state for r in info.replicas},
                 "ongoing": sum(r.last_ongoing for r in info.replicas),
+                "cold_start_ms": info.last_cold_start_ms,
             }
         return out
 
@@ -521,15 +558,18 @@ class ServeController:
     async def _reconcile_once(self) -> None:
         loop = asyncio.get_running_loop()
         changed = False
+        depths_moved = False
         for name, info in list(self._deployments.items()):
             # 1. Promote STARTING replicas that answer ping; cull ones that
             # died in __init__ (ping resolves to an actor error) or never
             # came up within the startup timeout.
             for rep in [r for r in info.replicas
                         if r.state == REPLICA_STARTING]:
-                state = await loop.run_in_executor(
+                state, node = await loop.run_in_executor(
                     None, functools.partial(_try_ping, rep.handle, 0.05))
                 if state == "ok":
+                    if node:
+                        rep.node_hex = node
                     # Deliver the current user_config BEFORE the replica
                     # becomes routable: a request must never reach user
                     # code whose reconfigure(weights) hasn't run. A failed
@@ -543,6 +583,13 @@ class ServeController:
                             loop, info, rep):
                         rep.state = REPLICA_RUNNING
                         changed = True
+                        if info.cold_start_t0 is not None:
+                            info.last_cold_start_ms = round(
+                                (time.time() - info.cold_start_t0) * 1e3, 1)
+                            info.cold_start_t0 = None
+                            logger.info(
+                                "serve: %s cold start served in %.0fms",
+                                name, info.last_cold_start_ms)
                 if rep.state == REPLICA_STARTING and (
                         state == "dead"
                         or time.time() - rep.started_at
@@ -604,9 +651,14 @@ class ServeController:
                             except (TypeError, ValueError):
                                 return 0
 
-                        rep.last_ongoing = max(
+                        new_load = max(
                             st.get("ongoing", 0),
                             _n("queue_depth") + _n("running"))
+                        if new_load != rep.last_ongoing:
+                            depths_moved = True
+                        rep.last_ongoing = new_load
+                        if st.get("node"):
+                            rep.node_hex = st["node"]
                 for rep in dead:
                     logger.warning("serve: replica %s of %s failed health "
                                    "check — replacing", rep.replica_id, name)
@@ -639,6 +691,11 @@ class ServeController:
         if changed:
             self._rebuild_routing_table()
             self._checkpoint()  # replica set moved: keep recovery current
+        elif depths_moved:
+            # Queue depths piggyback on the routing-table push (routers
+            # never poll per-request): membership is unchanged so no
+            # checkpoint, just a version bump at the health-check cadence.
+            self._rebuild_routing_table()
 
     async def _ensure_user_config_ref(self, loop, info: _DeploymentInfo):
         """Put the payload ONCE per version, serially — concurrent
@@ -674,6 +731,8 @@ class ServeController:
         cfg = info.config.autoscaling
         running = [r for r in info.replicas if r.state == REPLICA_RUNNING]
         if not running:
+            # Parked (scale-to-zero) or mid cold start: wake_deployment
+            # owns upscale from zero; there is no load signal to act on.
             return info.target
         total_ongoing = sum(r.last_ongoing for r in running)
         desired = math.ceil(total_ongoing / cfg.target_ongoing_requests) \
@@ -692,6 +751,12 @@ class ServeController:
             if info.idle_since is None:
                 info.idle_since = now
             if now - info.idle_since >= cfg.downscale_delay_s:
+                if desired == 0 and now - info.last_wake_at < max(
+                        cfg.downscale_delay_s, 1.0):
+                    # Wake hysteresis: a cold start is (or just was) in
+                    # flight — parking now would strand the request that
+                    # triggered it in a wake/park livelock.
+                    return info.target
                 info.idle_since = None
                 return desired
         else:
@@ -736,11 +801,21 @@ class ServeController:
             running = [r for r in info.replicas
                        if r.state == REPLICA_RUNNING]
             prefix = info.config.route_prefix or f"/{name}"
+            auto = info.config.autoscaling
             table[name] = {
                 "replicas": [(r.replica_id, r.handle) for r in running],
                 "max_concurrent_queries":
                     info.config.max_concurrent_queries,
                 "route_prefix": prefix,
+                # Placement + depth piggyback for the routers' locality /
+                # power-of-two-choices pick (pushed, never polled).
+                "nodes": {r.replica_id: r.node_hex for r in running
+                          if r.node_hex},
+                "depths": {r.replica_id: r.last_ongoing for r in running},
+                # Scale-to-zero marker: an empty replica list means "wake
+                # me", not "unknown deployment".
+                "parked": bool(auto is not None and auto.min_replicas == 0
+                               and not running),
             }
         self._routing_table = table
         self._bump()
@@ -767,20 +842,22 @@ def _try_proxy_port(handle) -> Optional[int]:
         return None
 
 
-def _try_ping(handle, timeout_s: float) -> str:
-    """Returns "ok" | "pending" | "dead" — a resolved-but-errored ping is a
-    dead replica, not a slow one."""
+def _try_ping(handle, timeout_s: float) -> tuple:
+    """Returns ("ok" | "pending" | "dead", node_hex) — a resolved-but-
+    errored ping is a dead replica, not a slow one. The node id rides the
+    ping so placement reaches the routing table with no extra RPC."""
     import ray_tpu
 
     try:
         ref = handle.ping.remote()
         ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout_s)
         if not ready:
-            return "pending"
-        ray_tpu.get(ready[0])
-        return "ok"
+            return "pending", ""
+        out = ray_tpu.get(ready[0])
+        node = out.get("node", "") if isinstance(out, dict) else ""
+        return "ok", node
     except Exception:  # noqa: BLE001
-        return "dead"
+        return "dead", ""
 
 
 def _gather_stats(replicas) -> list:

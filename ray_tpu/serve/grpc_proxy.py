@@ -152,12 +152,42 @@ class GrpcProxy:
                         grpc.StatusCode.NOT_FOUND,
                         f"no deployment named {deployment!r}")
                 await asyncio.sleep(0.1)
+        loop = asyncio.get_running_loop()
+        # Fast data plane first — the SAME dispatch path as the HTTP
+        # proxy (ReplicaDispatcher.fastlane), so the two ingresses cannot
+        # drift: request bytes ride a raw frame, the replica decodes
+        # msgpack/opaque bodies and encodes the reply symmetrically.
+        from ray_tpu.serve import dataplane
+
+        try:
+            out = await self._dispatcher.dispatch_call(loop, deployment,
+                                                       bytes(request))
+        except dataplane.ParkBufferFull as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except (asyncio.TimeoutError, TimeoutError):
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                "request timed out")
+        except ConnectionError as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        if out is not None:
+            entry, body = out
+            sid = entry.get("stream")
+            if sid:
+                # Release the replica-side pump/queue NOW, not at the
+                # 120s idle reap.
+                self._dispatcher.fastlane.stream_cancel(loop, deployment,
+                                                        sid)
+            if entry.get("err"):
+                code = grpc.StatusCode.UNIMPLEMENTED \
+                    if entry.get("code") == 501 else grpc.StatusCode.INTERNAL
+                await context.abort(code, entry["err"])
+            return bytes(body)
+        dataplane.COUNTERS["fallback_requests"] += 1
         try:
             payload = msgpack.unpackb(bytes(request), raw=False,
                                       strict_map_key=False)
         except Exception:  # noqa: BLE001 — opaque bytes pass through
             payload = bytes(request)
-        loop = asyncio.get_running_loop()
         try:
             result = await self._dispatcher.dispatch(
                 loop, deployment, "__call__", (payload,))
@@ -200,6 +230,13 @@ class GrpcProxy:
                 grpc.StatusCode.INTERNAL,
                 f"result of type {type(result).__name__} is not "
                 f"msgpack-serializable: {e}")
+
+    async def counters(self) -> dict:
+        """This proxy process's fast-path counters (shared-path test
+        support: proves gRPC rides the same raw dispatch as HTTP)."""
+        from ray_tpu.serve import dataplane
+
+        return dataplane.counters_snapshot()
 
     async def stop(self):
         if self._router is not None:

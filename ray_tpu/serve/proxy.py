@@ -11,8 +11,14 @@ max_concurrent_queries admission control, not the proxy.
 
 Wire format: request body is JSON (or raw text) → the deployment callable
 receives the decoded payload; dict/list/str/number results come back as
-JSON. Matches what a JAX text-generation replica needs without dragging in
-an ASGI framework.
+JSON (bytes results stream back raw). Matches what a JAX text-generation
+replica needs without dragging in an ASGI framework.
+
+Request path (fast data plane, serve/dataplane.py): bodies ride raw-bytes
+frames to the replica's direct RPC server — coalesced per event-loop tick,
+no pickle, replies carry final response bytes — with the classic light
+(pickled RPC) and heavy (actor task) lanes as fallback. docs/
+SERVE_DATAPLANE.md has the wire contract.
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ import threading
 from typing import Optional
 
 from ray_tpu.observability import tracing as _tracing
+from ray_tpu.serve import dataplane
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +42,7 @@ class HTTPProxy:
         self._runner = None
         self._router = None
         self._ready_lock = None
+        self._route_cache = None  # (table version, [(prefix, name, entry)])
 
     async def ready(self) -> int:
         """Start the server; returns the bound port. Serialized: two
@@ -95,13 +103,15 @@ class HTTPProxy:
         # request: this is where serve traces begin. W3C propagation in:
         # clients set `traceparent`; the context then flows proxy ->
         # router -> replica -> engine over RPC framing and task specs.
-        span = _tracing.NOOP_SPAN
-        if _tracing._ENABLED:
-            span = _tracing.get_tracer().start_span(
-                "serve.http",
-                child_of=_tracing.parse_traceparent(
-                    request.headers.get("traceparent")),
-                attrs={"method": request.method, "path": request.path})
+        # Disabled tracing skips even the no-op span plumbing — this is
+        # the per-request hot path.
+        if not _tracing._ENABLED:
+            return await self._handle_inner(request)
+        span = _tracing.get_tracer().start_span(
+            "serve.http",
+            child_of=_tracing.parse_traceparent(
+                request.headers.get("traceparent")),
+            attrs={"method": request.method, "path": request.path})
         with span:
             resp = await self._handle_inner(request)
             span.set_attr("status", getattr(resp, "status", None))
@@ -111,13 +121,58 @@ class HTTPProxy:
         from aiohttp import web
 
         path = "/" + request.match_info["tail"]
-        deployment = self._match(path)
-        if deployment is None:
+        match = self._match_route(path)
+        if match is None:
             return web.json_response(
                 {"error": f"no deployment for path {path!r}"}, status=404)
-        entry = self._table_entry(deployment)
-        prefix = (entry or {}).get("route_prefix", "/") or "/"
+        deployment, entry = match
+        prefix = entry.get("route_prefix", "/") or "/"
         body = await request.read() if request.can_read_body else b""
+        dispatch_version = self._router._version
+        cached = self._asgi_deployments.get(deployment)
+        # Full header set only when the deployment might be ASGI — plain
+        # JSON deployments never read them, and encoding ~20 tuples per
+        # request is measurable at high rps. Learned from the first
+        # response's shape, invalidated on routing-table changes (a
+        # redeploy can change the type). Names lowercase per the ASGI
+        # spec (apps look up b"content-type", not the client's casing).
+        want_headers = (cached is None or cached[0] != dispatch_version
+                        or cached[1])
+        loop = asyncio.get_running_loop()
+
+        # Fast data plane: the request body rides a raw-bytes frame to
+        # the replica's direct server (coalesced with its same-tick
+        # neighbours) and the replica answers with final response bytes —
+        # no pickle of bodies anywhere. None = fall back to the classic
+        # pickle lanes (fast path disabled / saturated / transport says
+        # the classic lane is safer).
+        req_entry = {"k": "http", "m": request.method,
+                     "p": self._strip_prefix(path, prefix),
+                     "rp": prefix.rstrip("/"),
+                     "q": request.query_string.encode("latin-1"),
+                     "c": request.remote or "127.0.0.1"}
+        if want_headers:
+            req_entry["h"] = [(k.lower(), v)
+                              for k, v in request.headers.items()]
+        try:
+            out = await self._dispatcher.dispatch_raw_http(
+                loop, deployment, req_entry, body)
+        except dataplane.ParkBufferFull as e:
+            return web.json_response({"error": str(e)}, status=503)
+        except (asyncio.TimeoutError, TimeoutError):
+            return web.json_response(
+                {"error": "request timed out"}, status=504)
+        except ConnectionError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        except Exception as e:  # noqa: BLE001 — framing/transport bug → 500
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=500)
+        if out is not None:
+            resp_entry, resp_body = out
+            return await self._respond_fast(request, deployment, resp_entry,
+                                            resp_body, dispatch_version)
+
+        dataplane.COUNTERS["fallback_requests"] += 1
         http_req = {
             "method": request.method,
             # ASGI path is relative to the deployment's mount point
@@ -129,20 +184,10 @@ class HTTPProxy:
             "client": (request.remote or "127.0.0.1", 0),
             "body": body,
         }
-        dispatch_version = self._router._version
-        cached = self._asgi_deployments.get(deployment)
-        if cached is None or cached[0] != dispatch_version or cached[1]:
-            # Full header set only when the deployment might be ASGI —
-            # plain JSON deployments never read them, and encoding ~20
-            # tuples per request is measurable at high rps. Learned from
-            # the first response's shape (see _respond), invalidated on
-            # routing-table changes (a redeploy can change the type).
-            # Names lowercase per the ASGI spec (apps look up
-            # b"content-type", not the client's casing).
+        if want_headers:
             http_req["headers"] = [
                 (k.lower().encode("latin-1"), v.encode("latin-1"))
                 for k, v in request.headers.items()]
-        loop = asyncio.get_running_loop()
         try:
             result = await self._dispatch(loop, deployment, http_req)
         except asyncio.TimeoutError:
@@ -165,9 +210,105 @@ class HTTPProxy:
             return rest or "/"
         return path
 
+    def _match_route(self, path: str) -> Optional[tuple]:
+        """Longest-prefix route match against a per-version cache of the
+        routing table — the per-request lock + table copy the old _match
+        paid was measurable at fast-path rates (entries are immutable
+        once published: the router swaps whole tables per version)."""
+        version = self._router._version
+        cache = self._route_cache
+        if cache is None or cache[0] != version:
+            with self._router._lock:
+                routes = [(entry["route_prefix"], name, entry)
+                          for name, entry in self._router._table.items()]
+            cache = (version, routes)
+            self._route_cache = cache
+        best, best_len = None, -1
+        for prefix, name, entry in cache[1]:
+            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
+                    or (prefix == "/" and path.startswith("/"))):
+                if len(prefix) > best_len:
+                    best, best_len = (name, entry), len(prefix)
+        return best
+
     def _table_entry(self, deployment: str) -> Optional[dict]:
         with self._router._lock:
             return self._router._table.get(deployment)
+
+    async def _respond_fast(self, request, deployment: str, entry: dict,
+                            body, dispatch_version: int):
+        """Write a fast-lane response: the replica already produced the
+        final body bytes, status and content type — the proxy only frames
+        HTTP. Streamed responses relay raw chunk frames."""
+        from aiohttp import web
+        from multidict import CIMultiDict
+
+        if entry.get("err"):
+            # No ASGI-ness cache update from error entries: they carry no
+            # 'a' flag, and caching False here would strip headers from
+            # every later request to an ASGI deployment.
+            return web.json_response({"error": entry["err"]},
+                                     status=int(entry.get("code") or 500))
+        self._asgi_deployments[deployment] = (dispatch_version,
+                                              bool(entry.get("a")))
+        status = int(entry.get("status") or 200)
+        if entry.get("hdr") is not None:
+            # Multidict: repeated headers (Set-Cookie) must all survive.
+            headers = CIMultiDict((k, v) for k, v in entry.get("hdr") or [])
+        else:
+            headers = CIMultiDict(
+                {"Content-Type":
+                 entry.get("ct") or "application/octet-stream"})
+        sid = entry.get("stream")
+        if sid is None:
+            return web.Response(status=status, headers=headers,
+                                body=bytes(body))
+        # Streamed tail: chunked framing owns the length.
+        headers.popall("Content-Length", None)
+        headers.popall("Transfer-Encoding", None)
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        if len(body):
+            await resp.write(bytes(body))
+        ok = await self._relay_stream_fast(deployment, sid, resp.write)
+        if not ok:
+            # Truncated (generator error / replica gone): abort the
+            # connection so the client can't mistake a partial body for a
+            # complete 200.
+            if request.transport is not None:
+                request.transport.close()
+            return resp
+        await resp.write_eof()
+        return resp
+
+    async def _relay_stream_fast(self, deployment: str, sid: str,
+                                 write) -> bool:
+        """Drain a replica-side stream as raw chunk frames (the PR-3
+        token stream rides this). Returns False on truncation."""
+        loop = asyncio.get_running_loop()
+        lane = self._dispatcher.fastlane
+        try:
+            while True:
+                out = await lane.stream_pull(loop, deployment, sid)
+                if out is None:
+                    logger.warning("stream %s: replica unreachable "
+                                   "(truncated)", sid)
+                    return False
+                meta, chunks = out
+                for c in chunks:
+                    await write(bytes(c))
+                if meta.get("err"):
+                    logger.warning("stream %s failed: %s", sid, meta["err"])
+                    return False
+                if meta.get("done"):
+                    return True
+        except BaseException:
+            # Client disconnect (write failed) or handler cancellation:
+            # release the replica-side pump/queue NOW instead of letting
+            # the generator idle against a full queue until the 120s reap.
+            lane.stream_cancel(loop, deployment, sid)
+            raise
 
     async def _respond(self, request, deployment: str, result,
                        dispatch_version: int):
@@ -236,6 +377,11 @@ class HTTPProxy:
         if isinstance(result, (dict, list, int, float, bool)) \
                 or result is None:
             return web.json_response({"result": result})
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            # Lane parity: the fast lane returns bytes results raw; a
+            # request that fell back here must not get the str() repr.
+            return web.Response(body=bytes(result),
+                                content_type="application/octet-stream")
         return web.Response(text=str(result))
 
     async def _relay_stream(self, deployment: str, sid: str, write) -> bool:
@@ -269,16 +415,13 @@ class HTTPProxy:
             raise
 
     def _match(self, path: str) -> Optional[str]:
-        with self._router._lock:
-            table = dict(self._router._table)
-        best, best_len = None, -1
-        for name, entry in table.items():
-            prefix = entry["route_prefix"]
-            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
-                    or (prefix == "/" and path.startswith("/"))):
-                if len(prefix) > best_len:
-                    best, best_len = name, len(prefix)
-        return best
+        match = self._match_route(path)
+        return match[0] if match is not None else None
+
+    async def counters(self) -> dict:
+        """This proxy process's fast-path counters (the zero-pickle
+        acceptance proof reads these)."""
+        return dataplane.counters_snapshot()
 
     async def stop(self):
         if self._router is not None:
@@ -290,13 +433,15 @@ class HTTPProxy:
 
 class ReplicaDispatcher:
     """Routes one call to a replica of a deployment; shared by the HTTP
-    and gRPC proxies. Light lane first: admission via router.reserve(),
-    then `actor_call_light` on the replica's direct server — the result
-    rides the RPC response, skipping the whole actor-task path (TaskSpec
-    + ObjectRef + reply push), worth ~2x on trivial payloads. Any
-    light-lane transport problem (replica restarting, stale connection,
-    saturation) falls back to the full actor-call path, which owns
-    retries and backpressure.
+    and gRPC proxies so the two ingresses cannot drift. Lanes, fastest
+    first: (1) the raw fast lane (`self.fastlane`, serve/dataplane.py)
+    — zero-pickle coalesced frames on the replica's direct server; (2)
+    the light lane below: admission via router.reserve(), then
+    `actor_call_light` — pickled args, result rides the RPC response,
+    skipping the actor-task path (TaskSpec + ObjectRef + reply push);
+    (3) the full actor-call path, which owns retries and backpressure.
+    Any light-lane transport problem (replica restarting, stale
+    connection, saturation) falls through to the heavy lane.
 
     `method` follows the router convention: the "__serve_http__" sentinel
     targets the replica's HTTP entry point; anything else is a user
@@ -305,11 +450,27 @@ class ReplicaDispatcher:
     def __init__(self, router, runtime):
         self._router = router
         self._runtime = runtime
+        # Raw fast lane: coalesced zero-pickle frames (serve/dataplane.py)
+        # shared by the HTTP and gRPC ingresses so they cannot drift.
+        self.fastlane = dataplane.FastLane(router, runtime)
         # replica_id -> RpcClient for the light request/response lane
         # (invalidated on any transport error; pruned against the routing
         # table when its version changes).
         self._light_clients: dict = {}
         self._light_version = -2  # != router's initial -1: prune on first use
+
+    async def dispatch_raw_http(self, loop, deployment: str,
+                                entry: dict, body):
+        """HTTP request over the raw fast lane; None = use the classic
+        lanes (the caller owns the fallback and its counter)."""
+        return await self.fastlane.dispatch(loop, deployment, entry, body)
+
+    async def dispatch_call(self, loop, deployment: str, body: bytes):
+        """Unary call (gRPC ingress parity) over the raw fast lane: the
+        request bytes pass through untouched; the replica decodes
+        msgpack-decodable bodies and encodes the result symmetrically."""
+        return await self.fastlane.dispatch(
+            loop, deployment, {"k": "call", "m": "__call__"}, body)
 
     @staticmethod
     def _light_call(method: str, args: tuple) -> dict:

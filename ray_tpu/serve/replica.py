@@ -13,11 +13,14 @@ accelerator env before the process initializes JAX.
 from __future__ import annotations
 
 import asyncio
+import functools
 import inspect
+import json
 import time
-from typing import Any, Dict
+from typing import Any, Dict, List, Tuple
 
 from ray_tpu.observability import tracing as _tracing
+from ray_tpu.serve import dataplane
 
 
 class Replica:
@@ -36,6 +39,11 @@ class Replica:
         self._errored = 0
         self._started_at = time.time()
         self._draining = False
+        # Fast-lane method resolution cache: name -> (bound method,
+        # needs_await). The user class is fixed for the replica's
+        # lifetime, so iscoroutinefunction/batched checks run once per
+        # method instead of per request.
+        self._raw_methods: Dict[str, tuple] = {}
         # Streamed responses in flight: id -> [queue, pump_task, last_use]
         # (events: ("chunk", item) | ("end", None) | ("error", str)).
         # Reaped after STREAM_IDLE_S without a pull — an HTTP client that
@@ -155,21 +163,26 @@ class Replica:
                                             "replica": self._replica_id})
             with span:
                 return await self._handle_asgi(request)
-        body = request.get("body") or b""
-        if body:
-            import json
-
-            try:
-                payload = json.loads(body)
-            except json.JSONDecodeError:
-                payload = body.decode("utf-8", "replace")
-        else:
-            from urllib.parse import parse_qsl
-
-            qs = dict(parse_qsl(
-                (request.get("query_string") or b"").decode("latin-1")))
-            payload = qs or None
+        payload = self._decode_http_payload(
+            request.get("body") or b"",
+            request.get("query_string") or b"")
         return await self.handle_request("__call__", (payload,), {})
+
+    @staticmethod
+    def _decode_http_payload(body: bytes, query_string: bytes):
+        """HTTP body -> deployment payload, shared by the classic and
+        raw lanes so their decode semantics cannot drift: JSON body if
+        it parses, raw text otherwise, query-string dict (or None) for
+        body-less requests."""
+        if body:
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError:
+                return body.decode("utf-8", "replace")
+        from urllib.parse import parse_qsl
+
+        qs = dict(parse_qsl(bytes(query_string).decode("latin-1")))
+        return qs or None
 
     async def _handle_asgi(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """Run the ASGI app; buffered responses return whole, streamed
@@ -255,6 +268,276 @@ class Replica:
                         "headers": [("content-type", "text/plain")],
                         "body": item.encode()}
 
+    # ------------------------------------------------------ raw fast lane
+
+    async def __serve_raw_dispatch__(self, frame: memoryview) -> list:
+        """Serve fast-lane entry point (the worker's `serve_raw` raw
+        handler): decode one coalesced request frame, answer every
+        request, encode one reply frame. Bodies are raw bytes end to end
+        — request payloads and response bodies never touch pickle, and a
+        frame of N requests costs one replica wakeup (sync callables
+        additionally share a single executor hop)."""
+        meta, region = dataplane.decode_frame(frame)
+        reqs = meta.get("reqs") or []
+        bodies = dataplane.slice_bodies(region,
+                                        [r.get("n", 0) for r in reqs])
+        dataplane.COUNTERS["raw_dispatch_frames"] += 1
+        dataplane.COUNTERS["raw_dispatch_requests"] += len(reqs)
+        span = _tracing.NOOP_SPAN
+        if _tracing._ENABLED:
+            span = _tracing.get_tracer().start_span(
+                "serve.replica", attrs={"deployment": self._deployment,
+                                        "replica": self._replica_id,
+                                        "raw": True,
+                                        "frame_size": len(reqs)})
+        with span:
+            results = await self._raw_dispatch_all(reqs, bodies)
+        entries: List[Dict[str, Any]] = []
+        out_bodies: List[Any] = []
+        for entry, body in results:
+            entry["n"] = len(body)
+            entries.append(entry)
+            if entry["n"]:
+                out_bodies.append(body)
+        return dataplane.encode_frame({"v": 1, "resps": entries},
+                                      out_bodies)
+
+    async def _raw_dispatch_all(self, reqs, bodies
+                                ) -> List[Tuple[Dict[str, Any], bytes]]:
+        if self._draining:
+            # Provably not executed: the proxy may safely re-route these
+            # to another replica (retriable).
+            return [({"err": f"replica of {self._deployment} is draining",
+                      "code": 503, "retriable": True}, b"")
+                    for _ in reqs]
+        n = len(reqs)
+        results: List[Any] = [None] * n
+        sync_jobs: List[Tuple[int, Any]] = []   # (idx, zero-arg callable)
+        coro_jobs: List[Tuple[int, Any]] = []   # (idx, coroutine)
+        # ASGI requests account their own ongoing/processed counts inside
+        # _handle_asgi — counting them here too would double the load the
+        # autoscaler sees. ("call"-kind requests on an ASGI deployment
+        # still count here: they bypass the ASGI app.)
+        def _self_counting(req):
+            return self._asgi_app is not None and req.get("k") == "http"
+
+        n_own = sum(1 for r in reqs if not _self_counting(r))
+        self._ongoing += n_own
+        try:
+            for i, (req, body) in enumerate(zip(reqs, bodies)):
+                try:
+                    kind, job = self._raw_prepare(req, body)
+                except Exception as e:  # noqa: BLE001 — per-request error
+                    results[i] = e
+                    continue
+                if kind == "sync":
+                    sync_jobs.append((i, job))
+                else:
+                    coro_jobs.append((i, job))
+            if sync_jobs:
+                # ONE executor hop for the whole frame's sync callables:
+                # per-request hops were a measurable tax at proxy rates.
+                def run_sync():
+                    out = []
+                    for i, job in sync_jobs:
+                        try:
+                            out.append((i, job(), None))
+                        except Exception as e:  # noqa: BLE001 — per-request
+                            out.append((i, None, e))
+                    return out
+
+                loop = asyncio.get_running_loop()
+                for i, value, err in await loop.run_in_executor(None,
+                                                                run_sync):
+                    results[i] = err if err is not None else (value,)
+            if coro_jobs:
+                gathered = await asyncio.gather(
+                    *(job for _, job in coro_jobs), return_exceptions=True)
+                for (i, _), value in zip(coro_jobs, gathered):
+                    results[i] = value if isinstance(value, BaseException) \
+                        else (value,)
+            out: List[Tuple[Dict[str, Any], bytes]] = []
+            for i, req in enumerate(reqs):
+                r = results[i]
+                if isinstance(r, BaseException):
+                    self._errored += 1
+                    out.append(({"err": f"{type(r).__name__}: {r}",
+                                 "code": 500}, b""))
+                    continue
+                value = r[0]
+                if inspect.iscoroutine(value):
+                    # A sync callable returned a coroutine: await on loop.
+                    try:
+                        value = await value
+                    except Exception as e:  # noqa: BLE001 — per-request
+                        self._errored += 1
+                        out.append(({"err": f"{type(e).__name__}: {e}",
+                                     "code": 500}, b""))
+                        continue
+                if inspect.isgenerator(value) or inspect.isasyncgen(value):
+                    value = {"__serve_stream__": self._pump_generator(value)}
+                if not _self_counting(req):
+                    self._processed += 1
+                out.append(self._encode_raw_result(req, value))
+            return out
+        finally:
+            self._ongoing -= n_own
+
+    def _resolve_raw_method(self, name: str) -> tuple:
+        cached = self._raw_methods.get(name)
+        if cached is None:
+            method = getattr(self._user, name, None)
+            if method is None:
+                raise AttributeError(
+                    f"deployment {self._deployment!r} has no method "
+                    f"{name!r}")
+            needs_await = inspect.iscoroutinefunction(method) or bool(
+                getattr(method, "__serve_is_batched__", False))
+            cached = self._raw_methods[name] = (method, needs_await)
+        return cached
+
+    def _raw_prepare(self, req: Dict[str, Any], body: memoryview):
+        """One request entry -> ("sync", zero-arg callable) or ("coro",
+        coroutine). Raising here is a per-request error."""
+        kind = req.get("k")
+        if kind == "http":
+            if self._asgi_app is not None:
+                return "coro", self._handle_asgi(self._raw_http_req(req,
+                                                                    body))
+            method, needs_await = self._resolve_raw_method("__call__")
+            if needs_await:
+                decode = functools.partial(self._raw_http_payload, req,
+                                           bytes(body))
+
+                async def run():
+                    return await method(decode())
+                return "coro", run()
+            # Payload decode (json) rides the sync job into the shared
+            # executor hop — the loop never touches request bodies.
+            return "sync", functools.partial(
+                self._call_sync_http, method, req, bytes(body))
+        if kind == "call":
+            method, needs_await = self._resolve_raw_method(
+                req.get("m") or "__call__")
+            payload = self._raw_call_payload(bytes(body))
+            if needs_await:
+                async def run_call():
+                    return await method(payload)
+                return "coro", run_call()
+            return "sync", functools.partial(method, payload)
+        raise ValueError(f"unknown fast-lane request kind {kind!r}")
+
+    def _call_sync_http(self, method, req, body: bytes):
+        return method(self._raw_http_payload(req, body))
+
+    @staticmethod
+    def _raw_http_req(req: Dict[str, Any], body) -> Dict[str, Any]:
+        return {
+            "method": req.get("m") or "GET",
+            "path": req.get("p") or "/",
+            "root_path": req.get("rp") or "",
+            "query_string": req.get("q") or b"",
+            "client": (req.get("c") or "127.0.0.1", 0),
+            # ASGI scope headers are (bytes, bytes) pairs — the frame
+            # meta carries them as str (msgpack), encode like the classic
+            # lane does.
+            "headers": [
+                (k.encode("latin-1") if isinstance(k, str) else bytes(k),
+                 v.encode("latin-1") if isinstance(v, str) else bytes(v))
+                for k, v in req.get("h") or []],
+            "body": bytes(body),
+        }
+
+    def _raw_http_payload(self, req: Dict[str, Any], body: bytes):
+        return self._decode_http_payload(body, req.get("q") or b"")
+
+    @staticmethod
+    def _raw_call_payload(body: bytes):
+        """gRPC-parity payload: msgpack-decodable bodies are decoded to a
+        Python value, opaque bytes pass through untouched."""
+        import msgpack
+
+        try:
+            return msgpack.unpackb(body, raw=False, strict_map_key=False)
+        except Exception:  # noqa: BLE001 — opaque bytes pass through
+            return body
+
+    def _encode_raw_result(self, req: Dict[str, Any], result
+                           ) -> Tuple[Dict[str, Any], bytes]:
+        if req.get("k") == "call":
+            import msgpack
+
+            if isinstance(result, dict) and (
+                    result.get("__serve_stream__")
+                    or result.get("__serve_http__")):
+                sid = (result.get("__serve_stream__")
+                       or result.get("stream"))
+                return {"stream": sid or "", "err":
+                        "streaming/ASGI deployments are not servable over "
+                        "the unary gRPC ingress — use the HTTP proxy",
+                        "code": 501}, b""
+            if isinstance(result, (bytes, bytearray, memoryview)):
+                return {"enc": "bin"}, bytes(result)
+            try:
+                return {"enc": "msgpack"}, msgpack.packb(result,
+                                                         use_bin_type=True)
+            except Exception as e:  # noqa: BLE001 — per-request error
+                return {"err": f"result of type {type(result).__name__} is "
+                        f"not msgpack-serializable: {e}", "code": 500}, b""
+        # HTTP result -> final response: status + headers + body bytes so
+        # the proxy writes them through without touching the payload.
+        if isinstance(result, dict) and result.get("__serve_http__"):
+            entry = {"status": result.get("status", 200),
+                     "hdr": list(result.get("headers") or []), "a": 1}
+            sid = result.get("stream")
+            if sid:
+                entry["stream"] = sid
+            return entry, bytes(result.get("body") or b"")
+        if isinstance(result, dict) and result.get("__serve_stream__"):
+            return {"status": 200, "stream": result["__serve_stream__"],
+                    "ct": "application/octet-stream"}, b""
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            return {"status": 200,
+                    "ct": "application/octet-stream"}, bytes(result)
+        if isinstance(result, str):
+            return {"status": 200, "ct": "text/plain; charset=utf-8"}, \
+                result.encode()
+        if isinstance(result, (dict, list, int, float, bool)) \
+                or result is None:
+            return {"status": 200, "ct": "application/json"}, \
+                json.dumps({"result": result}).encode()
+        return {"status": 200, "ct": "text/plain; charset=utf-8"}, \
+            str(result).encode()
+
+    async def __serve_stream_raw__(self, frame: memoryview) -> list:
+        """Raw stream pull (the worker's `serve_stream` handler): drain
+        the next batch of a registered stream as length-prefixed chunk
+        bytes — the PR-3 token stream rides this as just another
+        consumer. `cancel` frames release the pump immediately."""
+        meta, _ = dataplane.decode_frame(frame)
+        sid = meta.get("sid") or ""
+        if meta.get("cancel"):
+            await self.stream_cancel(sid)
+            return dataplane.encode_frame({"done": True, "lens": []}, [])
+        batch = await self.stream_next(sid,
+                                       max_items=meta.get("max") or 64,
+                                       timeout_s=meta.get("timeout") or 30.0)
+        chunks = [self._encode_stream_item(it)
+                  for it in batch.get("items") or []]
+        out = {"done": bool(batch.get("done")),
+               "lens": [len(c) for c in chunks]}
+        if batch.get("error"):
+            out["err"] = batch["error"]
+        return dataplane.encode_frame(out, chunks)
+
+    @staticmethod
+    def _encode_stream_item(item) -> bytes:
+        if isinstance(item, (bytes, bytearray, memoryview)):
+            return bytes(item)
+        if isinstance(item, str):
+            return item.encode()
+        return (json.dumps(item) + "\n").encode()
+
     def _register_stream(self, queue: asyncio.Queue, task) -> str:
         self._reap_idle_streams()
         self._stream_seq += 1
@@ -314,6 +597,17 @@ class Replica:
             rec[2] = time.monotonic()
         return {"items": items, "done": done, "error": error}
 
+    @staticmethod
+    def _node_hex() -> str:
+        """This replica's node id (for the controller's locality table);
+        empty when instantiated outside a cluster (unit tests)."""
+        import ray_tpu
+
+        rt = ray_tpu._global_runtime
+        if rt is None or rt.node_id is None:
+            return ""
+        return rt.node_id.hex()
+
     def stats(self) -> Dict[str, Any]:
         out = {
             "deployment": self._deployment,
@@ -321,6 +615,11 @@ class Replica:
             "processed": self._processed,
             "errored": self._errored,
             "uptime_s": time.time() - self._started_at,
+            "node": self._node_hex(),
+            "fastpath": {
+                "frames": dataplane.COUNTERS["raw_dispatch_frames"],
+                "requests": dataplane.COUNTERS["raw_dispatch_requests"],
+            },
         }
         # User-exported metrics (e.g. the inference engine's queue depth
         # and tokens/s): the controller folds `queue_depth` into its
@@ -334,12 +633,15 @@ class Replica:
                 pass
         return out
 
-    def ping(self) -> str:
+    def ping(self) -> Dict[str, Any]:
         # The controller health-checks periodically: piggyback the idle
         # stream sweep so abandoned streams are reaped even when no new
-        # streaming request ever reaches this replica.
+        # streaming request ever reaches this replica. The node id rides
+        # along so the controller can publish replica placement in the
+        # routing table (locality-aware direct routing) without an extra
+        # round trip.
         self._reap_idle_streams()
-        return "pong"
+        return {"ok": True, "node": self._node_hex()}
 
     async def prepare_shutdown(self, timeout_s: float = 5.0) -> int:
         """Graceful drain: refuse new requests, wait for ongoing ones,

@@ -2,9 +2,14 @@
 
 Equivalent of the reference's `Router`/`ReplicaSet.assign_replica`
 (`serve/_private/router.py:274,227`): keeps a local snapshot of the
-controller's routing table (refreshed by a background long-poll thread),
-picks the least-loaded replica whose local in-flight count is under
-``max_concurrent_queries``, and blocks when all replicas are saturated.
+controller's routing table (pushed via a background long-poll thread —
+never polled per-request; the controller piggybacks replica placement
+and queue depths on the same push), prefers a co-located replica with
+headroom and otherwise picks by power-of-two-choices over local
+in-flight + pushed depth, and blocks when all replicas are saturated.
+Scale-to-zero deployments appear as `parked` entries; routing to one
+fires a throttled wake RPC and waits for the cold-started replica to be
+pushed into the table.
 In-flight counts are decremented by a reaper thread that waits on the
 outstanding ObjectRefs — the framework has no future callbacks by design
 (completion events ride the worker push channel), so one thread per router
@@ -14,7 +19,9 @@ amortizes completion tracking across all requests.
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
@@ -22,11 +29,17 @@ logger = logging.getLogger(__name__)
 
 class Router:
     UNKNOWN_GRACE_S = 5.0  # deploy-in-progress grace before KeyError
+    WAKE_THROTTLE_S = 0.5  # min gap between wake RPCs per deployment
 
     def __init__(self, controller_handle, poll_timeout_s: float = 5.0):
         self._controller = controller_handle
         self._poll_timeout_s = poll_timeout_s
         self._lock = threading.Condition()
+        # Locality: this process's node (lazy — resolving it needs a live
+        # runtime) so _pick can prefer co-located replicas.
+        self._local_node: Optional[str] = None
+        # Scale-to-zero wake throttling: deployment -> last wake monotonic.
+        self._last_wake: Dict[str, float] = {}
         # Threads parked in assign()'s backpressure wait. notify_all costs
         # two context switches per call; at proxy request rates an
         # unconditional notify in release() measurably taxes the hot path,
@@ -67,8 +80,6 @@ class Router:
                timeout_s: Optional[float] = None):
         """Pick a replica and submit; returns the ObjectRef. Blocks while
         every replica is at max_concurrent_queries (backpressure)."""
-        import time
-
         self._ensure_started()
         start = time.monotonic()
         deadline = None if timeout_s is None else start + timeout_s
@@ -77,8 +88,14 @@ class Router:
                 entry = self._table.get(deployment)
                 choice = self._reserve_locked(entry)
                 if choice is not None:
-                    replica_id, handle = choice
+                    replica_id, handle = choice[0], choice[1]
                     break
+                if entry is not None and not entry["replicas"] \
+                        and entry.get("parked"):
+                    # Scale-to-zero: ask the controller for a replica
+                    # (throttled, off-thread — never an RPC under the
+                    # router lock) and keep waiting for the table push.
+                    self.wake(deployment)
                 # A name absent from the table is (after a short grace for
                 # an in-progress deploy) an error, not backpressure — don't
                 # park forever on a typo.
@@ -115,7 +132,7 @@ class Router:
             choice = self._reserve_locked(self._table.get(deployment))
         if choice is None:
             return None
-        replica_id, handle = choice
+        replica_id, handle = choice[0], choice[1]
         return self._submit(handle, replica_id, method_name, args, kwargs)
 
     def reserve(self, deployment: str) -> Optional[Tuple[str, object]]:
@@ -124,10 +141,66 @@ class Router:
         saturated/unknown. The caller OWNS the slot and must call
         release() when its request completes — used by transports that
         bypass _submit/ObjectRefs (the proxy's light lane)."""
+        choice = self.reserve_fast(deployment)
+        if choice is None:
+            return None
+        return choice[0], choice[1]
+
+    def reserve_fast(self, deployment: str, exclude: Optional[set] = None
+                     ) -> Optional[Tuple[str, object, bool]]:
+        """reserve() for the raw fast lane: returns (replica_id, handle,
+        colocated) — `colocated` reports whether the locality-first pick
+        landed on this process's node. `exclude` skips replicas the
+        caller just lost a frame to (the retry-once path)."""
         if not self._started:
             return None
         with self._lock:
-            return self._reserve_locked(self._table.get(deployment))
+            return self._reserve_locked(self._table.get(deployment),
+                                        exclude or ())
+
+    def deployment_state(self, deployment: str) -> str:
+        """Coarse state for the fast lane's no-replica handling:
+        "unknown" (not in the table), "parked" (scale-to-zero, waiting
+        for a cold start), or "active"."""
+        with self._lock:
+            entry = self._table.get(deployment)
+        if entry is None:
+            return "unknown"
+        if not entry["replicas"] and entry.get("parked"):
+            return "parked"
+        return "active"
+
+    def has_replicas(self, deployment: str) -> bool:
+        """Cheap routable-replica probe (the fast lane's cold-start wait
+        polls this on the event loop — it must hold no thread)."""
+        with self._lock:
+            entry = self._table.get(deployment)
+            return bool(entry and entry["replicas"])
+
+    def live_replica_ids(self) -> set:
+        with self._lock:
+            return {rid for entry in self._table.values()
+                    for rid, _ in entry.get("replicas", ())}
+
+    def wake(self, deployment: str) -> None:
+        """Nudge the controller to cold-start a parked deployment.
+        Throttled per deployment and fired from a one-shot thread: the
+        actor submit may block resolving the controller connection, and
+        callers hold the router lock or sit on an event loop."""
+        now = time.monotonic()
+        last = self._last_wake.get(deployment, 0.0)
+        if now - last < self.WAKE_THROTTLE_S:
+            return
+        self._last_wake[deployment] = now
+
+        def fire():
+            try:
+                self._controller.wake_deployment.remote(deployment)
+            except Exception:  # noqa: BLE001 — next throttled wake retries
+                logger.debug("serve: wake of %s failed", deployment,
+                             exc_info=True)
+
+        threading.Thread(target=fire, name="serve-wake", daemon=True).start()
 
     def release(self, replica_id: str):
         """Return a slot taken with reserve()."""
@@ -137,15 +210,15 @@ class Router:
             if self._waiters:
                 self._lock.notify_all()
 
-    def _reserve_locked(self, entry):
+    def _reserve_locked(self, entry, exclude=()):
         """Pick a replica with headroom and count the in-flight slot —
-        the single admission-accounting point for both assign paths."""
+        the single admission-accounting point for every assign path."""
         if not entry or not entry["replicas"]:
             return None
-        choice = self._pick(entry)
+        choice = self._pick(entry, exclude)
         if choice is None:
             return None
-        replica_id, _ = choice
+        replica_id = choice[0]
         self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
         return choice
 
@@ -173,16 +246,71 @@ class Router:
                     return handle
         return None
 
-    def _pick(self, entry: dict) -> Optional[Tuple[str, object]]:
+    def _local_node_hex(self) -> Optional[str]:
+        if self._local_node is None:
+            try:
+                import ray_tpu
+
+                rt = ray_tpu._global_runtime
+                if rt is not None and rt.node_id is not None:
+                    self._local_node = rt.node_id.hex()
+            except Exception:  # noqa: BLE001 — no runtime (unit tests)
+                pass
+            if self._local_node is None:
+                self._local_node = ""  # resolved-and-absent: don't retry
+        return self._local_node or None
+
+    def _pick(self, entry: dict, exclude=()
+              ) -> Optional[Tuple[str, object, bool]]:
+        """Replica choice: locality first, then power-of-two-choices.
+
+        A co-located replica (same node as this router, per the table's
+        pushed placement map) with headroom always wins — that request
+        skips the network entirely. Otherwise two random candidates are
+        compared by local in-flight + the controller-pushed queue depth
+        (stale by at most the health-check cadence; the local in-flight
+        half is exact) and the lighter one is picked — the classic p2c
+        bound on max load without scanning every replica under the lock.
+        Only RUNNING replicas ever appear in the table, so DEAD and
+        draining replicas are structurally unroutable here."""
         limit = entry["max_concurrent_queries"]
-        best, best_load = None, None
+        nodes = entry.get("nodes") or {}
+        depths = entry.get("depths") or {}
+        local = self._local_node_hex() if nodes else None
+        co_best, co_load = None, None
+        candidates = []
         for replica_id, handle in entry["replicas"]:
+            if replica_id in exclude:
+                continue
             load = self._inflight.get(replica_id, 0)
             if load >= limit:
                 continue
-            if best_load is None or load < best_load:
-                best, best_load = (replica_id, handle), load
-        return best
+            if local is not None and nodes.get(replica_id) == local:
+                # Pack-first among co-located replicas: the MOST loaded
+                # one that still has headroom. Requests concentrating on
+                # one replica coalesce into bigger frames (and bigger
+                # @serve.batch gangs); admission spills to the next
+                # replica only at max_concurrent_queries, which bounds
+                # the latency cost.
+                if co_load is None or load > co_load:
+                    co_best, co_load = (replica_id, handle), load
+            else:
+                candidates.append((replica_id, handle, load))
+        if co_best is not None:
+            return co_best[0], co_best[1], True
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            replica_id, handle, _ = candidates[0]
+            return replica_id, handle, False
+        i = random.randrange(len(candidates))
+        j = random.randrange(len(candidates) - 1)
+        if j >= i:
+            j += 1
+        a, b = candidates[i], candidates[j]
+        pick = a if (a[2] + depths.get(a[0], 0)
+                     <= b[2] + depths.get(b[0], 0)) else b
+        return pick[0], pick[1], False
 
     # ------------------------------------------------------- background IO
 

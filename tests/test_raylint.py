@@ -108,6 +108,54 @@ def test_rl001_quiet_when_validation_precedes_park(tmp_path):
     assert lint_src(tmp_path, RL001_GOOD_PARK_LAST, rules=["RL001"]) == []
 
 
+# Serve fast-lane raw-frame idiom (worker._handle_serve_raw): the reply
+# is a raw frame sent from an async completion scheduled on the actor
+# loop. The completion owns the reply — every exit path must reply_raw
+# (user errors travel INSIDE an error frame).
+
+RL001_BAD_SERVE_RAW_FRAME = """
+    from ray_tpu.core.rpc import DEFERRED
+
+    def handle_serve_raw(conn, payload, loop, dispatch):
+        mid = conn.current_msg_id
+
+        async def run():
+            parts = await dispatch(payload)  # a raise strands the caller
+            conn.reply_raw(mid, "serve_raw", parts)
+
+        schedule(loop, run())
+        return DEFERRED
+"""
+
+RL001_GOOD_SERVE_RAW_FRAME = """
+    from ray_tpu.core.rpc import DEFERRED
+
+    def handle_serve_raw(conn, payload, loop, dispatch):
+        mid = conn.current_msg_id
+
+        async def run():
+            try:
+                parts = await dispatch(payload)
+                conn.reply_raw(mid, "serve_raw", parts)
+            except BaseException as e:
+                conn.reply_raw(mid, "serve_raw", encode_error_frame(e))
+
+        schedule(loop, run())
+        return DEFERRED
+"""
+
+
+def test_rl001_flags_unguarded_raw_frame_completion(tmp_path):
+    findings = lint_src(tmp_path, RL001_BAD_SERVE_RAW_FRAME,
+                        rules=["RL001"])
+    assert rule_ids(findings) == ["RL001"]
+
+
+def test_rl001_quiet_on_error_frame_guarded_completion(tmp_path):
+    assert lint_src(tmp_path, RL001_GOOD_SERVE_RAW_FRAME,
+                    rules=["RL001"]) == []
+
+
 # ------------------------------------------------------------------ RL002
 
 RL002_BAD = """
@@ -294,6 +342,37 @@ def test_rl003_quiet_on_handoff_via_assignment(tmp_path):
             core._pending["k"] = oid
     """
     assert lint_src(tmp_path, src, rules=["RL003"]) == []
+
+
+# Serve fast-lane flavor: a handler that pins a segment for a raw-frame
+# reply must free it on the error paths too — reply_raw raises on a gone
+# caller, and the fall-through free then never runs.
+
+RL003_BAD_RAW_REPLY = """
+    def handle_serve_chunk(core, conn, frame):
+        oid = core.put_raw(frame)
+        conn.reply_raw(conn.current_msg_id, "serve_raw", view_of(frame))
+        core.free_raw(oid)
+"""
+
+RL003_GOOD_RAW_REPLY = """
+    def handle_serve_chunk(core, conn, frame):
+        oid = core.put_raw(frame)
+        try:
+            conn.reply_raw(conn.current_msg_id, "serve_raw", view_of(frame))
+        finally:
+            core.free_raw(oid)
+"""
+
+
+def test_rl003_flags_reply_raw_fall_through_free(tmp_path):
+    findings = lint_src(tmp_path, RL003_BAD_RAW_REPLY, rules=["RL003"])
+    assert rule_ids(findings) == ["RL003"]
+    assert "fall-through" in findings[0].message
+
+
+def test_rl003_quiet_on_reply_raw_finally_free(tmp_path):
+    assert lint_src(tmp_path, RL003_GOOD_RAW_REPLY, rules=["RL003"]) == []
 
 
 # ------------------------------------------------------------------ RL004

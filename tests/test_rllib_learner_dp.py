@@ -200,13 +200,29 @@ def test_remote_sharded_group_trains_multiprocess(ray_start_regular):
 
     rng = np.random.default_rng(0)
     batch = _ppo_batch(rng, 64)
-    group = LearnerGroup(lambda **kw: _make_ppo_learner(**kw),
-                         mode="remote", num_learners=2)
+
+    def _skip_if_unsupported_env(e: Exception):
+        # Some jax builds cannot form a multiprocess computation group on
+        # the CPU backend at all ("Multiprocess computations aren't
+        # implemented on the CPU backend") — an environment limitation,
+        # not a framework regression: skip instead of failing tier-1.
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip("jax CPU backend does not support multiprocess "
+                        "computations in this environment")
+
+    group = None
     try:
-        out = group.update(batch)
+        try:
+            group = LearnerGroup(lambda **kw: _make_ppo_learner(**kw),
+                                 mode="remote", num_learners=2)
+            out = group.update(batch)
+        except Exception as e:  # noqa: BLE001 — env-capability probe
+            _skip_if_unsupported_env(e)
+            raise
         assert np.isfinite(out["total_loss"])
         single = _make_ppo_learner(num_devices=1).update(batch)
         assert abs(out["total_loss"] - single["total_loss"]) < 0.05, \
             (out["total_loss"], single["total_loss"])
     finally:
-        group.shutdown()
+        if group is not None:
+            group.shutdown()

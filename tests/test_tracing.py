@@ -510,7 +510,9 @@ def test_serve_http_llm_trace_spans_processes_and_ttft(traced_cluster):
             body = json.loads(resp.read())
         assert len(body["result"]["ids"]) == 7
 
-        want = {"serve.http", "serve.route", "serve.dispatch",
+        # The fast data plane dispatches direct (serve.direct replaces
+        # the classic serve.route/serve.dispatch pair on this path).
+        want = {"serve.http", "serve.direct",
                 "serve.replica", "engine.queue", "engine.prefill",
                 "engine.decode"}
         spans = _trace_spans(root.trace_id, want, timeout=40.0)
