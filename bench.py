@@ -1475,6 +1475,110 @@ def bench_tracing(quick: bool) -> dict:
     return out
 
 
+def _sharded_decode_main(quick: bool) -> dict:
+    """Runs inside a fresh subprocess whose env forces a multi-device
+    CPU platform (the bench's own process may have initialized jax with
+    one device long before this section runs): tp=2 vs single-device
+    decode tokens/s at equal parameter count."""
+    import jax
+
+    from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    new_tokens = 32 if quick else 96
+    n_reqs = 4
+
+    def run_engine(mesh) -> float:
+        cfg = EngineConfig(model_size="tiny", max_model_len=256,
+                           batch_slots=4, num_blocks=64,
+                           max_blocks_per_seq=16)
+        engine = InferenceEngine(cfg, mesh=mesh)
+        # Warm both programs out of the measurement window.
+        engine.add_request([1, 2, 3], max_new_tokens=2)
+        engine.run_until_idle()
+        reqs = [engine.add_request([10 + i, 11 + i], new_tokens)
+                for i in range(n_reqs)]
+        t0 = time.perf_counter()
+        engine.run_until_idle()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.generated) for r in reqs)
+        engine.check_no_leaks()
+        return total / dt
+
+    single = run_engine(None)
+    mesh = build_mesh(MeshSpec({"tp": 2}), devices=jax.devices()[:2])
+    sharded = run_engine(mesh)
+    return {
+        "single_decode_tokens_per_s": round(single, 1),
+        "sharded_decode_tokens_per_s": round(sharded, 1),
+        "sharded_decode_speedup": round(sharded / single, 3),
+    }
+
+
+def bench_sharded(quick: bool) -> dict:
+    """Sharded replica groups (ISSUE 9): tensor-parallel decode
+    throughput vs single-device at EQUAL parameter count, and gang
+    cold-start latency (forge-spawned rank actors).
+
+    On this 2-core CPU sandbox tp=2 shards compute over forced host
+    devices that share the same physical cores, so `sharded_decode_
+    speedup` measures partitioning OVERHEAD (expect <= 1.0 here; on a
+    real multi-chip host the same program is the scale-up path) — the
+    number to watch is that overhead staying bounded and the parity
+    tests staying green."""
+    import json as _json
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu import shardgroup
+
+    code = ("import bench, json; "
+            f"print('SHARD_RESULT ' + json.dumps("
+            f"bench._sharded_decode_main({quick!r})))")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=1200,
+                          cwd=os.path.dirname(os.path.abspath(__file__)),
+                          env=env)
+    out: dict = {}
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("SHARD_RESULT "):
+            out = _json.loads(line[len("SHARD_RESULT "):])
+    if not out:
+        raise RuntimeError(
+            f"sharded decode run failed (rc={proc.returncode}): "
+            f"{(proc.stderr or '')[-500:]}")
+
+    # Gang cold start: placement group 2PC + two forge-spawned rank
+    # actors + bring-up, measured to the all-ranks-alive ping (tp=1:
+    # no mesh needed, so this half runs fine in the bench process).
+    class _Rank:
+        def __call__(self, payload):
+            return payload
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    coldstarts = []
+    for _ in range(2 if quick else 4):
+        t0 = time.perf_counter()
+        group = shardgroup.create_replica_group(
+            _Rank, shardgroup.ShardSpec(tp=1, world_size=2),
+            deployment_name="bench", ready_timeout_s=60)
+        coldstarts.append((time.perf_counter() - t0) * 1e3)
+        group.kill()
+    ray_tpu.shutdown()
+
+    out["sharded_group_coldstart_ms"] = round(min(coldstarts), 1)
+    out["sharded_group_coldstart_worst_ms"] = round(max(coldstarts), 1)
+    return out
+
+
 def main(out=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1560,6 +1664,10 @@ def main(out=None):
             extra.update(bench_inference(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["inference_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extra.update(bench_sharded(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["sharded_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_envelope:
         try:
             extra.update(bench_envelope(args.quick))

@@ -12,6 +12,8 @@ for the catalog with real before/after examples):
 - RL006 jit-retrace-hazard     — XLA programs compiled once, cached
 - RL007 static-lock-order      — lock acquisition graph is acyclic
 - RL008 span-leak              — tracing spans always end()ed
+- RL009 gang-without-death-hook — placement-grouped gangs abort cleanly
+                                  and register group death handling
 """
 
 from __future__ import annotations
@@ -879,3 +881,119 @@ def rl008_span_leak(ctx: FileContext) -> Iterable[Finding]:
                 call, "RL008",
                 "start_span() result discarded — the span can never be "
                 "ended; use it as a context manager")
+
+
+# =====================================================================
+# RL009 gang-without-death-hook
+# =====================================================================
+#
+# Gang discipline (ray_tpu/shardgroup/gang.py): creating MULTIPLE actors
+# into one placement group — a loop whose body both constructs a
+# PlacementGroupSchedulingStrategy and calls `.remote(...)` — is a gang,
+# and gangs have two non-negotiable obligations no runtime test proves
+# on the paths that matter:
+#
+#  (a) ABORT: the creation loop must sit inside a `try` whose except/
+#      finally path releases everything (a call to
+#      `remove_placement_group`, or an abort helper — name containing
+#      "abort" — that does).  A mid-gang create failure otherwise leaks
+#      every acquired bundle and leaves a half-alive gang serving
+#      nothing.
+#
+#  (b) DEATH HOOK: the function must register group death handling — a
+#      `GangMonitor(...)`, a call whose name mentions "death", or an
+#      `on_death=`/`death_hook=` keyword — so one dead rank kills/fails
+#      the whole gang instead of survivors hanging on a peer that will
+#      never answer (the serve controller's group health check plays
+#      this role for serve gangs via `create_gang`).
+#
+# The blessed APIs (`shardgroup.create_gang` / `create_replica_group`)
+# satisfy both; hand-rolled gangs that cannot take a hook annotate with
+# `# raylint: disable=RL009` and own the consequences.
+
+
+_RL009_DEATH_NAMES = {"GangMonitor"}
+_RL009_DEATH_KWARGS = {"on_death", "death_hook"}
+
+
+def _rl009_gang_loop(fn: ast.AST) -> Optional[ast.AST]:
+    """The first loop in `fn` that creates placement-grouped actors."""
+    for sub in walk_excluding_nested_functions(fn):
+        if not isinstance(sub, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        has_pgss = has_remote = False
+        for call in ast.walk(sub):
+            if not isinstance(call, ast.Call):
+                continue
+            seg = last_segment(dotted(call.func))
+            if seg == "PlacementGroupSchedulingStrategy":
+                has_pgss = True
+            elif seg == "remote" or (
+                    # `Cls.options(...).remote(...)` — the dominant real
+                    # shape: the receiver is itself a Call, so dotted()
+                    # has no name for it; match the attribute directly.
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "remote"):
+                has_remote = True
+        if has_pgss and has_remote:
+            return sub
+    return None
+
+
+def _rl009_is_cleanup(call: ast.Call) -> bool:
+    seg = last_segment(dotted(call.func))
+    return seg == "remove_placement_group" or "abort" in seg.lower()
+
+
+def _rl009_abort_guarded(ctx: FileContext, loop: ast.AST,
+                         fn: ast.AST) -> bool:
+    """Is the gang loop inside a try whose except/finally cleans up?"""
+    for anc in ctx.ancestors(loop):
+        if anc is fn:
+            break
+        if not isinstance(anc, ast.Try):
+            continue
+        blocks = [h.body for h in anc.handlers]
+        if anc.finalbody:
+            blocks.append(anc.finalbody)
+        for body in blocks:
+            for stmt in statements(body):
+                for call in _calls_in(stmt):
+                    if _rl009_is_cleanup(call):
+                        return True
+    return False
+
+
+def _rl009_has_death_hook(fn: ast.AST) -> bool:
+    for call in _calls_in(fn):
+        seg = last_segment(dotted(call.func))
+        if seg in _RL009_DEATH_NAMES or "death" in seg.lower():
+            return True
+        for kw in call.keywords:
+            if kw.arg in _RL009_DEATH_KWARGS:
+                return True
+    return False
+
+
+@rule("RL009", "gang-without-death-hook: placement-grouped multi-actor "
+               "creation without abort cleanup and a group death hook")
+def rl009_gang_without_death_hook(ctx: FileContext) -> Iterable[Finding]:
+    for fn in _functions(ctx):
+        loop = _rl009_gang_loop(fn)
+        if loop is None:
+            continue
+        missing = []
+        if not _rl009_abort_guarded(ctx, loop, fn):
+            missing.append(
+                "no abort path (wrap the creation loop in try/except "
+                "that kills created ranks and remove_placement_group()s)")
+        if not _rl009_has_death_hook(fn):
+            missing.append(
+                "no group death hook (register a GangMonitor / on_death "
+                "handler so one dead rank fails the whole gang)")
+        if missing:
+            yield ctx.finding(
+                loop, "RL009",
+                "multi-actor gang on a placement group: "
+                + "; ".join(missing)
+                + " — or use shardgroup.create_gang/create_replica_group")

@@ -53,7 +53,15 @@ class LLMServer:
         kwargs.setdefault("max_model_len", max_model_len)
         self._default_new = default_new_tokens
         self._config = EngineConfig(**kwargs)
-        self._engine = InferenceEngine(self._config)
+        # Sharded replica groups: when this replica is a gang rank the
+        # shard context was activated before this ctor ran; the gang's
+        # tp mesh turns on the engine's tensor-parallel path (params and
+        # the paged KV arena shard over the mesh, same seed -> same
+        # weights as an unsharded replica).
+        from ray_tpu import shardgroup
+
+        self._engine = InferenceEngine(self._config,
+                                       mesh=shardgroup.current_mesh())
         self._loop = EngineLoop(self._engine)
 
     # ------------------------------------------------------------ complete

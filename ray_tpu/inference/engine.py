@@ -115,14 +115,17 @@ class InferenceEngine:
     with randomly initialized weights, matching the sampler examples.
     """
 
-    def __init__(self, config: EngineConfig, model=None, params=None):
+    def __init__(self, config: EngineConfig, model=None, params=None,
+                 mesh=None):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.models.llama import (
             Llama,
             LlamaConfig,
+            arena_sharding,
             make_paged_arena,
+            shard_params_tp,
         )
 
         cfg = config
@@ -139,11 +142,26 @@ class InferenceEngine:
             params = jax.jit(lambda: model.init(
                 jax.random.PRNGKey(0),
                 jnp.zeros((1, 8), jnp.int32)))()
+        # Tensor-parallel serving (docs/SHARDED.md): with a mesh, params
+        # are placed into their tp NamedShardings (heads/mlp/vocab split
+        # over the "tp" axis) and the paged arena shards its kv-head dim
+        # WITH the heads — the jitted step programs below then compile to
+        # partitioned XLA with no code change here (GSPMD does the rest).
+        self._mesh = mesh
+        self._tp = 1
+        if mesh is not None:
+            from ray_tpu.models.llama import _mesh_tp
+
+            self._tp = _mesh_tp(mesh)
+            params = shard_params_tp(model, params, mesh)
         self._model = model
         self._params = params
+        self._arena_sharding = (arena_sharding(model.config, mesh)
+                                if mesh is not None else None)
         self._bm = BlockManager(cfg.num_blocks, cfg.block_size)
         self._arenas = make_paged_arena(model.config, cfg.num_blocks,
-                                        cfg.block_size)
+                                        cfg.block_size,
+                                        sharding=self._arena_sharding)
         self._slots: List[Optional[Request]] = [None] * cfg.batch_slots
         self._waiting: List[Request] = []     # kept sorted by arrival
         self._live: Dict[str, Request] = {}   # request_id -> live request
@@ -562,7 +580,7 @@ class InferenceEngine:
 
             self._arenas = make_paged_arena(
                 self._model.config, self.config.num_blocks,
-                self.config.block_size)
+                self.config.block_size, sharding=self._arena_sharding)
         for fn, args in emissions:
             try:
                 fn(*args)
@@ -651,6 +669,7 @@ class InferenceEngine:
         return {
             "queue_depth": len(self._waiting),
             "running": len(running),
+            "tp": self._tp,
             "batch_slots": self.config.batch_slots,
             "tokens_emitted": self._tokens_emitted,
             "tokens_per_sec": (window_tokens / span) if span > 0 else 0.0,

@@ -296,13 +296,88 @@ class Llama(nn.Module):
         return self.lm_head(x), new_arenas
 
 
-def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int):
+def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                     sharding=None):
     """Preallocated per-layer (k, v) paged arena [num_blocks, block_size,
     kv_heads, head_dim]. Block 0 is the trash block (never allocated to a
-    sequence): masked writes land there and nothing ever reads it."""
+    sequence): masked writes land there and nothing ever reads it.
+    `sharding` (from :func:`arena_sharding`) lays each arena out sharded
+    on its kv-head dim — the paged cache shards WITH the attention heads,
+    so a tp-sharded decode never gathers K/V across devices."""
     shape = (num_blocks, block_size, cfg.n_kv_head, cfg.head_dim)
-    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
-            for _ in range(cfg.n_layer)]
+    if sharding is None:
+        def zeros():
+            return jnp.zeros(shape, cfg.dtype)
+    else:
+        # Allocate DIRECTLY into the sharded layout: a device_put of a
+        # host/default-device zeros array would transiently commit the
+        # whole arena to one device — at real tp widths that excess can
+        # OOM device 0 at startup even though the sharded steady state
+        # fits. One jitted zeros program, executed 2*n_layer times.
+        import jax
+
+        zeros = jax.jit(lambda: jnp.zeros(shape, cfg.dtype),
+                        out_shardings=sharding)
+    return [(zeros(), zeros()) for _ in range(cfg.n_layer)]
+
+
+# --------------------------------------------------------------------------- #
+# Tensor-parallel path: NamedSharding placement over a "tp" mesh axis
+# --------------------------------------------------------------------------- #
+
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    """Fail fast on widths XLA can't shard evenly: attention heads, KV
+    heads (the paged arena shards with them), the SwiGLU hidden width and
+    the vocab all split over tp."""
+    bad = {name: dim for name, dim in (
+        ("n_head", cfg.n_head), ("n_kv_head", cfg.n_kv_head),
+        ("intermediate", cfg.intermediate), ("vocab_size", cfg.vocab_size))
+        if dim % tp}
+    if bad:
+        raise ValueError(
+            f"tp={tp} does not divide {bad} — pick a tp width that "
+            "divides heads, kv heads, the MLP hidden and the vocab")
+
+
+def tp_shardings(model: "Llama", mesh):
+    """NamedSharding pytree for the params on `mesh` (logical axes ->
+    mesh axes via the standard rules: heads/mlp/vocab shard over "tp")."""
+    from ray_tpu.models.gpt2 import mesh_shardings_for
+
+    return mesh_shardings_for(model, mesh, (1, 8))
+
+
+def shard_params_tp(model: "Llama", params, mesh):
+    """device_put an (un)sharded param pytree into its tp layout —
+    resharding is a no-op placement when the layout already matches, so
+    this is safe on freshly-initialized and checkpoint-restored trees
+    alike."""
+    import jax
+
+    validate_tp(model.config, _mesh_tp(mesh))
+    return jax.device_put(params, tp_shardings(model, mesh))
+
+
+def arena_sharding(cfg: LlamaConfig, mesh):
+    """NamedSharding for the paged KV arena: kv-head dim over "tp"
+    ([num_blocks, block_size, kv_heads, head_dim] -> P(None, None, "tp",
+    None)), the same split as the attention heads that read it."""
+    import jax
+
+    validate_tp(cfg, _mesh_tp(mesh))
+    # No trailing None: jit normalizes output specs by dropping it, and a
+    # device_put layout that differs only in the trailing None is a
+    # DIFFERENT jit cache key — the engine's compile-once discipline
+    # (fresh arenas after fail_all mixing with donated step outputs)
+    # depends on the two being identical.
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, None, "tp"))
+
+
+def _mesh_tp(mesh) -> int:
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(axes.get("tp", 1))
 
 
 def make_cache(cfg: LlamaConfig, batch: int, max_len: int):

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, Optional, Union
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle, _drop_process_router
+from ray_tpu.shardgroup.spec import ShardSpec
 
 logger = logging.getLogger(__name__)
 
@@ -62,7 +63,8 @@ class Deployment:
                 autoscaling_config: Optional[AutoscalingConfig] = None,
                 route_prefix: Optional[str] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
-                user_config: Any = None
+                user_config: Any = None,
+                shard_spec: Optional["ShardSpec"] = None
                 ) -> "Deployment":
         cfg = _dc_replace(self.config)
         if num_replicas is not None:
@@ -77,6 +79,8 @@ class Deployment:
             cfg.ray_actor_options = dict(ray_actor_options)
         if user_config is not None:
             cfg.user_config = user_config
+        if shard_spec is not None:
+            cfg.shard_spec = shard_spec
         return Deployment(self._target, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -96,7 +100,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                route_prefix: Optional[str] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               user_config: Any = None):
+               user_config: Any = None,
+               shard_spec: Optional["ShardSpec"] = None):
     """`@serve.deployment` on a class or function."""
 
     def wrap(target):
@@ -107,6 +112,7 @@ def deployment(_target=None, *, name: Optional[str] = None,
             route_prefix=route_prefix,
             ray_actor_options=dict(ray_actor_options or {}),
             user_config=user_config,
+            shard_spec=shard_spec,
         )
         return Deployment(target, name or target.__name__, cfg)
 
@@ -438,7 +444,7 @@ def deploy_config(config, *, timeout_s: float = 60.0):
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "batch", "build", "delete", "deploy_config",
-    "deployment", "get_deployment_handle", "grpc_port", "http_port",
-    "ingress", "run", "shutdown", "start", "status",
+    "DeploymentHandle", "ShardSpec", "batch", "build", "delete",
+    "deploy_config", "deployment", "get_deployment_handle", "grpc_port",
+    "http_port", "ingress", "run", "shutdown", "start", "status",
 ]

@@ -13,6 +13,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ray_tpu.shardgroup.spec import ShardSpec
+
 
 @dataclass
 class AutoscalingConfig:
@@ -52,6 +54,13 @@ class DeploymentConfig:
     # transfer plane's tree broadcast instead of being re-pickled through
     # the controller per replica (reference: serve user_config semantics).
     user_config: Any = None
+    # Sharded replica groups (docs/SHARDED.md): when set, every "replica"
+    # of this deployment is a gang of shard_spec.world_size rank actors on
+    # one placement group driving a shard_spec.tp-wide tensor-parallel
+    # mesh. The router still sees ONE handle per replica (rank 0);
+    # autoscaling / scale-to-zero operate on whole groups, and any rank
+    # death kills and restarts the group as a unit.
+    shard_spec: Optional[ShardSpec] = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling is not None:

@@ -60,6 +60,11 @@ class _ReplicaInfo:
         # Placement, reported by the replica's ping: published in the
         # routing table so routers can prefer co-located replicas.
         self.node_hex = ""
+        # Sharded replica groups: the gang behind this logical replica
+        # (None for plain single-actor replicas). `handle` is rank 0 —
+        # the only endpoint routers ever see; lifecycle ops (ping
+        # promotion, health check, stop) treat the gang as one unit.
+        self.group = None
 
 
 class _DeploymentInfo:
@@ -161,7 +166,13 @@ class ServeController:
                     (info.user_cls, info.init_args, info.init_kwargs, cfg)),
                 "target": info.target,
                 "next_replica_seq": info.next_replica_seq,
-                "replica_ids": [r.replica_id for r in info.replicas],
+                # Groups are never re-adopted (a gang with a dead owner
+                # restarts as a unit); their descriptions are kept so
+                # restore can kill stale rank actors and release the pg.
+                "replica_ids": [r.replica_id for r in info.replicas
+                                if r.group is None],
+                "groups": [r.group.describe() for r in info.replicas
+                           if r.group is not None],
             }
         payload = pickle.dumps(
             {"deployments": state, "proxy_cfg": self._proxy_cfg})
@@ -288,6 +299,12 @@ class ServeController:
                 rep = _ReplicaInfo(handle, replica_id)
                 rep.state = REPLICA_STARTING  # re-proven by reconcile ping
                 info.replicas.append(rep)
+            # Stale gangs from the dead controller's tenure: kill every
+            # rank and release the placement group — reconcile spawns
+            # fresh groups (a gang only ever restarts as a unit, and its
+            # group_id/rendezvous must be fresh per incarnation).
+            for desc in rec.get("groups", ()):
+                _cleanup_stale_group(desc)
             self._deployments[name] = info
             logger.info("serve: restored deployment %s (re-adopted %d/%d "
                         "replicas)", name, len(info.replicas),
@@ -431,6 +448,10 @@ class ServeController:
                 "ongoing": sum(r.last_ongoing for r in info.replicas),
                 "cold_start_ms": info.last_cold_start_ms,
             }
+            if info.config.shard_spec is not None:
+                spec = info.config.shard_spec
+                out[name]["shard"] = {"world_size": spec.world_size,
+                                      "tp": spec.tp}
         return out
 
     async def graceful_shutdown(self) -> None:
@@ -566,7 +587,7 @@ class ServeController:
             for rep in [r for r in info.replicas
                         if r.state == REPLICA_STARTING]:
                 state, node = await loop.run_in_executor(
-                    None, functools.partial(_try_ping, rep.handle, 0.05))
+                    None, functools.partial(_try_ping_replica, rep, 0.05))
                 if state == "ok":
                     if node:
                         rep.node_hex = node
@@ -770,6 +791,8 @@ class ServeController:
         import ray_tpu
         from ray_tpu.serve.replica import Replica
 
+        if info.config.shard_spec is not None:
+            return self._start_replica_group(name, info)
         replica_id = f"{name}#{info.next_replica_seq}"
         info.next_replica_seq += 1
         opts = dict(info.config.ray_actor_options)
@@ -784,10 +807,59 @@ class ServeController:
         logger.info("serve: starting replica %s", replica_id)
         return _ReplicaInfo(handle, replica_id)
 
+    def _start_replica_group(self, name: str, info: _DeploymentInfo):
+        """One logical replica = one gang: shard_spec.world_size rank
+        actors on a fresh placement group. Rank 0 keeps the plain
+        replica's name (SERVE_REPLICA::<id>) so routing, the dataplane
+        and by-name test hooks are oblivious; ranks > 0 are
+        SERVE_RANK::<id>#r<k>. Creation is non-blocking (wait_ready=
+        False): the STARTING->RUNNING ping loop owns promotion, and a
+        rank that never comes up trips the startup timeout, which stops
+        the whole gang (all-or-nothing by way of the lifecycle)."""
+        from ray_tpu.serve.replica import Replica
+        from ray_tpu.shardgroup import create_gang
+
+        spec = info.config.shard_spec
+        replica_id = f"{name}#{info.next_replica_seq}"
+        info.next_replica_seq += 1
+        base_opts = dict(info.config.ray_actor_options)
+        base_opts.setdefault("num_cpus", 0.05)
+        base_opts["max_concurrency"] = info.config.max_concurrent_queries + 8
+        base_opts["namespace"] = SERVE_NAMESPACE
+
+        def rank_options(rank: int):
+            opts = dict(base_opts)
+            opts["name"] = (f"SERVE_REPLICA::{replica_id}" if rank == 0
+                            else f"SERVE_RANK::{replica_id}#r{rank}")
+            return opts
+
+        def rank_args(rank: int):
+            ctx = {"group_id": replica_id, "rank": rank,
+                   "world_size": spec.world_size, "tp": spec.tp,
+                   "spmd": spec.world_size > 1}
+            return ((name, info.user_cls, info.init_args,
+                     info.init_kwargs, replica_id), {"shard_ctx": ctx})
+
+        group = create_gang(
+            Replica, spec, group_id=replica_id,
+            bundle=spec.rank_bundle(base_opts),
+            rank_options=rank_options, rank_args=rank_args,
+            wait_ready=False)
+        logger.info("serve: starting replica group %s (world=%d, tp=%d)",
+                    replica_id, spec.world_size, spec.tp)
+        rep = _ReplicaInfo(group.handle, replica_id)
+        rep.group = group
+        return rep
+
     def _stop_replica(self, rep: _ReplicaInfo, graceful: bool = True):
         import ray_tpu
 
         rep.state = "STOPPING"
+        if rep.group is not None:
+            # Gangs die as a unit: every rank AND the placement group
+            # (bundle release) — a half-alive gang is never left behind.
+            rep.group.kill(graceful_timeout_s=1.0 if graceful else 0.0)
+            return
         try:
             if graceful:
                 rep.handle.prepare_shutdown.remote(1.0)
@@ -842,6 +914,56 @@ def _try_proxy_port(handle) -> Optional[int]:
         return None
 
 
+def _cleanup_stale_group(desc: Dict[str, Any]) -> None:
+    """Tear down a gang recorded in a dead controller's checkpoint:
+    best-effort kill of every rank actor by name, then release the
+    placement group's bundles."""
+    import ray_tpu
+    from ray_tpu.core.ids import PlacementGroupID
+    from ray_tpu.util.placement_group import (
+        PlacementGroup,
+        remove_placement_group,
+    )
+
+    for rank_name in desc.get("rank_names", ()):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(rank_name,
+                                           namespace=SERVE_NAMESPACE))
+        except Exception:  # noqa: BLE001 — died with the controller
+            pass
+    if desc.get("pg_id"):
+        try:
+            remove_placement_group(PlacementGroup(
+                PlacementGroupID.from_hex(desc["pg_id"]),
+                desc.get("bundles") or [], desc.get("strategy") or "PACK"))
+        except Exception:  # noqa: BLE001 — already removed
+            logger.debug("serve: stale group pg removal failed",
+                         exc_info=True)
+
+
+def _try_ping_replica(rep: _ReplicaInfo, timeout_s: float) -> tuple:
+    """Group-aware STARTING probe: a plain replica is its own ping; a
+    gang is "ok" only when EVERY rank answers (coordinated mesh bring-up
+    finished everywhere), "dead" as soon as ANY rank died — the startup
+    path then stops the whole gang (all-or-nothing), releasing its
+    placement group."""
+    if rep.group is None:
+        return _try_ping(rep.handle, timeout_s)
+    state, node = _try_ping(rep.handle, timeout_s)
+    if state == "dead":
+        return "dead", ""
+    # Rank 0 was just probed (it carries the node id); sweep only the
+    # other ranks so each STARTING tick costs world_size pings, not
+    # world_size + 1.
+    statuses = rep.group.ping_all(
+        timeout_s=timeout_s, indices=range(1, rep.group.world_size))
+    if any(s == "dead" for s in statuses):
+        return "dead", ""
+    if state == "ok" and all(s == "ok" for s in statuses):
+        return "ok", node
+    return "pending", node
+
+
 def _try_ping(handle, timeout_s: float) -> tuple:
     """Returns ("ok" | "pending" | "dead", node_hex) — a resolved-but-
     errored ping is a dead replica, not a slow one. The node id rides the
@@ -877,4 +999,14 @@ def _gather_stats(replicas) -> list:
             out.append(ray_tpu.get(ref, timeout=1.0))
         except Exception:  # noqa: BLE001
             out.append(None)
+    # Gang liveness rides the same health check: a group whose rank 0
+    # still answers but whose rank k died reports as DEAD — the
+    # controller then kills and restarts the gang as one unit (any rank
+    # death is a group death; docs/SHARDED.md failure semantics).
+    for i, rep in enumerate(replicas):
+        if out[i] is not None and rep.group is not None:
+            # Rank 0 already answered stats above — sweep only ranks > 0.
+            if rep.group.dead_ranks(timeout_s=1.0,
+                                    indices=range(1, rep.group.world_size)):
+                out[i] = None
     return out

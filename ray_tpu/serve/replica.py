@@ -29,9 +29,19 @@ class Replica:
     (stats/ping/prepare_shutdown) never starve behind user requests."""
 
     def __init__(self, deployment_name: str, user_cls, init_args,
-                 init_kwargs, replica_id: str = ""):
+                 init_kwargs, replica_id: str = "", shard_ctx=None):
         self._deployment = deployment_name
         self._replica_id = replica_id
+        # Sharded replica groups: activate this rank's shard context
+        # BEFORE user code runs — mesh bring-up (and, on SPMD backends,
+        # jax.distributed) must win the race with the deployment ctor's
+        # first jax computation (XLA backends freeze on first use). The
+        # deployment reads its mesh via `shardgroup.current_mesh()`.
+        self._shard_ctx = None
+        if shard_ctx is not None:
+            from ray_tpu import shardgroup
+
+            self._shard_ctx = shardgroup.activate(shard_ctx)
         self._user = user_cls(*init_args, **(init_kwargs or {}))
         self._asgi_app = self._resolve_asgi_app(user_cls)
         self._ongoing = 0
@@ -621,6 +631,8 @@ class Replica:
                 "requests": dataplane.COUNTERS["raw_dispatch_requests"],
             },
         }
+        if self._shard_ctx is not None:
+            out["shard"] = self._shard_ctx.as_dict()
         # User-exported metrics (e.g. the inference engine's queue depth
         # and tokens/s): the controller folds `queue_depth` into its
         # autoscaling signal so backlog inside the deployment counts as

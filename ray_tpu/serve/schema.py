@@ -31,7 +31,8 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.serve.config import AutoscalingConfig
 
 _DEPLOYMENT_KEYS = {"name", "num_replicas", "max_concurrent_queries",
-                    "autoscaling", "route_prefix", "ray_actor_options"}
+                    "autoscaling", "route_prefix", "ray_actor_options",
+                    "shard_spec"}
 
 
 def validate_config(config: Dict[str, Any]) -> None:
@@ -100,6 +101,10 @@ def _apply_overrides(app, overrides: List[Dict[str, Any]]):
         if "autoscaling" in o and o["autoscaling"] is not None:
             kwargs["autoscaling_config"] = AutoscalingConfig(
                 **o["autoscaling"])
+        if "shard_spec" in o and o["shard_spec"] is not None:
+            from ray_tpu.shardgroup import ShardSpec
+
+            kwargs["shard_spec"] = ShardSpec(**o["shard_spec"])
         return dep.options(**kwargs) if kwargs else dep
 
     def rebuild(node):
@@ -146,6 +151,8 @@ def build(app) -> Dict[str, Any]:
             entry["ray_actor_options"] = dict(cfg.ray_actor_options)
         if cfg.autoscaling is not None:
             entry["autoscaling"] = asdict(cfg.autoscaling)
+        if cfg.shard_spec is not None:
+            entry["shard_spec"] = asdict(cfg.shard_spec)
         deployments.append(entry)
     return {"applications": [{"name": "default",
                               "import_path": "<module>:<app>",

@@ -1,0 +1,27 @@
+"""ray_tpu.shardgroup — gang-scheduled sharded replica groups.
+
+Makes N rank actors spanning hosts look like ONE logical replica: atomic
+all-or-nothing gang creation on a placement group, coordinated tp-mesh
+bring-up (rank 0 coordinates `jax.distributed`; every rank builds the
+same cross-host Mesh), group-level lifecycle (any rank death kills and
+restarts the whole gang), and a group handle the serve router/dataplane
+treat as a single replica — requests land on rank 0, which drives the
+SPMD step. See docs/SHARDED.md.
+"""
+
+from ray_tpu.shardgroup.gang import create_gang, create_replica_group
+from ray_tpu.shardgroup.group import GangError, GangMonitor, ReplicaGroup
+from ray_tpu.shardgroup.runtime import (
+    ShardContext,
+    activate,
+    current,
+    current_mesh,
+    deactivate,
+)
+from ray_tpu.shardgroup.spec import ShardSpec
+
+__all__ = [
+    "GangError", "GangMonitor", "ReplicaGroup", "ShardContext",
+    "ShardSpec", "activate", "create_gang", "create_replica_group",
+    "current", "current_mesh", "deactivate",
+]

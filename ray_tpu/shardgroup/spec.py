@@ -1,0 +1,81 @@
+"""ShardSpec: the declarative shape of a sharded replica group.
+
+One logical serve replica (or train worker "super-rank") may be a GANG of
+`world_size` rank actors spanning hosts, together driving one pjit
+program over a `tp`-wide tensor-parallel device mesh.  The spec is pure
+data — serve's `DeploymentConfig` carries it, the controller hands it to
+the gang scheduler (`shardgroup.gang`), and every rank receives its
+per-rank slice as a `ShardContext` (`shardgroup.runtime`).
+
+TPU mapping: a llama-70B replica on a v5e-16 is
+``ShardSpec(tp=16, world_size=4, strategy="STRICT_SPREAD",
+bundle={"TPU": 4})`` — four hosts of four chips, one bundle per host, the
+mesh's tp axis laid over all 16 chips via `jax.distributed`.  On the CPU
+test backend (no cross-process XLA), `world_size > 1` gangs still
+exercise every gang-scheduling/lifecycle path while the mesh itself is
+per-process over `--xla_force_host_platform_device_count` devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+def resources_of(actor_options: Optional[Dict] = None) -> Dict[str, float]:
+    """Actor options -> the resource dict they actually request. The
+    SINGLE translation both sides of the bundle contract use: what
+    `rank_bundle` reserves and what the gang's fail-fast overflow check
+    compares against must never disagree."""
+    opts = actor_options or {}
+    resources: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        resources["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        resources["TPU"] = float(opts["num_tpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        resources[k] = float(v)
+    return resources
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """Gang shape for one logical replica.
+
+    tp          tensor-parallel width: the size of the mesh's "tp" axis
+                (attention heads / MLP hidden / vocab shard over it, the
+                paged KV arena shards its kv-head dim with it).
+    world_size  number of rank ACTORS (processes/hosts) in the gang.
+    strategy    placement-group strategy for the gang's bundles
+                ("PACK" for single-host tests, "STRICT_SPREAD" for one
+                rank per host on a pod).
+    bundle      per-rank resource bundle; empty means "derive from the
+                deployment's ray_actor_options, default {CPU: 0.1}".
+    """
+
+    tp: int = 1
+    world_size: int = 1
+    strategy: str = "PACK"
+    bundle: Dict[str, float] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.tp < 1 or self.world_size < 1:
+            raise ValueError(
+                f"ShardSpec needs tp >= 1 and world_size >= 1, got "
+                f"tp={self.tp} world_size={self.world_size}")
+        if self.tp > 1 and self.tp % self.world_size:
+            raise ValueError(
+                f"tp={self.tp} must be divisible by world_size="
+                f"{self.world_size} (every rank hosts tp/world_size "
+                "contiguous mesh columns)")
+
+    @property
+    def tp_per_rank(self) -> int:
+        return max(1, self.tp // self.world_size)
+
+    def rank_bundle(self, actor_options: Optional[Dict] = None
+                    ) -> Dict[str, float]:
+        """The placement-group bundle one rank reserves."""
+        if self.bundle:
+            return {k: float(v) for k, v in self.bundle.items()}
+        return resources_of(actor_options) or {"CPU": 0.1}

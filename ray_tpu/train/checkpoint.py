@@ -56,6 +56,20 @@ class Checkpoint:
         save_pytree(os.path.join(path, "pytree"), tree)
         return cls.from_directory(path)
 
+    @classmethod
+    def from_sharded_pytree(cls, tree: Any, path: Optional[str] = None,
+                            process_index: int = 0, process_count: int = 1,
+                            meta: Optional[Dict[str, Any]] = None
+                            ) -> "Checkpoint":
+        """Shard-aware variant of from_pytree: each rank writes only its
+        addressable shards + an index manifest (see save_sharded_pytree);
+        restore via get_sharded_pytree reshards to ANY tp width."""
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        save_sharded_pytree(os.path.join(path, "sharded"), tree,
+                            process_index=process_index,
+                            process_count=process_count, meta=meta)
+        return cls.from_directory(path)
+
     # -- views ---------------------------------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -82,6 +96,12 @@ class Checkpoint:
     def get_pytree(self, target: Any = None) -> Any:
         assert self._path, "pytree checkpoints are directory-backed"
         return restore_pytree(os.path.join(self._path, "pytree"), target)
+
+    def get_sharded_pytree(self, target: Any = None,
+                           shardings: Any = None) -> Any:
+        assert self._path, "sharded checkpoints are directory-backed"
+        return restore_sharded_pytree(os.path.join(self._path, "sharded"),
+                                      target=target, shardings=shardings)
 
     def to_uri(self, uri: str) -> str:
         """Persist to a URI and return it; cloud schemes upload through
@@ -174,6 +194,259 @@ def unbox_value_nodes(tree: Any) -> Any:
             return tree["value"]
         return {k: unbox_value_nodes(v) for k, v in tree.items()}
     return tree
+
+
+# --------------------------------------------------------------------------- #
+# Shard-aware checkpoints: per-rank shard files + an index manifest
+# --------------------------------------------------------------------------- #
+#
+# A tp-sharded model must checkpoint WITHOUT host-gathering the whole
+# pytree on one process: each rank writes only its addressable shards as
+# raw little-endian files (np.save chokes on bfloat16; raw bytes +
+# dtype-in-manifest is bit-exact by construction) plus a per-rank
+# manifest; rank 0 merges them into one index (`manifest.json`) mapping
+# every leaf to {shape, dtype, shards: [{file, index}]}. Restore
+# assembles each leaf from its shard slices and re-places it under ANY
+# sharding — a tp=2 save restores onto a tp=1 or tp=4 mesh bit-exactly,
+# because resharding raw bytes is pure slicing, no arithmetic.
+
+_SHARD_MANIFEST = "manifest.json"
+
+
+def _shard_key(key_path) -> str:
+    """Stable, readable leaf key from a jax KeyPath: dict keys and
+    attribute names joined by "/" (flax boxes surface as a trailing
+    "value" level — the same shape `unbox_value_nodes` collapses)."""
+    parts = []
+    for entry in key_path:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", None)
+        if name is None:
+            name = getattr(entry, "idx", None)
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered by jax; covers bfloat16 & friends
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _norm_index(index, shape) -> list:
+    """A shard's slice tuple -> [[start, stop], ...] (JSON-safe)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _sanitize(key: str) -> str:
+    """Filesystem-safe shard-file stem. Distinct keys can sanitize to
+    the same text ('a/b_c' vs 'a_b/c'), so a crc of the ORIGINAL key is
+    appended — two leaves must never share a shard file."""
+    import zlib
+
+    text = "".join(c if c.isalnum() or c in "._-" else "_" for c in key)
+    return f"{text}.{zlib.crc32(key.encode()):08x}"
+
+
+def save_sharded_pytree(path: str, tree: Any, process_index: int = 0,
+                        process_count: int = 1,
+                        meta: Optional[Dict[str, Any]] = None) -> str:
+    """Save this process's shards of `tree` under `path`. Single-process
+    saves are complete immediately; multi-process saves need every rank
+    to call this, then rank 0 to call `merge_sharded_manifest` (after a
+    barrier) to write the unified index."""
+    import jax
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    entries: Dict[str, Any] = {}
+    for key_path, leaf in flat:
+        key = _shard_key(key_path)
+        shards = []
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            arr = leaf
+            shape = tuple(arr.shape)
+            dtype = arr.dtype.name
+            seen = set()
+            fully_replicated = arr.sharding.is_fully_replicated
+            if fully_replicated and process_index != 0:
+                # Every rank holds the whole value; rank 0's copy wins.
+                entries[key] = {"shape": list(shape), "dtype": dtype,
+                                "shards": []}
+                continue
+            for s in arr.addressable_shards:
+                idx = _norm_index(s.index, shape)
+                tkey = tuple(map(tuple, idx))
+                if tkey in seen:
+                    continue  # replicated copy on another local device
+                seen.add(tkey)
+                fname = (f"{_sanitize(key)}.p{process_index}"
+                         f".s{len(shards)}.bin")
+                data = np.ascontiguousarray(np.asarray(s.data))
+                with open(os.path.join(path, fname), "wb") as f:
+                    f.write(data.tobytes())
+                shards.append({"file": fname, "index": idx})
+        else:
+            data = np.ascontiguousarray(np.asarray(leaf))
+            shape, dtype = tuple(data.shape), data.dtype.name
+            if process_index == 0:
+                fname = f"{_sanitize(key)}.p0.s0.bin"
+                with open(os.path.join(path, fname), "wb") as f:
+                    f.write(data.tobytes())
+                shards.append({"file": fname,
+                               "index": _norm_index(
+                                   tuple(slice(0, d) for d in shape),
+                                   shape)})
+        entries[key] = {"shape": list(shape), "dtype": dtype,
+                        "shards": shards}
+    rank_manifest = {"process_index": process_index,
+                     "process_count": process_count,
+                     "meta": dict(meta or {}), "entries": entries}
+    with open(os.path.join(path, f"manifest.p{process_index}.json"),
+              "w") as f:
+        json.dump(rank_manifest, f)
+    if process_count == 1:
+        merge_sharded_manifest(path, process_count=1)
+    return path
+
+
+def merge_sharded_manifest(path: str, process_count: int) -> str:
+    """Merge every rank's manifest into the single restore index —
+    called by rank 0 AFTER all ranks finished saving (the caller owns
+    the barrier; `train.session`/collective barrier or the gang's
+    broadcast both work). Validates full coverage of every leaf."""
+    merged: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {}
+    for p in range(process_count):
+        with open(os.path.join(path, f"manifest.p{p}.json")) as f:
+            rank_manifest = json.load(f)
+        meta.update(rank_manifest.get("meta") or {})
+        for key, entry in rank_manifest["entries"].items():
+            into = merged.setdefault(
+                key, {"shape": entry["shape"], "dtype": entry["dtype"],
+                      "shards": []})
+            if into["shape"] != entry["shape"] \
+                    or into["dtype"] != entry["dtype"]:
+                raise ValueError(
+                    f"sharded checkpoint {path}: leaf {key!r} disagrees "
+                    f"across ranks ({into['shape']}/{into['dtype']} vs "
+                    f"{entry['shape']}/{entry['dtype']})")
+            seen = {tuple(map(tuple, s["index"])) for s in into["shards"]}
+            for s in entry["shards"]:
+                if tuple(map(tuple, s["index"])) not in seen:
+                    into["shards"].append(s)
+    import math
+
+    for key, entry in merged.items():
+        total = math.prod(entry["shape"]) if entry["shape"] else 1
+        shards = entry["shards"]
+        # Overlap would let the volume sum mask a genuinely missing
+        # region (restore fills np.empty garbage there) — a save's
+        # shards partition the array, so ANY overlap is a corrupt
+        # manifest, and with none the volume sum is an exact check.
+        for i in range(len(shards)):
+            for j in range(i + 1, len(shards)):
+                if all(a1 < b2 and a2 < b1
+                       for (a1, b1), (a2, b2)
+                       in zip(shards[i]["index"], shards[j]["index"])):
+                    raise ValueError(
+                        f"sharded checkpoint {path}: leaf {key!r} has "
+                        f"overlapping shards {shards[i]['index']} and "
+                        f"{shards[j]['index']} — manifests disagree on "
+                        "the partitioning")
+        covered = sum(
+            math.prod(max(0, b - a) for a, b in s["index"]) if s["index"]
+            else 1
+            for s in shards)
+        if covered < total:
+            raise ValueError(
+                f"sharded checkpoint {path}: leaf {key!r} covers only "
+                f"{covered}/{total} elements — a rank's shards are "
+                "missing (did every rank save before the merge?)")
+    with open(os.path.join(path, _SHARD_MANIFEST), "w") as f:
+        json.dump({"process_count": process_count, "meta": meta,
+                   "entries": merged}, f)
+    return path
+
+
+def sharded_manifest(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, _SHARD_MANIFEST)) as f:
+        return json.load(f)
+
+
+def restore_sharded_pytree(path: str, target: Any = None,
+                           shardings: Any = None) -> Any:
+    """Restore a sharded checkpoint, resharding as needed.
+
+    - `target`: a pytree with the SAME structure as the saved one (e.g.
+      `jax.eval_shape` of the model init); leaves are replaced by the
+      restored arrays. Without it a nested dict keyed by the manifest
+      paths is returned (flax boxes appear as {'value': leaf} — see
+      `unbox_value_nodes`).
+    - `shardings`: optional pytree of shardings matching the result (or
+      a single sharding applied to every leaf); leaves are device_put
+      into it — THIS is the resharding path, bit-exact for any source/
+      target tp width because assembly and re-slicing move raw bytes.
+    """
+    import numpy as np
+
+    manifest = sharded_manifest(path)
+    arrays: Dict[str, Any] = {}
+    for key, entry in manifest["entries"].items():
+        shape = tuple(entry["shape"])
+        dtype = _np_dtype(entry["dtype"])
+        out = np.empty(shape, dtype)
+        for s in entry["shards"]:
+            idx = tuple(slice(a, b) for a, b in s["index"])
+            sub_shape = tuple(b - a for a, b in s["index"])
+            with open(os.path.join(path, s["file"]), "rb") as f:
+                data = np.frombuffer(f.read(), dtype=dtype)
+            out[idx] = data.reshape(sub_shape)
+        arrays[key] = out
+
+    if target is not None:
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        leaves = []
+        for key_path, _ in flat:
+            key = _shard_key(key_path)
+            if key not in arrays:
+                raise KeyError(
+                    f"sharded checkpoint {path} has no leaf {key!r} "
+                    f"(has: {sorted(arrays)[:8]}...)")
+            leaves.append(arrays[key])
+        result = jax.tree_util.tree_unflatten(treedef, leaves)
+    else:
+        result: Dict[str, Any] = {}
+        for key, arr in arrays.items():
+            node = result
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = arr
+
+    if shardings is not None:
+        import jax
+
+        if isinstance(shardings, jax.sharding.Sharding):
+            result = jax.tree.map(
+                lambda x: jax.device_put(x, shardings), result)
+        else:
+            result = jax.device_put(result, shardings)
+    return result
 
 
 # --------------------------------------------------------------------------- #

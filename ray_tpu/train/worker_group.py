@@ -41,6 +41,10 @@ class TrainWorker:
     def execute(self, fn: Callable, *args, **kwargs):
         return fn(*args, **kwargs)
 
+    def ping(self) -> Dict[str, Any]:
+        """Liveness probe for the group's death monitor."""
+        return {"ok": True, "rank": self.rank}
+
     def node_info(self):
         import socket
 
@@ -181,37 +185,98 @@ class WorkerGroup:
                                        strategy=placement_strategy)
             self._pg.ready(timeout=120)
         self.workers = []
-        for rank in range(num_workers):
-            opts = dict(options)
-            opts["num_cpus"] = num_cpus
-            if num_tpus:
-                opts["num_tpus"] = num_tpus
-            if resources:
-                opts["resources"] = dict(resources)
-            if self._pg is not None:
-                from ray_tpu.util.scheduling_strategies import (
-                    PlacementGroupSchedulingStrategy,
-                )
+        self._dead_rank: Optional[int] = None
+        self._monitor = None
+        try:
+            for rank in range(num_workers):
+                opts = dict(options)
+                opts["num_cpus"] = num_cpus
+                if num_tpus:
+                    opts["num_tpus"] = num_tpus
+                if resources:
+                    opts["resources"] = dict(resources)
+                if self._pg is not None:
+                    from ray_tpu.util.scheduling_strategies import (
+                        PlacementGroupSchedulingStrategy,
+                    )
 
-                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
-                    self._pg, placement_group_bundle_index=rank)
-            self.workers.append(
-                actor_cls.options(**opts).remote(rank, num_workers))
+                    opts["scheduling_strategy"] = \
+                        PlacementGroupSchedulingStrategy(
+                            self._pg, placement_group_bundle_index=rank)
+                try:
+                    handle = actor_cls.options(**opts).remote(
+                        rank, num_workers)
+                except Exception as e:
+                    raise RuntimeError(
+                        f"creating train worker rank {rank}/{num_workers} "
+                        f"failed: {type(e).__name__}: {e}") from e
+                self.workers.append(handle)
+        except Exception:
+            # All-or-nothing (raylint RL009): a mid-gang failure releases
+            # every already-created worker AND the placement group's
+            # bundles — no leaked reservations, no half-alive gangs.
+            self._abort_gang()
+            raise
+        if num_workers > 1:
+            # Group death hook: a dead worker fails the next execute()
+            # fast with a rank-attributed error instead of a generic
+            # actor error minutes later (gradient sync would otherwise
+            # discover it at the collective timeout).
+            from ray_tpu.shardgroup import GangMonitor, ReplicaGroup, ShardSpec
+
+            grp = ReplicaGroup(
+                f"train-wg-{id(self):x}", ShardSpec(world_size=num_workers),
+                None, self.workers,
+                [f"rank{r}" for r in range(num_workers)])
+            self._monitor = GangMonitor(grp, self._on_worker_death)
+
+    def _abort_gang(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 — never created / dead
+                pass
+        self.workers = []
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001 — already removed
+                pass
+            self._pg = None
+
+    def _on_worker_death(self, group, rank: int):
+        self._dead_rank = rank
+
+    def _check_group_alive(self):
+        if self._dead_rank is not None:
+            raise RuntimeError(
+                f"train worker group lost rank {self._dead_rank}/"
+                f"{self.num_workers} — the group must be shut down and "
+                "recreated (workers restart as a unit)")
 
     def __len__(self):
         return self.num_workers
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        self._check_group_alive()
         return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)
                             for w in self.workers])
 
     def execute_async(self, fn: Callable, *args, **kwargs):
+        self._check_group_alive()
         return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
 
     def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        self._check_group_alive()
         return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
 
     def shutdown(self):
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor.group._dead = True
+            self._monitor = None
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

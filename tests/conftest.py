@@ -62,6 +62,27 @@ def _lock_witness_session_gate():
             + "\n".join(rep.cycles))
 
 
+@pytest.fixture(scope="session")
+def multi_device_workers():
+    """Multi-device CPU meshes in WORKER subprocesses.
+
+    The XLA_FLAGS export above runs at conftest import — before any jax
+    import and before any cluster exists — so every worker subprocess
+    (cold execs inherit os.environ; forge forks inherit the template's
+    env, and the template is spawned before XLA init) sees an 8-device
+    CPU platform. Tests that build tp meshes inside replicas/rank actors
+    take this fixture as their explicit dependency on that guarantee;
+    it asserts the flag is still exported and returns the device count.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    marker = "xla_force_host_platform_device_count="
+    assert marker in flags, (
+        "XLA_FLAGS lost the forced device count — worker meshes would "
+        f"be single-device: {flags!r}")
+    count = flags.split(marker, 1)[1].split()[0]
+    return int(count)
+
+
 @pytest.fixture(scope="module")
 def ray_start_shared():
     """Module-scoped cluster: fast, shared across a module's tests.

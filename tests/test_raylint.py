@@ -900,3 +900,148 @@ def test_rl008_suppression_for_factories(tmp_path):
         return tracer.start_span(name)  # raylint: disable=RL008
     """
     assert lint_src(tmp_path, src, rules=["RL008"]) == []
+
+
+# ------------------------------------------------------------------ RL009
+
+RL009_BAD_NAKED_GANG = """
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    def spawn_gang(cls, n):
+        pg = placement_group([{"CPU": 1}] * n)
+        handles = []
+        for rank in range(n):
+            strategy = PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=rank)
+            handles.append(ray_tpu.remote(cls).options(
+                scheduling_strategy=strategy).remote(rank))
+        return handles
+"""
+
+RL009_BAD_ABORT_ONLY = """
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group, \\
+        remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    def spawn_gang(cls, n):
+        pg = placement_group([{"CPU": 1}] * n)
+        handles = []
+        try:
+            for rank in range(n):
+                handles.append(ray_tpu.remote(cls).options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        pg, placement_group_bundle_index=rank)).remote(rank))
+        except Exception:
+            for h in handles:
+                ray_tpu.kill(h)
+            remove_placement_group(pg)
+            raise
+        return handles
+"""
+
+RL009_GOOD_FULL_DISCIPLINE = """
+    import ray_tpu
+    from ray_tpu.shardgroup import GangMonitor, ReplicaGroup, ShardSpec
+    from ray_tpu.util.placement_group import placement_group, \\
+        remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    def spawn_gang(cls, n, on_death):
+        pg = placement_group([{"CPU": 1}] * n)
+        handles = []
+        try:
+            for rank in range(n):
+                handles.append(ray_tpu.remote(cls).options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        pg, placement_group_bundle_index=rank)).remote(rank))
+        except Exception:
+            for h in handles:
+                ray_tpu.kill(h)
+            remove_placement_group(pg)
+            raise
+        group = ReplicaGroup("g", ShardSpec(world_size=n), pg, handles,
+                             [str(r) for r in range(n)])
+        GangMonitor(group, on_death)
+        return group
+"""
+
+RL009_GOOD_SINGLE_ACTOR = """
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    def spawn_one(cls, pg):
+        # One actor on a PG is not a gang — no loop, no RL009.
+        return ray_tpu.remote(cls).options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=0)).remote()
+
+    def submit_many(handles, payloads):
+        # Loops of .remote() WITHOUT a strategy construction are calls,
+        # not gang creation.
+        return [h.run.remote(p) for h, p in zip(handles, payloads)]
+"""
+
+
+def test_rl009_flags_naked_gang(tmp_path):
+    findings = lint_src(tmp_path, RL009_BAD_NAKED_GANG, rules=["RL009"])
+    assert rule_ids(findings) == ["RL009"]
+    assert "abort" in findings[0].message
+    assert "death hook" in findings[0].message
+
+
+def test_rl009_flags_abort_without_death_hook(tmp_path):
+    findings = lint_src(tmp_path, RL009_BAD_ABORT_ONLY, rules=["RL009"])
+    assert rule_ids(findings) == ["RL009"]
+    assert "death hook" in findings[0].message
+    assert "abort" not in findings[0].message.split(";")[0] or \
+        "no abort" not in findings[0].message
+
+
+def test_rl009_quiet_on_full_discipline(tmp_path):
+    assert lint_src(tmp_path, RL009_GOOD_FULL_DISCIPLINE,
+                    rules=["RL009"]) == []
+
+
+def test_rl009_quiet_on_non_gang_shapes(tmp_path):
+    assert lint_src(tmp_path, RL009_GOOD_SINGLE_ACTOR,
+                    rules=["RL009"]) == []
+
+
+def test_rl009_suppression(tmp_path):
+    src = RL009_BAD_NAKED_GANG.replace(
+        "for rank in range(n):",
+        "for rank in range(n):  # raylint: disable=RL009")
+    assert lint_src(tmp_path, src, rules=["RL009"]) == []
+
+
+RL009_BAD_OPTIONS_CHAIN = """
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    def spawn_gang(actor_cls, pg, n):
+        # The dominant real shape: `.remote()` hangs off an options()
+        # CALL, so it has no dotted name — must still count as a gang.
+        handles = []
+        for rank in range(n):
+            handles.append(actor_cls.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    pg, placement_group_bundle_index=rank)).remote(rank))
+        return handles
+"""
+
+
+def test_rl009_flags_options_chain_gang(tmp_path):
+    findings = lint_src(tmp_path, RL009_BAD_OPTIONS_CHAIN, rules=["RL009"])
+    assert rule_ids(findings) == ["RL009"]

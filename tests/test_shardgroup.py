@@ -1,0 +1,395 @@
+"""Sharded replica groups (ISSUE 9): gang scheduling, tensor-parallel
+serving, resharding checkpoints.
+
+Strategy mirrors the serve suites: pure logic (ShardSpec validation,
+engine tp parity, checkpoint resharding) runs in-driver on the forced
+8-device CPU platform; gang lifecycle (all-or-nothing abort, rank-death
+group restart with dataplane failover, scale-to-zero groups) runs end to
+end on an in-process cluster where rank actors are real worker
+subprocesses inheriting the multi-device env (`multi_device_workers`).
+"""
+
+import concurrent.futures
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve, shardgroup
+
+
+@pytest.fixture()
+def shard_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _post(port, path, payload, timeout=60):
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ------------------------------------------------------------------ spec
+
+
+def test_shard_spec_validation():
+    assert shardgroup.ShardSpec(tp=4, world_size=2).tp_per_rank == 2
+    with pytest.raises(ValueError):
+        shardgroup.ShardSpec(tp=0)
+    with pytest.raises(ValueError):
+        shardgroup.ShardSpec(tp=3, world_size=2)
+    # A pure gang without tensor parallelism is legal (tp=1, ws=N).
+    assert shardgroup.ShardSpec(tp=1, world_size=3).tp_per_rank == 1
+    # Bundle derivation: explicit bundle wins, else actor options.
+    spec = shardgroup.ShardSpec(tp=2, bundle={"CPU": 2})
+    assert spec.rank_bundle({"num_cpus": 8}) == {"CPU": 2.0}
+    assert shardgroup.ShardSpec(tp=2).rank_bundle(
+        {"num_cpus": 1, "resources": {"TPU-v5e": 4}}) == \
+        {"CPU": 1.0, "TPU-v5e": 4.0}
+
+
+def test_llama_tp_validation():
+    from ray_tpu.models.llama import LlamaConfig, validate_tp
+
+    cfg = LlamaConfig.tiny()
+    validate_tp(cfg, 2)               # 4 heads / 2 kv heads / 352 / 512
+    with pytest.raises(ValueError):
+        validate_tp(cfg, 8)           # kv heads (2) don't split 8 ways
+
+
+def test_worker_sees_forced_devices(multi_device_workers, shard_cluster):
+    """The conftest env export reaches worker subprocesses: a task in a
+    worker sees the same forced device count as the driver."""
+
+    @ray_tpu.remote
+    def count_devices():
+        import jax
+
+        return len(jax.devices())
+
+    assert ray_tpu.get(count_devices.remote(),
+                       timeout=120) == multi_device_workers
+
+
+# --------------------------------------------------- engine tp parity
+
+
+def test_engine_tp_decode_parity_and_compile_once(multi_device_workers):
+    """Satellite: sharded-vs-single-host decode parity on the CPU mesh —
+    a tp=2 engine (params AND paged arena sharded) emits token-for-token
+    what the single-device engine emits, with the compile-once
+    discipline intact on both."""
+    import jax
+
+    from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg = EngineConfig(model_size="tiny", max_model_len=128)
+    mesh = build_mesh(MeshSpec({"tp": 2}), devices=jax.devices()[:2])
+    outs = {}
+    for name, engine in (("single", InferenceEngine(cfg)),
+                         ("tp2", InferenceEngine(cfg, mesh=mesh))):
+        reqs = [engine.add_request([1, 2, 3, 4, 5], max_new_tokens=10),
+                engine.add_request([7, 8, 9], max_new_tokens=8)]
+        engine.run_until_idle()
+        outs[name] = [list(r.generated) for r in reqs]
+        engine.check_no_leaks()
+        stats = engine.stats()
+        assert stats["prefill_compiles"] == 1, (name, stats)
+        assert stats["decode_compiles"] == 1, (name, stats)
+    assert outs["single"] == outs["tp2"]
+    # The arena really is sharded on its kv-head dim.
+    engine_tp = InferenceEngine(cfg, mesh=mesh)
+    spec = engine_tp._arenas[0][0].sharding.spec
+    assert tuple(spec) == (None, None, "tp")
+
+
+# ------------------------------------------------ resharding checkpoints
+
+
+def test_resharding_roundtrip_bit_exact(multi_device_workers, tmp_path):
+    """Satellite: tp=2 save -> tp=1 and tp=4 restore, bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        shard_params_tp,
+        tp_shardings,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train.checkpoint import (
+        Checkpoint,
+        restore_sharded_pytree,
+        save_sharded_pytree,
+        sharded_manifest,
+    )
+
+    model = Llama(LlamaConfig.tiny(seq=64))
+    params = jax.jit(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))()
+    mesh2 = build_mesh(MeshSpec({"tp": 2}), devices=jax.devices()[:2])
+    mesh4 = build_mesh(MeshSpec({"tp": 4}), devices=jax.devices()[:4])
+    params_tp2 = shard_params_tp(model, params, mesh2)
+
+    path = str(tmp_path / "ckpt")
+    save_sharded_pytree(path, params_tp2, meta={"tp": 2})
+    assert sharded_manifest(path)["meta"]["tp"] == 2
+
+    target = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))
+    base = [np.asarray(x) for x in jax.tree.leaves(params)]
+
+    restored_host = restore_sharded_pytree(path, target=target)
+    restored_tp4 = restore_sharded_pytree(
+        path, target=target, shardings=tp_shardings(model, mesh4))
+    for restored in (restored_host, restored_tp4):
+        got = [np.asarray(x) for x in jax.tree.leaves(restored)]
+        assert len(got) == len(base)
+        for a, b in zip(base, got):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+
+    # Functional check: resharded params drive the model to the same
+    # logits the original params produce (bf16 partial-sum order differs
+    # across shardings, so this is close-to, not bitwise — bitwise is
+    # asserted on the PARAMS above, and greedy-decode parity end to end
+    # in test_engine_tp_decode_parity_and_compile_once).
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    ref = np.asarray(model.apply(params, ids), np.float32)
+    out = np.asarray(model.apply(restored_tp4, ids), np.float32)
+    np.testing.assert_allclose(out, ref, atol=0.02, rtol=0)
+
+    # Checkpoint-object front door.
+    ck = Checkpoint.from_sharded_pytree(params_tp2,
+                                        path=str(tmp_path / "ck2"))
+    again = ck.get_sharded_pytree(target=target)
+    for a, b in zip(base, [np.asarray(x) for x in jax.tree.leaves(again)]):
+        assert np.array_equal(a, b)
+
+
+def test_sharded_manifest_detects_missing_rank(tmp_path):
+    """A merge over an incomplete rank set (a rank never saved) fails
+    the coverage check instead of silently restoring garbage."""
+    import json as _json
+    import os
+
+    from ray_tpu.train.checkpoint import merge_sharded_manifest
+
+    path = str(tmp_path)
+    with open(os.path.join(path, "manifest.p0.json"), "w") as f:
+        _json.dump({"process_index": 0, "process_count": 2, "meta": {},
+                    "entries": {"w": {"shape": [4, 4], "dtype": "float32",
+                                      "shards": [{"file": "w.bin",
+                                                  "index": [[0, 2],
+                                                            [0, 4]]}]}}},
+                   f)
+    with open(os.path.join(path, "manifest.p1.json"), "w") as f:
+        _json.dump({"process_index": 1, "process_count": 2, "meta": {},
+                    "entries": {"w": {"shape": [4, 4], "dtype": "float32",
+                                      "shards": []}}}, f)
+    with pytest.raises(ValueError, match="covers only"):
+        merge_sharded_manifest(path, process_count=2)
+
+
+# --------------------------------------------------------- gang creation
+
+
+class _FailingRank:
+    """Deployment whose rank 2 explodes in its ctor."""
+
+    def __init__(self):
+        ctx = shardgroup.current()
+        if ctx is not None and ctx.rank == 2:
+            raise RuntimeError("rank 2 exploded in ctor")
+
+    def __call__(self, payload):
+        return payload
+
+
+def test_gang_all_or_nothing_abort(shard_cluster):
+    """Satellite: a mid-gang ctor failure aborts the WHOLE gang — one
+    rank-attributed error, every bundle released, no half-alive ranks."""
+    before = ray_tpu.available_resources().get("CPU", 0)
+    with pytest.raises(shardgroup.GangError) as err:
+        shardgroup.create_replica_group(
+            _FailingRank, shardgroup.ShardSpec(tp=1, world_size=4),
+            deployment_name="failgang", actor_options={"num_cpus": 0.5},
+            ready_timeout_s=60)
+    assert err.value.rank == 2
+    assert "rank 2" in str(err.value)
+    group_id = err.value.group_id
+    # Every bundle released (the pg is gone, reservations returned).
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if abs(ray_tpu.available_resources().get("CPU", 0) - before) < 0.01:
+            break
+        time.sleep(0.1)
+    assert abs(ray_tpu.available_resources().get("CPU", 0) - before) < 0.01
+    # No half-alive ranks: every rank actor of the gang is gone.
+    for rank in range(4):
+        with pytest.raises(Exception):
+            ray_tpu.get_actor(f"SHARDGROUP::{group_id}#r{rank}")
+
+
+def test_gang_bundle_overflow_fails_fast(shard_cluster):
+    """A rank asking for more than its bundle is a GangError in
+    milliseconds, not an unplaceable creation spinning for minutes."""
+    t0 = time.time()
+    with pytest.raises(shardgroup.GangError, match="bundle"):
+        shardgroup.create_gang(
+            _FailingRank, shardgroup.ShardSpec(tp=1, world_size=2,
+                                               bundle={"CPU": 0.1}),
+            rank_options=lambda r: {"num_cpus": 2.0})
+    assert time.time() - t0 < 5.0
+
+
+def test_gang_infeasible_pg_released(shard_cluster):
+    before = ray_tpu.available_resources().get("CPU", 0)
+    with pytest.raises(shardgroup.GangError, match="not placeable"):
+        shardgroup.create_replica_group(
+            _FailingRank,
+            shardgroup.ShardSpec(tp=1, world_size=3, bundle={"CPU": 64}),
+            deployment_name="toolarge", pg_timeout_s=2)
+    time.sleep(0.5)
+    assert abs(ray_tpu.available_resources().get("CPU", 0) - before) < 0.01
+
+
+def test_gang_monitor_fires_once_on_rank_death(shard_cluster):
+    class Idle:
+        def __call__(self, payload):
+            return payload
+
+    deaths = []
+    group = shardgroup.create_replica_group(
+        Idle, shardgroup.ShardSpec(tp=1, world_size=2),
+        deployment_name="mon",
+        on_death=lambda g, rank: deaths.append(rank))
+    assert group.check_alive(timeout_s=10)
+    ray_tpu.kill(group.ranks[1])
+    deadline = time.time() + 15
+    while not deaths and time.time() < deadline:
+        time.sleep(0.1)
+    assert deaths == [1]
+    group.kill()
+
+
+# ------------------------------------------------- serve: sharded llama
+
+
+@pytest.mark.parametrize("prompt", [[1, 2, 3, 4, 5]])
+def test_sharded_llama_http_parity(multi_device_workers, shard_cluster,
+                                   prompt):
+    """Acceptance: a tp=2 sharded llama gang serves token-for-token the
+    SAME ids as the single-device deployment through the serve HTTP
+    path (same seed -> same weights; the mesh is the only difference)."""
+    from ray_tpu.inference.api import LLMServer
+
+    plain = LLMServer.options(name="LLMPlain")
+    sharded = LLMServer.options(
+        name="LLMShard", shard_spec=serve.ShardSpec(tp=2, world_size=1))
+    serve.run(plain.bind("tiny", 128, 8), timeout_s=180)
+    serve.run(sharded.bind("tiny", 128, 8), timeout_s=180)
+    port = serve.http_port()
+    payload = {"ids": prompt, "max_new_tokens": 8}
+    status_p, body_p = _post(port, "/LLMPlain", payload, timeout=120)
+    status_s, body_s = _post(port, "/LLMShard", payload, timeout=120)
+    assert status_p == 200 and status_s == 200
+    ids_plain = json.loads(body_p)["result"]["ids"]
+    ids_sharded = json.loads(body_s)["result"]["ids"]
+    assert ids_plain[:len(prompt)] == prompt
+    assert ids_sharded == ids_plain
+    # The sharded replica really ran as a gang rank with an active
+    # shard context (not a silent single-device fallback).
+    rep = ray_tpu.get_actor("SERVE_REPLICA::LLMShard#0", namespace="serve")
+    stats = ray_tpu.get(rep.stats.remote(), timeout=30)
+    assert stats["shard"]["tp"] == 2
+    assert stats["user"]["queue_depth"] == 0
+
+
+# ------------------------------------- serve: rank death -> group restart
+
+
+def test_rank_death_failover_and_group_restart(shard_cluster):
+    """Acceptance: killing one rank of a serving group never hangs a
+    request — in-flight requests fail over per the dataplane retry-once
+    contract, and the group restarts as a unit within a bounded time."""
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=8,
+                      shard_spec=serve.ShardSpec(tp=1, world_size=2))
+    class Slow:
+        def __call__(self, payload):
+            time.sleep(0.4)
+            return {"done": payload}
+
+    serve.run(Slow.bind(), timeout_s=120)
+    port = serve.http_port()
+    _post(port, "/Slow", -1)  # warm route + connection
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        futs = [pool.submit(_post, port, "/Slow", i, 60) for i in range(16)]
+        time.sleep(0.3)
+        # Kill a NON-routed rank: the router never saw it, but its death
+        # must still take the whole group down (and back up).
+        victim = ray_tpu.get_actor("SERVE_RANK::Slow#0#r1",
+                                   namespace="serve")
+        ray_tpu.kill(victim)
+        killed_at = time.time()
+        results = [f.result() for f in futs]
+    # Every request completed exactly once; none hung, none failed.
+    assert all(status == 200 for status, _ in results)
+    # The group restarts AS A UNIT within a bounded time: a replacement
+    # replica id reaches RUNNING and the old gang is fully gone.
+    deadline = killed_at + 25
+    new_running = None
+    while time.time() < deadline:
+        replicas = serve.status().get("Slow", {}).get("replicas", {})
+        fresh = [rid for rid, state in replicas.items()
+                 if rid not in ("Slow#0",) and state == "RUNNING"]
+        if len(fresh) >= 2 and "Slow#0" not in replicas:
+            new_running = fresh
+            break
+        time.sleep(0.2)
+    assert new_running is not None, serve.status()
+    for name in ("SERVE_REPLICA::Slow#0", "SERVE_RANK::Slow#0#r1"):
+        with pytest.raises(Exception):
+            ray_tpu.get_actor(name, namespace="serve")
+    # The restarted group serves.
+    status, body = _post(port, "/Slow", 99)
+    assert status == 200 and json.loads(body) == {"result": {"done": 99}}
+
+
+def test_group_scale_to_zero_cold_start(shard_cluster):
+    """Scale-to-zero operates on WHOLE groups: a parked gang deployment
+    cold-starts all ranks on first arrival and answers from rank 0."""
+
+    @serve.deployment(
+        max_concurrent_queries=8,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=0, max_replicas=1, downscale_delay_s=60.0),
+        shard_spec=serve.ShardSpec(tp=1, world_size=2))
+    class Cold:
+        def __call__(self, payload):
+            return {"woke": payload}
+
+    serve.run(Cold.bind(), timeout_s=120)
+    assert serve.status()["Cold"]["replicas"] == {}  # deployed parked
+    port = serve.http_port()
+    status, body = _post(port, "/Cold", 7, timeout=60)
+    assert status == 200 and json.loads(body) == {"result": {"woke": 7}}
+    replicas = serve.status()["Cold"]["replicas"]
+    assert list(replicas.values()) == ["RUNNING"]
+    rid = next(iter(replicas))
+    # Both ranks of the woken gang exist.
+    ray_tpu.get_actor(f"SERVE_REPLICA::{rid}", namespace="serve")
+    ray_tpu.get_actor(f"SERVE_RANK::{rid}#r1", namespace="serve")
