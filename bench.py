@@ -1579,6 +1579,279 @@ def bench_sharded(quick: bool) -> dict:
     return out
 
 
+def _chaos_rpc_hook_aba(cluster, n_calls: int) -> dict:
+    """A-B-A inertness check for the RPC chaos hook: kv round-trip rate
+    with the filter ABSENT, with a pass-all filter INSTALLED, then absent
+    again — the disabled path is one module-global None check, and the
+    off-vs-off disagreement is the ambient noise floor that bounds what
+    "unmeasurable" means on this box."""
+    import ray_tpu
+    from ray_tpu.core.rpc import clear_chaos_filter, install_chaos_filter
+
+    runtime = ray_tpu._require_runtime()
+    runtime.gcs.call("kv_put", {"key": b"chaos:aba", "value": b"x"})
+
+    def rate() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            runtime.gcs.call("kv_get", {"key": b"chaos:aba"})
+        return n_calls / (time.perf_counter() - t0)
+
+    off_a = rate()
+    install_chaos_filter(lambda name, addr, method: None)
+    try:
+        on = rate()
+    finally:
+        clear_chaos_filter()
+    off_b = rate()
+    base = max(off_a, off_b)
+    return {
+        "chaos_rpc_hook_off_calls_per_s": round(base, 1),
+        "chaos_rpc_hook_on_calls_per_s": round(on, 1),
+        "chaos_rpc_hook_off_noise_pct": round(
+            abs(off_a - off_b) / base * 100.0, 2),
+        "chaos_rpc_hook_overhead_pct": round(
+            max(0.0, (base - on) / base * 100.0), 2),
+    }
+
+
+def bench_chaos(quick: bool, smoke: bool = False,
+                seed: int = 20260804) -> dict:
+    """Chaos-plane acceptance bench (ISSUE 10 / ROADMAP 4): a seeded
+    ChaosSchedule kills a node every ~N seconds — plus worker/forge kills
+    and (full runs) a GCS restart — while Poisson serve traffic AND a
+    checkpointing training loop run against the same cluster. Reported:
+    per-fault-class detect->recovered MTTR (`chaos_mttr_ms`), request
+    error rate, steps lost per fault, and HARD asserts: zero hangs
+    (watchdog over every parked future), every fault recovered within the
+    deadline, and the training loop provably resumed from its checkpoint
+    after each gang restart (step continuity). The event log in the
+    output IS the reproduction recipe: same seed => same log.
+
+    `smoke=True` is the gate's short variant: one node kill under light
+    serve load, deterministic seed, well under 60s, no training loop."""
+    import random as _random
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.chaos import (
+        ChaosRunner,
+        ChaosSchedule,
+        ForgeKillInjector,
+        GcsRestartInjector,
+        HangWatchdog,
+        NodeKillInjector,
+        WorkerKillInjector,
+    )
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    gcs_path = os.path.join(tempfile.mkdtemp(), "gcs_tables.bin")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 3},
+                      gcs_storage_path=gcs_path)
+    node_args = {"num_cpus": 2, "resources": {"churn": 2}}
+    n_nodes = 2 if (smoke or quick) else 3
+    for _ in range(n_nodes):
+        cluster.add_node(**node_args)
+    cluster.wait_for_nodes()
+    cluster.connect()
+    out: dict = {"chaos_seed": seed}
+    try:
+        if not smoke:
+            out.update(_chaos_rpc_hook_aba(cluster,
+                                           300 if quick else 1500))
+
+        # --- schedule + injectors -------------------------------------
+        if smoke:
+            kinds = {"node_kill": 1.0}
+            count, period = 1, 1.5
+        elif quick:
+            kinds = {"node_kill": 2.0, "worker_kill": 1.0,
+                     "forge_kill": 1.0}
+            count, period = 4, 2.5
+        else:
+            kinds = {"node_kill": 3.0, "worker_kill": 2.0,
+                     "forge_kill": 1.0, "gcs_restart": 1.0}
+            count, period = 8, 3.0
+        sched = ChaosSchedule(seed=seed, kinds=kinds, period_s=period,
+                              count=count, jitter=0.25)
+        injectors = {
+            "node_kill": NodeKillInjector(cluster, replace=True,
+                                          node_args=node_args),
+            "worker_kill": WorkerKillInjector(cluster),
+            "forge_kill": ForgeKillInjector(cluster),
+            "gcs_restart": GcsRestartInjector(cluster),
+        }
+        runner = ChaosRunner(cluster, sched, injectors,
+                             recovery_deadline_s=45.0)
+
+        # --- Poisson serve load ---------------------------------------
+        @serve.deployment(num_replicas=2, max_concurrent_queries=32)
+        class ChaosEcho:
+            def __call__(self, payload):
+                return payload
+
+        handle = serve.run(ChaosEcho.bind())
+        _get = ray_tpu.get
+        _get([handle.remote(i) for i in range(8)])  # warm
+
+        rate_hz = 15.0 if (smoke or quick) else 30.0
+        duration_s = (period * count) + (2.0 if smoke else 6.0)
+        arrivals_rng = _random.Random(seed + 1)
+        arrivals, t = [], 0.0
+        while t < duration_s:
+            t += arrivals_rng.expovariate(rate_hz)
+            arrivals.append(t)
+        serve_stats = {"sent": 0, "ok": 0, "err": 0}
+
+        def serve_load(wd):
+            t0 = time.perf_counter()
+            refs = []
+            for i, at in enumerate(arrivals):
+                delay = t0 + at - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    refs.append(handle.remote(i))
+                    serve_stats["sent"] += 1
+                except Exception:  # noqa: BLE001 — routed into a dead
+                    serve_stats["err"] += 1  # replica mid-churn
+            for ref in refs:
+                try:
+                    with wd.track("serve-result"):
+                        _get(ref, timeout=30)
+                    serve_stats["ok"] += 1
+                except Exception:  # noqa: BLE001 — replica died mid-call
+                    serve_stats["err"] += 1
+
+        # --- checkpointing training loop ------------------------------
+        train_result = {}
+
+        def train_load():
+            from ray_tpu.train import session as _session
+            from ray_tpu.train.checkpoint import Checkpoint
+            from ray_tpu.train.config import (
+                FailureConfig,
+                RunConfig,
+                ScalingConfig,
+            )
+            from ray_tpu.train.trainer import DataParallelTrainer
+
+            n_steps = max(10, int(duration_s / 0.25) + 4)
+
+            def loop(config):
+                ckpt = _session.get_checkpoint()
+                start = ckpt.to_dict()["step"] + 1 \
+                    if ckpt is not None else 0
+                for step in range(start, n_steps):
+                    time.sleep(0.25)
+                    _session.report(
+                        {"step": step, "start": start},
+                        checkpoint=Checkpoint.from_dict({"step": step})
+                        if _session.get_world_rank() == 0 else None)
+
+            trainer = DataParallelTrainer(
+                loop,
+                # Pin the train workers to the KILLABLE nodes (the head
+                # is never a chaos victim): node kills must actually hit
+                # the gang so the resume-from-checkpoint assert means
+                # something.
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    resources_per_worker={"churn": 0.5}),
+                run_config=RunConfig(
+                    name=f"bench_chaos_{seed}",
+                    failure_config=FailureConfig(max_failures=count + 2)))
+            res = trainer.fit()
+            train_result["steps"] = [m["step"]
+                                     for m in res.metrics_history]
+            train_result["starts"] = [m["start"]
+                                      for m in res.metrics_history]
+            train_result["error"] = res.error
+            train_result["n_steps"] = n_steps
+
+        # --- run everything under the watchdog ------------------------
+        with HangWatchdog(limit_s=60.0) as wd:
+            threads = [threading.Thread(target=serve_load, args=(wd,),
+                                        name="chaos-serve-load",
+                                        daemon=True)]
+            if not smoke:
+                threads.append(threading.Thread(target=train_load,
+                                                name="chaos-train-load",
+                                                daemon=True))
+            with runner:
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                    assert not t.is_alive(), f"{t.name} never finished"
+                assert runner.wait(timeout=120), "chaos schedule stalled"
+
+        # --- hard asserts ---------------------------------------------
+        runner.assert_recovered()           # bounded recovery, per fault
+        wd.assert_no_hangs()                # zero parked-forever futures
+        assert runner.executed_signatures == sched.signatures(), \
+            "executed event log diverged from the seeded schedule"
+
+        out["chaos_event_log"] = [list(s) for s in sched.signatures()]
+        out["chaos_faults_injected"] = runner.faults_injected
+        out["chaos_mttr_ms"] = runner.mttr_by_kind()
+        all_mttrs = [r.mttr_ms for r in runner.records
+                     if r.mttr_ms is not None]
+        out["chaos_mttr_max_ms"] = round(max(all_mttrs), 1) \
+            if all_mttrs else None
+        out["chaos_zero_hangs"] = wd.hang_count == 0
+        total = serve_stats["ok"] + serve_stats["err"]
+        out["chaos_requests_total"] = total
+        out["chaos_request_error_rate"] = round(
+            serve_stats["err"] / total, 4) if total else None
+
+        if not smoke:
+            assert train_result.get("error") is None, train_result["error"]
+            steps = train_result["steps"]
+            starts = sorted(set(train_result["starts"]))
+            assert steps and steps[-1] == train_result["n_steps"] - 1, \
+                "training loop did not run to completion"
+            # Step continuity: the union of executed steps covers the
+            # whole range — each gang restart resumed AT its checkpoint,
+            # not from scratch and not past a gap.
+            assert set(steps) == set(range(train_result["n_steps"])), \
+                f"step gap after restart: {steps}"
+            restarts = len(starts) - 1
+            out["chaos_train_restarts"] = restarts
+            out["chaos_train_resumed_from_checkpoint"] = \
+                restarts == 0 or starts[-1] > 0
+            # Re-executed steps (reported more than once) per fault:
+            # bounded checkpoint lag, NOT restart-from-zero.
+            dup_steps = len(steps) - len(set(steps))
+            out["chaos_steps_lost_per_fault"] = round(
+                dup_steps / max(1, runner.faults_injected), 2)
+        if smoke:
+            assert out["chaos_request_error_rate"] is not None and \
+                out["chaos_request_error_rate"] < 0.5, \
+                f"smoke error rate too high: {out}"
+
+        # Soft regression flag (same convention as serve_scaleup_regressed):
+        # recovery is the metric this subsystem exists to bound.
+        if out["chaos_mttr_max_ms"] is not None and \
+                out["chaos_mttr_max_ms"] > 20000:
+            out["chaos_mttr_regressed"] = True
+            print(f"WARNING: chaos_mttr_max_ms {out['chaos_mttr_max_ms']} "
+                  "exceeds the 20s soft budget", file=sys.stderr)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — controller may have died
+            pass
+        try:
+            cluster.shutdown()
+        except Exception:  # noqa: BLE001 — nodes already churned away
+            pass
+    return out
+
+
 def main(out=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1589,9 +1862,26 @@ def main(out=None):
     ap.add_argument("--skip-inference", action="store_true")
     ap.add_argument("--skip-envelope", action="store_true")
     ap.add_argument("--skip-tracing", action="store_true")
+    ap.add_argument("--skip-chaos", action="store_true")
+    ap.add_argument("--chaos-smoke", action="store_true",
+                    help="run ONLY the seeded chaos smoke (gate step: one "
+                         "node kill under light serve load, <60s) and "
+                         "exit nonzero on any hang/recovery failure")
     args = ap.parse_args()
 
     import ray_tpu
+
+    if args.chaos_smoke:
+        stream = out or sys.stdout
+        try:
+            smoke = bench_chaos(quick=True, smoke=True)
+        except Exception as e:  # noqa: BLE001 — the gate needs the reason
+            print(json.dumps({"chaos_smoke_error":
+                              f"{type(e).__name__}: {e}"}), file=stream)
+            sys.exit(1)
+        print(json.dumps({"chaos_smoke": smoke}), file=stream)
+        stream.flush()
+        sys.exit(0)
 
     extra: dict = {}
     value = 0.0
@@ -1686,6 +1976,11 @@ def main(out=None):
             extra.update(bench_tracing(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["tracing_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_chaos:
+        try:
+            extra.update(bench_chaos(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["chaos_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

@@ -14,12 +14,16 @@ for the catalog with real before/after examples):
 - RL008 span-leak              — tracing spans always end()ed
 - RL009 gang-without-death-hook — placement-grouped gangs abort cleanly
                                   and register group death handling
+- RL010 retry-without-deadline — poll/retry loops carry a deadline or a
+                                  bounded attempt count (the hang-shaped
+                                  class the chaos plane hunts)
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 from ray_tpu.analysis.engine import (
@@ -997,3 +1001,96 @@ def rl009_gang_without_death_hook(ctx: FileContext) -> Iterable[Finding]:
                 "multi-actor gang on a placement group: "
                 + "; ".join(missing)
                 + " — or use shardgroup.create_gang/create_replica_group")
+
+
+# =====================================================================
+# RL010 retry-without-deadline
+# =====================================================================
+#
+# The hang-shaped bug class the chaos plane hunts (docs/FAULT_TOLERANCE
+# .md): a retry/poll loop that can spin forever. Under fault injection
+# "forever" is the common case — the peer it polls died, the state it
+# waits for will never arrive — and an unbounded loop converts one fault
+# into a silent wedge the watchdog then has to attribute from thread
+# stacks. Statically checkable shape:
+#
+#   while True:            # constant-true condition
+#       ...retry work...
+#       time.sleep(x)      # or asyncio.sleep / <event>.wait(t): a POLL
+#
+# with NO evidence of a bound anywhere in the loop: no deadline/timeout/
+# remaining/attempt/retries-style name (including keyword arguments like
+# `timeout=30`), no bounded counter. Loops conditioned on an event
+# (`while not self._stopped.is_set()`) are service loops, not retries —
+# their bound is the stop signal — and a `while True` body consisting of
+# NOTHING but a sleep is a signal-driven keep-alive (it polls nothing);
+# neither is flagged.
+#
+# Loops that are unbounded BY API CONTRACT (an `await ref` with no
+# deadline parameter, a tail-the-logs-until-the-job-ends generator)
+# annotate with `# raylint: disable=RL010 — <why the bound lives
+# elsewhere>` and should make themselves visible to the hang watchdog.
+
+_RL010_BOUND = re.compile(
+    r"deadline|timeout|remaining|attempt|retr|tries|budget|expir"
+    r"|give_?up|max_|_left", re.I)
+
+
+def _rl010_const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def _rl010_is_sleepish(call: ast.Call) -> bool:
+    seg = last_segment(dotted(call.func))
+    if seg == "sleep":
+        return True
+    # <event>.wait(t) inside while True is the same poll idiom; a bare
+    # .wait() (no args) parks on the event instead of polling.
+    return seg == "wait" and bool(call.args or call.keywords)
+
+
+def _rl010_bound_evidence(loop: ast.While) -> bool:
+    for sub in walk_excluding_nested_functions(loop):
+        names = []
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.append(sub.attr)
+        elif isinstance(sub, ast.Call):
+            names.extend(kw.arg for kw in sub.keywords if kw.arg)
+        if any(_RL010_BOUND.search(n) for n in names):
+            return True
+    return False
+
+
+def _rl010_keepalive(loop: ast.While) -> bool:
+    """Body is nothing but sleep statements: a signal-driven keep-alive
+    (standalone daemon mains) — it retries nothing."""
+    return all(
+        isinstance(s, ast.Expr) and isinstance(s.value, ast.Call)
+        and last_segment(dotted(s.value.func)) == "sleep"
+        for s in loop.body)
+
+
+@rule("RL010", "retry-without-deadline: constant-true poll/retry loop "
+               "with no deadline or bounded attempt count")
+def rl010_retry_without_deadline(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While) or \
+                not _rl010_const_true(node.test):
+            continue
+        if _rl010_keepalive(node):
+            continue
+        has_poll = any(
+            isinstance(sub, ast.Call) and _rl010_is_sleepish(sub)
+            for sub in walk_excluding_nested_functions(node))
+        if not has_poll:
+            continue
+        if _rl010_bound_evidence(node):
+            continue
+        yield ctx.finding(
+            node, "RL010",
+            "unbounded retry/poll loop: `while True` + sleep with no "
+            "deadline, timeout, or attempt bound — under a fault this "
+            "spins forever; bound it (deadline/attempts) or justify "
+            "with a disable comment and watchdog visibility")

@@ -70,10 +70,33 @@ class Cluster:
             pass
         self.raylets = [r for r in self.raylets if r is not raylet]
 
+    def crash_node(self, raylet: Raylet):
+        """Kill a node WITHOUT telling the GCS (fault injection): the
+        raylet stops serving but no drain is issued, so the GCS discovers
+        the death through missed health checks exactly as it would for a
+        crashed host — the detection + cleanup path chaos must exercise
+        (remove_node's drain skips it)."""
+        raylet.stop()
+        self.raylets = [r for r in self.raylets if r is not raylet]
+
     def kill_gcs(self):
         """Stop the GCS process (fault injection). Raylets and drivers keep
         running and reconnect when `restart_gcs` brings it back."""
         self.gcs.stop()
+
+    def wait_gcs_noticed_down(self, timeout: float = 10.0) -> bool:
+        """Block until the driver's GCS client has OBSERVED the death of
+        the killed GCS (its reader drained with ConnectionLost). Tests
+        that simulate an outage window wait on this event instead of a
+        fixed sleep — the race they exercise (reconnect dialing a dead
+        address) only exists once the loss is seen."""
+        import ray_tpu
+
+        runtime = ray_tpu._global_runtime
+        if runtime is not None and hasattr(runtime.gcs, "wait_disconnected"):
+            return runtime.gcs.wait_disconnected(timeout)
+        # No connected driver: the GCS server is stopped synchronously.
+        return True
 
     def restart_gcs(self):
         """Bring the GCS back at the SAME address, restoring tables from the

@@ -96,6 +96,12 @@ class TaskSpec:
     actor_class_blob: Optional[bytes] = None
     actor_max_restarts: int = 0
     actor_max_concurrency: int = 1
+    # Which incarnation this creation dispatch is: 0 on first creation,
+    # N on the Nth max_restarts restart. The worker passes it to the
+    # class's optional `__ray_restart__(restart_count)` state-restore
+    # hook so a restarted actor can rebuild state it cannot get from
+    # __init__ args alone (reload a checkpoint, re-register, ...).
+    actor_restart_count: int = 0
     actor_name: Optional[str] = None
     actor_namespace: Optional[str] = None
     actor_lifetime: Optional[str] = None        # None | "detached"

@@ -100,6 +100,20 @@ _flag("memory_usage_threshold", float, 0.95,
 _flag("memory_monitor_refresh_ms", int, 0, "Memory monitor period; 0 disables")
 _flag("gcs_storage", str, "memory", "GCS table storage backend: memory | file")
 _flag("gcs_storage_path", str, "", "Persistence path for the file storage backend")
+_flag("gcs_persist_interval_s", float, 0.5,
+      "Period of the GCS table snapshot loop (file storage backend). Each "
+      "snapshot is fsync'd then atomically replaced, so a GCS killed at "
+      "ANY instant restarts from a complete snapshot, never a torn one")
+_flag("gcs_reconnect_timeout_s", float, 30.0,
+      "How long a ReconnectingClient keeps re-dialing (bounded exponential "
+      "backoff, re-resolving the address each attempt) before a call fails "
+      "with ConnectionLost. Covers a GCS kill->restart window: clients that "
+      "noticed the death mid-outage must not cache the dead connection")
+_flag("chaos_recovery_deadline_s", float, 120.0,
+      "Recovery-transition watchdog horizon: a state-machine transition "
+      "(serve replica STARTING, train gang restart) stuck longer than this "
+      "fails loudly with the stuck state attributed instead of hanging; "
+      "0 disables enforcement")
 _flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
 _flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creating task after object loss")
 _flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")

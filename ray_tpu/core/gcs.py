@@ -189,6 +189,26 @@ class GcsServer:
             self._persist_thread = threading.Thread(
                 target=self._persist_loop, name="gcs-persist", daemon=True)
             self._persist_thread.start()
+            self._reschedule_unresolved_actors()
+
+    def _reschedule_unresolved_actors(self):
+        """GCS failover: actor creations/restarts that were IN FLIGHT when
+        the previous incarnation died are restored as PENDING_CREATION /
+        RESTARTING, but the `_schedule_actor` work driving them died with
+        the old process — without a re-kick they would sit in that state
+        forever (the chaos node-kill + GCS-restart storm found exactly
+        this wedge). Re-submit each; the scheduling loop parks until
+        nodes re-register. If the old incarnation's create actually
+        landed after the snapshot, the re-create supersedes it (the
+        orphaned worker's ALIVE push died with the old GCS)."""
+        with self._lock:
+            pending = [info.actor_id for info in self.actors.values()
+                       if info.state in (ActorState.PENDING_CREATION,
+                                         ActorState.RESTARTING)]
+        for actor_id in pending:
+            logger.info("GCS failover: rescheduling in-flight actor %s",
+                        actor_id.hex()[:12])
+            self._exec.submit(self._schedule_actor, actor_id)
 
     def stop(self):
         self._stopped.set()
@@ -210,10 +230,9 @@ class GcsServer:
 
     # ------------------------------------------------------ table persistence
 
-    _PERSIST_PERIOD_S = 0.5
-
     def _persist_loop(self):
-        while not self._stopped.wait(self._PERSIST_PERIOD_S):
+        period = GLOBAL_CONFIG.gcs_persist_interval_s
+        while not self._stopped.wait(period):
             try:
                 self._persist_tables()
             except Exception:
@@ -233,22 +252,56 @@ class GcsServer:
                 "placement_groups": self.placement_groups,
                 "job_counter": self._job_counter,
             })
-        # Serialized writers (stop() vs the persist loop) + atomic replace:
-        # a reader never sees a torn or interleaved snapshot.
+        # Serialized writers (stop() vs the persist loop) + fsync + atomic
+        # replace: a reader never sees a torn or interleaved snapshot, and
+        # a crash at ANY instant leaves either the previous complete
+        # snapshot or the new complete snapshot on disk — without the
+        # fsync, os.replace could commit the rename before the data blocks
+        # hit disk and a power-cut restart would load a torn file.
         with self._persist_lock:
             tmp = self._storage_path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(snapshot)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self._storage_path)
+            # Durability of the rename itself (best-effort: some
+            # filesystems refuse directory fds).
+            try:
+                dfd = os.open(os.path.dirname(self._storage_path)
+                              or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                logger.debug("GCS persist: directory fsync unsupported",
+                             exc_info=True)
 
     def _load_tables(self):
         import os
         import pickle
 
+        # A crash mid-persist may leave a partial .tmp behind; it is never
+        # the snapshot (only os.replace promotes it) — drop it so nothing
+        # downstream can mistake it for one.
+        try:
+            os.unlink(self._storage_path + ".tmp")
+        except OSError:
+            pass
         if not os.path.exists(self._storage_path):
             return
-        with open(self._storage_path, "rb") as f:
-            state = pickle.load(f)
+        try:
+            with open(self._storage_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception as e:
+            # fsync+atomic-replace means this "cannot happen"; if it does
+            # (disk corruption), fail LOUDLY — silently starting an empty
+            # GCS would orphan every registered actor and placement group.
+            raise RuntimeError(
+                f"GCS snapshot {self._storage_path} is unreadable "
+                f"({type(e).__name__}: {e}); refusing to start with "
+                "partial state") from e
         self.nodes = state["nodes"]
         self.actors = state["actors"]
         self.named_actors = state["named_actors"]
@@ -286,9 +339,45 @@ class GcsServer:
             conn.meta["node_id"] = info.node_id
         logger.info("Node %s registered at %s, resources=%s", info.node_id.hex()[:12],
                     info.address, info.resources_total)
+        # Failover reconciliation: actors this GCS believes ALIVE on the
+        # registering node but that the node does NOT actually host died
+        # during an outage (their actor_died report went to the dead
+        # incarnation) — drive the normal failure path instead of leaving
+        # a ghost address every caller errors against. Runs ASYNC against
+        # a FRESH raylet query (after a short settle), never against the
+        # registration message's snapshot: a re-register racing an
+        # in-flight re-create would otherwise read the pre-create worker
+        # set and fail over an actor that is coming up right now.
+        if data.get("reconcile_actors"):
+            self._exec.submit(self._reconcile_node_actors, info.node_id)
         self.pubsub.publish(CH_NODE, b"*", {"event": "alive", "node": info.to_public()})
         self._broadcast_resource_view()
         return {"node_count": len(self.nodes)}
+
+    def _reconcile_node_actors(self, node_id: NodeID):
+        """Cross-check restored ALIVE actors against what their node
+        ACTUALLY hosts (fresh query — in-flight creations count as
+        hosted) and fail over the ghosts. See handle_register_node."""
+        time.sleep(1.0)  # let racing creations/registrations settle
+        if self._stopped.is_set():
+            return
+        try:
+            resp = self._raylet(node_id).call("list_live_actors", {},
+                                              timeout=5)
+        except Exception:  # noqa: BLE001 — node died again; health
+            return         # checking owns that path
+        live = {a.binary() for a in resp.get("actors", ())}
+        with self._lock:
+            ghosts = [a for a in self.actors.values()
+                      if a.state == ActorState.ALIVE
+                      and a.node_id == node_id
+                      and a.actor_id.binary() not in live]
+        for ghost in ghosts:
+            logger.warning(
+                "GCS failover: actor %s recorded ALIVE on %s but the node "
+                "does not host it — driving the failure path",
+                ghost.actor_id.hex()[:12], node_id.hex()[:12])
+            self._on_actor_failure(ghost, "worker died during GCS outage")
 
     def handle_heartbeat(self, conn: Connection, data: Dict[str, Any]):
         node_id: NodeID = data["node_id"]
@@ -1121,6 +1210,10 @@ class GcsServer:
             if info is None or info.state == ActorState.DEAD:
                 return
             spec = info.creation_spec
+            # Stamp the incarnation: the worker invokes the class's
+            # __ray_restart__ state-restore hook on restarts (count > 0)
+            # but never on first creation.
+            spec.actor_restart_count = info.num_restarts
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0 * 10
         while not self._stopped.is_set():
             node_id = self._pick_node_for(spec)

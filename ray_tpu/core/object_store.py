@@ -86,6 +86,50 @@ def _segment_name(session_suffix: str, object_id: ObjectID) -> str:
     return f"rtpu_{session_suffix}_{object_id.hex()}"
 
 
+# --- staging: a segment is only attachable by name once it is COMPLETE ------
+#
+# ObjectStoreClient readers attach segments by name with no seal check (a
+# same-node read must not round-trip through the raylet), so the name
+# itself must be the seal: writers (puts, raylet pulls, spill restores)
+# create the segment under a staging name, fill it, and atomically rename
+# it to the final name (os.rename inside /dev/shm — invisible to existing
+# mappings, the SegmentPool's own trick). Before this, a driver polling
+# its store mid-pull could attach the raylet's half-filled buffer and
+# deserialize torn bytes — the lineage-reconstruction-under-node-death
+# chaos storm hit exactly that window.
+
+_SHM_DIR = "/dev/shm"
+_STAGING = os.path.isdir(_SHM_DIR)
+
+
+def _staging_name(session_suffix: str, object_id: ObjectID) -> str:
+    return _segment_name(session_suffix, object_id) + "_stg"
+
+
+def _writer_name(session_suffix: str, object_id: ObjectID) -> str:
+    """The name a writer creates a fresh segment under (staging when the
+    platform supports the rename publish, final otherwise)."""
+    if _STAGING:
+        return _staging_name(session_suffix, object_id)
+    return _segment_name(session_suffix, object_id)
+
+
+def _rename_segment(shm: shared_memory.SharedMemory, new_name: str):
+    """Rename a live segment's backing file and patch the handle so later
+    close()/unlink() target the new name. Mappings are unaffected."""
+    os.rename(os.path.join(_SHM_DIR, shm.name),
+              os.path.join(_SHM_DIR, new_name))
+    # SharedMemory tracks a leading slash on POSIX; keep its convention.
+    shm._name = ("/" + new_name) if shm._name.startswith("/") \
+        else new_name  # type: ignore[attr-defined]
+
+
+def _promote_segment(shm: shared_memory.SharedMemory, final_name: str):
+    """Publish a fully-written staged segment under its final name."""
+    if _STAGING and shm.name != final_name:
+        _rename_segment(shm, final_name)
+
+
 def _swallow(fn, *args):
     try:
         fn(*args)
@@ -140,8 +184,12 @@ class SegmentPool:
     def acquire(self, object_id: ObjectID, size: int
                 ) -> Optional[shared_memory.SharedMemory]:
         """Claim a warm segment for `object_id`: renames the pooled file
-        to the object's name and returns the (still warm) mapping; None
-        when no exact-size segment is pooled."""
+        to the object's STAGING name (it still holds the previous
+        object's stale bytes — publishing it under the final name before
+        the copy would let a same-node reader attach and deserialize the
+        wrong object) and returns the (still warm) mapping; the writer
+        promotes it to the final name after the copy. None when no
+        exact-size segment is pooled."""
         if not self._enabled:
             return None
         with self._lock:
@@ -151,8 +199,7 @@ class SegmentPool:
             shm = lst.pop()
             self._bytes -= size
         try:
-            os.rename("/dev/shm/" + shm.name,
-                      "/dev/shm/" + _segment_name(self._session, object_id))
+            _rename_segment(shm, _writer_name(self._session, object_id))
         except OSError:
             _swallow(shm.close)
             return None
@@ -313,8 +360,13 @@ class SharedMemoryStore:
                 raise RaySystemError(f"Object {object_id} already exists in store")
             self._ensure_capacity(size)
             try:
+                # Created under the STAGING name: same-node clients attach
+                # by the final name, which only exists once seal() renames
+                # the complete segment into place — an in-progress pull's
+                # buffer is invisible to them.
                 shm = shared_memory.SharedMemory(
-                    name=_segment_name(self._session, object_id), create=True, size=max(size, 1)
+                    name=_writer_name(self._session, object_id),
+                    create=True, size=max(size, 1)
                 )
             except FileExistsError:
                 raise RaySystemError(f"shm segment for {object_id} already exists")
@@ -341,6 +393,11 @@ class SharedMemoryStore:
             entry = self._objects.get(object_id)
             if entry is None:
                 raise RaySystemError(f"seal of unknown object {object_id}")
+            if not entry.sealed and entry.shm is not None:
+                # Atomic publish: the final name appears only now, with
+                # the bytes complete (see the staging block above).
+                _promote_segment(entry.shm,
+                                 _segment_name(self._session, object_id))
             entry.sealed = True
 
     def put_serialized(self, object_id: ObjectID, parts: List[memoryview | bytes]) -> int:
@@ -568,8 +625,11 @@ class SharedMemoryStore:
 
     def _restore(self, entry: _LocalObject) -> memoryview:
         self._ensure_capacity(entry.size)
+        # Staged like every other write: a client attaching by final name
+        # mid-restore would otherwise read a half-filled buffer.
         shm = shared_memory.SharedMemory(
-            name=_segment_name(self._session, entry.object_id), create=True, size=max(entry.size, 1)
+            name=_writer_name(self._session, entry.object_id),
+            create=True, size=max(entry.size, 1)
         )
         try:
             if entry.pending_spill is not None:
@@ -580,6 +640,8 @@ class SharedMemoryStore:
             else:
                 with open(entry.spilled_path, "rb") as f:
                     f.readinto(shm.buf[: entry.size])
+            _promote_segment(
+                shm, _segment_name(self._session, entry.object_id))
         except BaseException:
             # A transient fetch failure must not leak the named segment —
             # the next read retries _restore, and a stale segment would

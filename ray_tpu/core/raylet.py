@@ -866,10 +866,31 @@ class Raylet:
 
     def _register_with_gcs(self, client):
         """Announce this node and (re)establish its subscriptions. Called at
-        startup and again by the reconnecting client after a GCS restart."""
-        client.call("register_node", {"info": self._node_info})
+        startup and again by the reconnecting client after a GCS restart.
+
+        `reconcile_actors` asks the GCS to cross-check the actors it
+        believes ALIVE here against what this node actually hosts (via a
+        fresh `list_live_actors` query): actor-death reports sent during
+        a GCS outage are lost, and a restored ghost address would
+        otherwise make every caller error against it until a minutes-long
+        timeout."""
+        client.call("register_node", {"info": self._node_info,
+                                      "reconcile_actors": True})
         client.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
         client.call("subscribe", {"channel": "OBJECT", "key": b"*"})
+
+    def handle_list_live_actors(self, conn: Connection, data=None):
+        """Actors this node currently hosts OR is creating right now —
+        the GCS's failover reconciliation compares its restored table
+        against this (in-flight creations count as hosted: failing one
+        over would kill an actor that is coming up this instant)."""
+        with self.pool._lock:
+            live = {h.actor_id for h in self.pool._workers.values()
+                    if h.is_actor and h.actor_id is not None
+                    and h.state != "dead"}
+        with self._lock:
+            live.update(self._pending_actor_creates.keys())
+        return {"actors": list(live)}
 
     def _pending_demand(self, cap: int = 64) -> List[Dict[str, float]]:
         """Resource shapes of queued tasks that can't run right now — the
@@ -1574,10 +1595,35 @@ class Raylet:
                              "gone", spec.task_id, exc_info=True)
         if spec.actor_creation:
             # Dedicated actor worker: stays busy serving direct calls.
-            pending = self._pending_actor_creates.pop(spec.actor_id, None)
-            if pending is not None:
+            # Resolve by the REPORTING WORKER, not by actor id alone: a
+            # superseded create attempt (GCS failover race) shares the
+            # actor id AND task id with the live attempt, and resolving
+            # the newer record with the older attempt's outcome handed
+            # callers a worker the newer attempt never created.
+            with self._lock:
+                pending = self._pending_actor_creates.get(spec.actor_id)
+                ours = pending is not None and pending.get("worker") is worker
+                if ours:
+                    self._pending_actor_creates.pop(spec.actor_id, None)
+            if ours:
                 pending["result"] = {"error": error_blob, "worker": worker}
                 pending["event"].set()
+            else:
+                # A superseded attempt's worker finished its creation
+                # late: it must not linger as a second host of the actor
+                # (its eventual death would also be misattributed to the
+                # live incarnation) — kill it silently.
+                logger.info("terminating superseded duplicate actor "
+                            "worker pid=%s for %s", worker.pid,
+                            spec.actor_id.hex()[:12])
+                worker.is_actor = False  # suppress the actor_died report
+                if self.pool.mark_dead(worker.worker_id) is not None:
+                    self._release_held_resources(worker)
+                if worker.proc is not None and worker.proc.poll() is None:
+                    try:
+                        worker.proc.terminate()
+                    except OSError:
+                        pass  # already reaped
         else:
             self.pool.push_idle(worker)
         self._dispatch_event.set()
@@ -1724,18 +1770,35 @@ class Raylet:
                     logger.debug("crash report for %s dropped: submitter "
                                  "gone", spec.task_id, exc_info=True)
         if handle.is_actor and handle.actor_id is not None:
-            if handle.actor_id in self._pending_actor_creates:
-                pending = self._pending_actor_creates.pop(handle.actor_id)
+            with self._lock:
+                pending = self._pending_actor_creates.get(handle.actor_id)
+                superseded = (pending is not None
+                              and pending.get("worker") is not handle)
+                if pending is not None and not superseded:
+                    self._pending_actor_creates.pop(handle.actor_id, None)
+                else:
+                    pending = None  # a newer attempt owns the record
+            if pending is not None:
                 pending["result"] = {"error": serialization.serialize_exception(
                     RaySystemError(f"actor worker died during creation: {reason}"))}
                 pending["event"].set()
-            try:
-                self.gcs.call("actor_died",
-                              {"actor_id": handle.actor_id, "reason": reason,
-                               "intended": False}, timeout=5)
-            except Exception:  # noqa: BLE001 — GCS death detection covers it
-                logger.debug("actor_died report for %s failed",
-                             handle.actor_id, exc_info=True)
+            if superseded:
+                # This worker belonged to a SUPERSEDED create attempt: a
+                # newer attempt owns the actor's record, so reporting
+                # actor_died here would burn a restart of (or terminally
+                # kill) the live incarnation that is coming up right now.
+                logger.info("suppressing actor_died for %s: worker pid=%s "
+                            "was a superseded create attempt's",
+                            handle.actor_id.hex()[:12], handle.pid)
+            else:
+                try:
+                    self.gcs.call("actor_died",
+                                  {"actor_id": handle.actor_id,
+                                   "reason": reason,
+                                   "intended": False}, timeout=5)
+                except Exception:  # noqa: BLE001 — GCS death detection
+                    logger.debug("actor_died report for %s failed",
+                                 handle.actor_id, exc_info=True)
             # actor resources released on death
             if handle.current_task is None and handle.actor_id is not None:
                 pass
@@ -1760,6 +1823,14 @@ class Raylet:
 
     # ------------------------------------------------------ actor creation
 
+    def _pop_pending_create_if_ours(self, actor_id, pending) -> None:
+        """Drop an actor's pending-create record only when it is still
+        THIS attempt's — an unconditional pop would tear down a newer
+        (superseding) attempt's record and strand its waiter."""
+        with self._lock:
+            if self._pending_actor_creates.get(actor_id) is pending:
+                self._pending_actor_creates.pop(actor_id, None)
+
     def handle_create_actor(self, conn: Connection, data: Dict[str, Any]):
         """GCS asks this node to host an actor (reference
         `GcsActorScheduler::LeaseWorkerFromNode`)."""
@@ -1775,8 +1846,27 @@ class Raylet:
             worker = self.pool.spawn_worker(env_extra=env)
         worker.is_actor = True
         worker.actor_id = spec.actor_id
-        pending = {"event": threading.Event(), "result": None, "env": env}
-        self._pending_actor_creates[spec.actor_id] = pending
+        pending = {"event": threading.Event(), "result": None, "env": env,
+                   "worker": worker}
+        # One pending record per actor, owned by the NEWEST attempt.
+        # Concurrent creates for the same actor are real under GCS
+        # failover: the dead incarnation's create RPC keeps running on
+        # this raylet while the restarted GCS re-kicks its own. The older
+        # attempt is superseded — fired with an error now (its caller is
+        # gone or will retry) — and completions resolve records by the
+        # WORKER that reported, never by actor id alone (the creation
+        # spec, and so its task id, is identical across attempts).
+        with self._lock:
+            prev = self._pending_actor_creates.pop(spec.actor_id, None)
+            self._pending_actor_creates[spec.actor_id] = pending
+        if prev is not None:
+            logger.warning(
+                "create_actor for %s superseded an in-flight attempt "
+                "(GCS failover re-kick racing the old incarnation)",
+                spec.actor_id.hex()[:12])
+            prev["result"] = {"error": serialization.serialize_exception(
+                RaySystemError("superseded by a newer create attempt"))}
+            prev["event"].set()
         # Spawn-ahead hysteresis for create bursts: in-flight creates on
         # this node (each arrives on its own GCS connection) are queued
         # demand — prespawn so the next creates find registered idle
@@ -1799,7 +1889,7 @@ class Raylet:
             worker.registered.wait(min(remaining, 0.5))
         if worker.conn is None:
             self.resources.release(placement)
-            self._pending_actor_creates.pop(spec.actor_id, None)
+            self._pop_pending_create_if_ours(spec.actor_id, pending)
             if worker.state == "dead" or (worker.proc is not None
                                           and worker.proc.poll() is not None):
                 return {"status": "error",
@@ -1813,8 +1903,9 @@ class Raylet:
         self._dispatch_to(worker, qt)
         if not pending["event"].wait(GLOBAL_CONFIG.worker_lease_timeout_ms / 1000.0):
             # Hung __init__: kill the worker; _on_worker_dead releases the
-            # resources and cleans up the pending record.
-            self._pending_actor_creates.pop(spec.actor_id, None)
+            # resources and cleans up the pending record. Pop only OUR
+            # record — a newer attempt may have superseded it.
+            self._pop_pending_create_if_ours(spec.actor_id, pending)
             if worker.proc is not None and worker.proc.poll() is None:
                 try:
                     worker.proc.terminate()
@@ -1859,6 +1950,59 @@ class Raylet:
                 logger.debug("actor_died report for %s failed",
                              handle.actor_id, exc_info=True)
         return {}
+
+    # ---------------------------------------------------------- chaos hooks
+
+    def handle_chaos_kill_worker(self, conn: Connection, data: Dict[str, Any]):
+        """Fault injection (ray_tpu/chaos): SIGKILL one live worker
+        PROCESS on this node — no graceful path, no actor bookkeeping.
+        Death is discovered by the normal exit-event / reaper machinery
+        exactly as a real crash would be, which is the point: the chaos
+        plane must exercise detection, not shortcut it. `draw` picks the
+        victim deterministically from the sorted live set; `actors_only`
+        restricts to dedicated actor workers."""
+        import signal as _signal
+
+        draw = int(data.get("draw", 0))
+        actors_only = bool(data.get("actors_only", False))
+        with self.pool._lock:
+            victims = sorted(
+                (h for h in self.pool._workers.values()
+                 if h.state != "dead" and h.pid
+                 and h.proc is not None and h.proc.poll() is None
+                 and (h.is_actor or not actors_only)),
+                key=lambda h: h.worker_id.hex())
+        if not victims:
+            return {"killed": False}
+        victim = victims[draw % len(victims)]
+        try:
+            os.kill(victim.pid, _signal.SIGKILL)
+        except OSError as e:
+            return {"killed": False, "error": str(e)}
+        logger.warning("chaos: SIGKILLed worker pid=%d (%s, actor=%s)",
+                       victim.pid, victim.worker_id.hex()[:12],
+                       victim.is_actor)
+        return {"killed": True, "pid": victim.pid,
+                "worker_id": victim.worker_id.hex(),
+                "actor": victim.is_actor}
+
+    def handle_chaos_kill_forge(self, conn: Connection, data: Dict[str, Any]):
+        """Fault injection: SIGKILL the worker-forge template process.
+        The forge client notices the loss, restarts the template in the
+        background, and spawns fall back to cold exec meanwhile (the
+        PR-5 failover discipline this injector exists to exercise)."""
+        import signal as _signal
+
+        forge = self.forge
+        proc = forge.proc if forge is not None else None
+        if proc is None or proc.poll() is not None:
+            return {"killed": False}
+        try:
+            os.kill(proc.pid, _signal.SIGKILL)
+        except OSError as e:
+            return {"killed": False, "error": str(e)}
+        logger.warning("chaos: SIGKILLed forge template pid=%d", proc.pid)
+        return {"killed": True, "pid": proc.pid}
 
     # ------------------------------------------------------ object transfer
 

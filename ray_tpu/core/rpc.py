@@ -40,6 +40,53 @@ class ConnectionLost(RaySystemError):
     pass
 
 
+# --- Chaos fault hook (ray_tpu/chaos) ----------------------------------------
+# Installed by the chaos plane's RpcFaultInjector; None in production. The
+# disabled path costs exactly one module-global None check on the send path
+# (proven inert by bench_chaos's A-B-A overhead measurement). When installed,
+# the filter sees (client_name, address, method) BEFORE a request frame is
+# sent and returns an action:
+#   None / "pass"        send normally
+#   ("delay", seconds)   sleep, then send — a slow link
+#   "error"              raise ConnectionLost without sending — a reset
+#                        connection (ReconnectingClient re-dials, the actor
+#                        submit path retries)
+#   "drop"               swallow the send — a blackhole partition. Blocking
+#                        callers run into their own RPC timeout; pipelined
+#                        callers with a callback get the loss envelope (a
+#                        drop on an ordered stream is indistinguishable from
+#                        a dead connection to the sender).
+# Only REQUEST frames from RpcClient are filtered: every cross-process hop in
+# the system originates at some client, so node-pair partitions are expressed
+# by matching the client's name/address, and response/push frames of an
+# unfiltered peer stay intact (a real partition would cut both directions —
+# injectors install matching filters on both sides when they want that).
+
+_CHAOS_FILTER = None
+
+
+def install_chaos_filter(fn) -> None:
+    """Install `fn(client_name, address, method) -> action` as the
+    process-wide RPC fault filter (see the action table above)."""
+    global _CHAOS_FILTER
+    _CHAOS_FILTER = fn
+
+
+def clear_chaos_filter() -> None:
+    global _CHAOS_FILTER
+    _CHAOS_FILTER = None
+
+
+def _chaos_action(client: "RpcClient", method: str):
+    """Evaluate the installed filter defensively: a broken filter must
+    degrade to fault-free RPC, never take the control plane down."""
+    try:
+        return _CHAOS_FILTER(client._name, client.address, method)
+    except Exception:  # noqa: BLE001 — chaos tooling must not add faults
+        logger.exception("chaos filter raised; treating as pass")
+        return None
+
+
 def _as_view(p) -> memoryview:
     v = p if isinstance(p, memoryview) else memoryview(p)
     if v.format != "B" or v.ndim != 1:
@@ -356,6 +403,7 @@ class RpcClient:
         timeout = connect_timeout or GLOBAL_CONFIG.rpc_connect_timeout_s
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
+        backoff = 0.05
         while True:
             try:
                 self._sock = socket.create_connection((host, int(port)), timeout=5)
@@ -364,7 +412,12 @@ class RpcClient:
                 last_err = e
                 if time.monotonic() > deadline:
                     raise ConnectionLost(f"connect to {address} failed: {e}")
-                time.sleep(0.05)
+                # Exponential backoff, capped: a long outage (GCS restart)
+                # must not spin the dial loop at 20 attempts/s for its
+                # whole duration.
+                time.sleep(min(backoff,
+                               max(0.0, deadline - time.monotonic())))
+                backoff = min(backoff * 2, 1.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
         self.address = address
@@ -574,6 +627,25 @@ class RpcClient:
             t = _tracing.wire_ctx()
             if t is not None:
                 env["t"] = t
+        if _CHAOS_FILTER is not None:
+            act = _chaos_action(self, method)
+            if isinstance(act, tuple) and act and act[0] == "delay":
+                time.sleep(act[1])
+            elif act == "drop":
+                # Blackhole: the send is swallowed. A pipelined caller's
+                # callback gets the loss envelope (on an ordered stream a
+                # silent drop and a dead connection look identical to the
+                # sender); without a callback it is fire-and-forget anyway.
+                with self._pending_lock:
+                    slot = self._pending.pop(msg_id, None)
+                if callback is not None and slot is not None:
+                    callback({"e": "chaos: dropped", "_lost": True}, b"")
+                return
+            elif act == "error":
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                raise ConnectionLost(
+                    f"{self._name}: chaos fault injected on '{method}'")
         try:
             _send_msg(self._sock, env, payload, self._send_lock)
         except OSError as e:
@@ -608,11 +680,26 @@ class RpcClient:
             t = _tracing.wire_ctx()
             if t is not None:
                 env["t"] = t
-        try:
-            _send_msg(self._sock, env, payload, self._send_lock)
-        except OSError as e:
-            self._closed.set()
-            raise ConnectionLost(str(e))
+        suppress_send = False
+        if _CHAOS_FILTER is not None:
+            act = _chaos_action(self, method)
+            if isinstance(act, tuple) and act and act[0] == "delay":
+                time.sleep(act[1])
+            elif act == "error":
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                raise ConnectionLost(
+                    f"{self._name}: chaos fault injected on '{method}'")
+            elif act == "drop":
+                # Blackhole: skip the send; the slot wait below delivers
+                # this caller's own bounded TimeoutError.
+                suppress_send = True
+        if not suppress_send:
+            try:
+                _send_msg(self._sock, env, payload, self._send_lock)
+            except OSError as e:
+                self._closed.set()
+                raise ConnectionLost(str(e))
         if not slot["event"].wait(timeout or GLOBAL_CONFIG.rpc_call_timeout_s):
             with self._pending_lock:
                 self._pending.pop(msg_id, None)
@@ -674,11 +761,16 @@ class ReconnectingClient:
     """
 
     def __init__(self, address: str, name: str, push_handler=None,
-                 resubscribe=None):
+                 resubscribe=None, resolve=None):
         self.address = address
         self._name = name
         self._push_handler = push_handler
         self._resubscribe = resubscribe
+        # Optional address provider, consulted before EVERY dial attempt:
+        # a client that cached its address while the server was down (e.g.
+        # a GCS killed and restarted elsewhere) re-resolves instead of
+        # re-dialing the dead endpoint forever.
+        self._resolve = resolve
         self._lock = threading.Lock()
         self._terminal = False  # close() is final: no resurrection
         self._client = RpcClient(address, name=name, push_handler=push_handler)
@@ -687,19 +779,64 @@ class ReconnectingClient:
     def is_closed(self) -> bool:
         return self._terminal or self._client.is_closed
 
+    def wait_disconnected(self, timeout: Optional[float] = None) -> bool:
+        """Block until the underlying connection is observed closed (e.g.
+        a test killed the server and must not proceed on a fixed sleep).
+        True when the loss was seen within `timeout`."""
+        return self._client._closed.wait(timeout)
+
     def _reconnect(self) -> RpcClient:
-        with self._lock:
-            if self._terminal:
-                raise ConnectionLost(f"{self._name}: client closed")
-            if self._client.is_closed:
-                self._client = RpcClient(self.address, name=self._name,
-                                         push_handler=self._push_handler)
-                if self._resubscribe is not None:
+        # Bounded-backoff re-dial: each attempt re-resolves the address
+        # and dials with a short per-attempt timeout, so a server that
+        # comes back mid-outage (GCS restart) is picked up quickly while
+        # the overall wait stays bounded by gcs_reconnect_timeout_s (a
+        # dead server fails the call with ConnectionLost, never hangs
+        # it). Dial attempts serialize on the lock (one racer re-dials,
+        # the rest adopt its fresh client); backoff sleeps run OUTSIDE
+        # the lock (RL002).
+        deadline = time.monotonic() + GLOBAL_CONFIG.gcs_reconnect_timeout_s
+        backoff = 0.05
+        last_err: Optional[Exception] = None
+        while True:
+            with self._lock:
+                if self._terminal:
+                    raise ConnectionLost(f"{self._name}: client closed")
+                if not self._client.is_closed:
+                    return self._client
+                addr = self.address
+                if self._resolve is not None:
                     try:
-                        self._resubscribe(self._client)
-                    except Exception:
-                        logger.warning("%s: resubscribe failed", self._name)
-            return self._client
+                        addr = self._resolve() or self.address
+                    except Exception:  # noqa: BLE001 — fall back to cached
+                        logger.debug("%s: address re-resolve failed",
+                                     self._name, exc_info=True)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionLost(
+                        f"{self._name}: reconnect to {addr} timed out "
+                        f"after {GLOBAL_CONFIG.gcs_reconnect_timeout_s}s"
+                    ) from last_err
+                try:
+                    client = RpcClient(
+                        addr, name=self._name,
+                        push_handler=self._push_handler,
+                        connect_timeout=min(max(backoff * 2, 0.2),
+                                            remaining))
+                except ConnectionLost as e:
+                    client = None
+                    last_err = e
+                if client is not None:
+                    self.address = addr
+                    self._client = client
+                    if self._resubscribe is not None:
+                        try:
+                            self._resubscribe(self._client)
+                        except Exception:
+                            logger.warning("%s: resubscribe failed",
+                                           self._name)
+                    return self._client
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2, 1.0)
 
     def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
         if self._terminal:

@@ -39,6 +39,47 @@ logger = logging.getLogger(__name__)
 _PENDING = object()
 
 
+# --- Parked-operation registry (chaos zero-hangs watchdog) -------------------
+# Every potentially-unbounded blocking wait in the public API (get / wait /
+# actor resolution) registers itself here for its duration. The chaos
+# plane's HangWatchdog samples the registry to enforce "no parked future
+# outlives the recovery deadline": a hang becomes an attributed assertion
+# (which op, for how long) instead of a silent wedge. Cost when nobody
+# watches: one dict insert + delete per blocking call.
+
+_parked_ops: Dict[int, Tuple[str, float]] = {}
+_parked_lock = threading.Lock()
+_parked_counter = 0
+
+
+class _ParkedOp:
+    __slots__ = ("token",)
+
+    def __init__(self, desc: str):
+        global _parked_counter
+        with _parked_lock:
+            _parked_counter += 1
+            self.token = _parked_counter
+            _parked_ops[self.token] = (desc, time.monotonic())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        with _parked_lock:
+            _parked_ops.pop(self.token, None)
+        return False
+
+
+def parked_ops() -> List[Tuple[int, str, float]]:
+    """(token, description, seconds parked) for every blocking public-API
+    op currently in flight in THIS process."""
+    now = time.monotonic()
+    with _parked_lock:
+        return [(tok, desc, now - t0)
+                for tok, (desc, t0) in _parked_ops.items()]
+
+
 class _TaskRecord:
     __slots__ = ("event", "results", "error", "crashed", "spec", "attempts",
                  "reconstructions", "submitted_addr")
@@ -456,27 +497,54 @@ class CoreRuntime:
 
         from ray_tpu._native import gather_copy
 
+        from ray_tpu.core.object_store import _promote_segment, _writer_name
+
+        final = _segment_name(self.session_suffix, oid)
         shm = None
         if reusable:
             shm = self._segment_pool.acquire(oid, size)
         if shm is not None:
             # Warm pooled segment: pages pre-faulted at reclaim time, the
             # copy runs at memcpy speed (cold tmpfs writes fault+zero
-            # every page and run 3-5x slower).
+            # every page and run 3-5x slower). Acquired under the STAGING
+            # name (it holds the previous object's bytes until the copy
+            # lands); promoted to the final name only once complete.
+            ok = False
             try:
                 gather_copy(shm.buf[:size], parts)
+                _promote_segment(shm, final)
+                ok = True
             finally:
                 shm.close()
+                if not ok:
+                    # Same cleanup as the cold path: a failed copy must
+                    # not leak the staged file (a later create of this
+                    # object would FileExistsError on the staging name).
+                    try:
+                        shm.unlink()
+                    except OSError:
+                        pass
             self._segment_pool.track(oid, size)
             return
         shm = shared_memory.SharedMemory(
-            name=_segment_name(self.session_suffix, oid), create=True, size=max(size, 1))
+            name=_writer_name(self.session_suffix, oid), create=True,
+            size=max(size, 1))
+        ok = False
         try:
             gather_copy(shm.buf[:size], parts)
+            # Atomic publish: same-node readers attach by the final name,
+            # which must never exist with incomplete bytes behind it.
+            _promote_segment(shm, final)
+            ok = True
         finally:
             shm.close()
             from ray_tpu.core.object_store import _untrack
             _untrack(shm)
+            if not ok:
+                try:
+                    shm.unlink()  # drop the staged partial, never leak it
+                except OSError:
+                    pass
         if reusable:
             self._segment_pool.track(oid, size)
 
@@ -826,6 +894,10 @@ class CoreRuntime:
         return self._env_cache.prepare(renv)
 
     def wait_for_actor(self, actor_id: ActorID, timeout: float = 120.0) -> str:
+        with _ParkedOp(f"wait_for_actor {actor_id.hex()[:12]}"):
+            return self._wait_for_actor(actor_id, timeout)
+
+    def _wait_for_actor(self, actor_id: ActorID, timeout: float) -> str:
         key = actor_id.binary()
         deadline = time.monotonic() + timeout
         # For actors THIS runtime just registered, the subscription rides
@@ -845,7 +917,15 @@ class CoreRuntime:
         while time.monotonic() < deadline:
             with self._lock:
                 state = self._actor_states.get(key)
-            if state is None and time.monotonic() >= next_query:
+            # Anti-entropy re-query: for UNKNOWN actors and for cached
+            # NON-TERMINAL states alike. A cached "RESTARTING" pushed by
+            # a GCS that then died would otherwise gate the query off
+            # forever — its ALIVE transition was published while this
+            # process's subscription was down, and no later push corrects
+            # the cache (observed: 120s stalls after GCS failover).
+            stale = state is not None and \
+                state.get("state") not in ("ALIVE", "DEAD")
+            if (state is None or stale) and time.monotonic() >= next_query:
                 next_query = time.monotonic() + requery
                 info = self.gcs.call("get_actor_info", {"actor_id": actor_id})
                 if info["known"]:
@@ -873,6 +953,43 @@ class CoreRuntime:
             ev.wait(timeout=0.5)
             ev.clear()
         raise GetTimeoutError(f"Timed out waiting for actor {actor_id.hex()[:12]}")
+
+    def actor_liveness(self, actor_id: ActorID) -> str:
+        """Non-blocking actor state probe: "alive" | "pending" | "dead".
+
+        Pushed-state cache first, one bounded GCS directory query as
+        fallback — never submits a task and never waits on creation.
+        Health/ping loops use this BEFORE submitting to an actor: a
+        submission to a not-yet-ALIVE actor resolves its address through
+        a blocking wait_for_actor, so one wedged __init__ would park the
+        prober (observed: the serve reconcile loop hostage to a replica
+        stuck in its constructor — the stuck-state enforcement it owns
+        could then never run)."""
+        key = actor_id.binary()
+        with self._lock:
+            state = self._actor_states.get(key)
+        st = state.get("state") if state is not None else None
+        if st not in ("ALIVE", "DEAD"):
+            # Unknown OR cached non-terminal: query the directory. A
+            # cached RESTARTING must not be trusted forever — its ALIVE
+            # transition may have been published while this process's
+            # subscription was down (GCS failover), and treating it as
+            # eternally "pending" would make health checks kill a
+            # healthy replica (same staleness mode _wait_for_actor's
+            # anti-entropy re-query covers).
+            try:
+                resp = self.gcs.call("get_actor_info",
+                                     {"actor_id": actor_id}, timeout=5)
+            except Exception:  # noqa: BLE001 — GCS mid-failover
+                return "pending"
+            if not resp.get("known"):
+                return "pending"
+            st = resp.get("state")
+        if st == "ALIVE":
+            return "alive"
+        if st == "DEAD":
+            return "dead"
+        return "pending"
 
     def _actor_client(self, actor_id: ActorID) -> ActorClient:
         key = actor_id.binary()
@@ -990,7 +1107,11 @@ class CoreRuntime:
                 self._notify_blocked(True)
 
         try:
-            return [self._get_one(oid, deadline, on_block) for oid in object_ids]
+            with _ParkedOp(f"get[{len(object_ids)}]"
+                           + (f" {object_ids[0].hex()[:12]}" if object_ids
+                              else "")):
+                return [self._get_one(oid, deadline, on_block)
+                        for oid in object_ids]
         finally:
             if state["blocked"]:
                 self._notify_blocked(False)
@@ -1299,6 +1420,7 @@ class CoreRuntime:
             self._wait_watchers.append(notif)
         ready_keys: set = set()
         n_ready = 0
+        parked = _ParkedOp(f"wait[{len(object_ids)}/{num_returns}]")
         try:
             # One full scan, then purely event-driven: completed task keys
             # map back to their pending refs, so each completion costs O(1)
@@ -1349,6 +1471,7 @@ class CoreRuntime:
                             still.append(oid)
                     others = still
         finally:
+            parked.__exit__()
             with self._lock:
                 try:
                     self._wait_watchers.remove(notif)

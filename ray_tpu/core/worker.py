@@ -268,6 +268,19 @@ class WorkerRuntime(CoreRuntime):
             if spec.actor_creation:
                 cls = serialization.loads(spec.actor_class_blob)
                 self.actor_instance = cls(*args, **kwargs)
+                restart_count = getattr(spec, "actor_restart_count", 0)
+                if restart_count > 0:
+                    # State-restore hook: this is incarnation N of a
+                    # max_restarts actor — __init__ re-ran with the
+                    # original args, and the hook lets the class rebuild
+                    # state __init__ cannot (reload a checkpoint,
+                    # re-subscribe). A raising hook fails the creation
+                    # (the GCS declares the actor dead) — a half-restored
+                    # actor must never serve calls.
+                    hook = getattr(self.actor_instance,
+                                   "__ray_restart__", None)
+                    if hook is not None:
+                        hook(restart_count)
                 self.actor_spec = spec
                 self._setup_actor_executor(spec.actor_max_concurrency)
                 values = []

@@ -97,7 +97,10 @@ class JobSubmissionClient:
         import time
 
         seen = 0
-        while True:
+        # Unbounded by API contract (tail -f semantics: follow the job
+        # until it terminates); the bound is the TERMINAL status check —
+        # a dead job server fails the poll's own RPC instead of hanging.
+        while True:  # raylint: disable=RL010
             logs = self.get_job_logs(submission_id)
             if len(logs) > seen:
                 yield logs[seen:]

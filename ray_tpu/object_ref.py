@@ -101,12 +101,18 @@ class ObjectRef:
 
         async def _poll():
             import ray_tpu
+            from ray_tpu.core import runtime as _rt
 
-            while True:
-                ready, _ = ray_tpu.wait([self], timeout=0)
-                if ready:
-                    return ray_tpu.get(self)
-                await asyncio.sleep(0.002)
+            # Unbounded by API contract (await has no deadline parameter)
+            # — registered as ONE parked op for its whole duration so the
+            # chaos HangWatchdog sees a wedged await as a hang, not as an
+            # innocuous stream of 0-timeout polls.
+            with _rt._ParkedOp(f"await {self.object_id.hex()[:12]}"):
+                while True:
+                    ready, _ = ray_tpu.wait([self], timeout=0)
+                    if ready:
+                        return ray_tpu.get(self)
+                    await asyncio.sleep(0.002)
 
         return _poll().__await__()
 

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.chaos.deadline import TransitionWatch
 from ray_tpu.serve.config import (
     REPLICA_RUNNING,
     REPLICA_STARTING,
@@ -130,6 +131,13 @@ class ServeController:
         # so an enqueue racing that window would see a "live" writer that
         # will never drain its payload.
         self._ckpt_writer_alive = False
+        # Recovery-deadline enforcement (chaos_recovery_deadline_s):
+        # replica STARTING phases and deployment convergence are tracked
+        # transitions — any of them stuck past the deadline fails loudly
+        # (attributed critical log + forced replacement + counter in
+        # status()) instead of quietly retrying forever. Driven only from
+        # the reconcile loop (TransitionWatch is single-threaded).
+        self._transitions = TransitionWatch("serve-controller")
 
     # ------------------------------------------------- checkpoint/recovery
 
@@ -447,6 +455,7 @@ class ServeController:
                     r.replica_id: r.state for r in info.replicas},
                 "ongoing": sum(r.last_ongoing for r in info.replicas),
                 "cold_start_ms": info.last_cold_start_ms,
+                "stuck_transitions": self._transitions.stuck_total,
             }
             if info.config.shard_spec is not None:
                 spec = info.config.shard_spec
@@ -580,6 +589,7 @@ class ServeController:
         loop = asyncio.get_running_loop()
         changed = False
         depths_moved = False
+        tracked_keys = set()
         for name, info in list(self._deployments.items()):
             # 1. Promote STARTING replicas that answer ping; cull ones that
             # died in __init__ (ping resolves to an actor error) or never
@@ -708,6 +718,36 @@ class ServeController:
                     self._stop_replica(rep)
                     info.replicas.remove(rep)
                 changed = True
+
+            # 5. Recovery-deadline tracking: every STARTING replica and
+            # the deployment's convergence toward target are in-flight
+            # transitions; anything stuck past chaos_recovery_deadline_s
+            # is failed loudly below (attributed), never left to spin.
+            running_n = sum(1 for r in info.replicas
+                            if r.state == REPLICA_RUNNING)
+            for rep in info.replicas:
+                if rep.state == REPLICA_STARTING:
+                    self._transitions.enter(rep.replica_id, "STARTING")
+                    tracked_keys.add(rep.replica_id)
+            if running_n < info.target:
+                key = f"deployment:{name}"
+                self._transitions.enter(
+                    key, f"converging({running_n}/{info.target})")
+                tracked_keys.add(key)
+
+        # Prune transitions whose subject completed or vanished this tick,
+        # then enforce the deadline: a stuck replica is force-replaced
+        # (reconcile respawns it), a stuck deployment is counted and
+        # re-armed — both land in status()["stuck_transitions"] and a
+        # CRITICAL log with the stuck state attributed.
+        self._transitions.prune(tracked_keys)
+        for key, state, elapsed in self._transitions.fail_stuck():
+            for info in self._deployments.values():
+                for rep in list(info.replicas):
+                    if rep.replica_id == key:
+                        self._stop_replica(rep, graceful=False)
+                        info.replicas.remove(rep)
+                        changed = True
 
         if changed:
             self._rebuild_routing_table()
@@ -970,6 +1010,13 @@ def _try_ping(handle, timeout_s: float) -> tuple:
     ping so placement reaches the routing table with no extra RPC."""
     import ray_tpu
 
+    # Never SUBMIT to a not-yet-ALIVE actor: submission resolves the
+    # address via a blocking wait_for_actor, so one replica wedged in its
+    # __init__ would park the whole reconcile loop — and the stuck-state
+    # enforcement that exists to catch exactly that could never run.
+    liveness = ray_tpu._require_runtime().actor_liveness(handle._actor_id)
+    if liveness != "alive":
+        return ("dead" if liveness == "dead" else "pending"), ""
     try:
         ref = handle.ping.remote()
         ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout_s)
@@ -985,8 +1032,18 @@ def _try_ping(handle, timeout_s: float) -> tuple:
 def _gather_stats(replicas) -> list:
     import ray_tpu
 
+    runtime = ray_tpu._require_runtime()
     refs, out = [], []
     for rep in replicas:
+        # Only RUNNING replicas are probed, and only via a non-blocking
+        # liveness check first: submitting to a not-ALIVE actor blocks on
+        # address resolution, and one wedged replica would park the whole
+        # reconcile loop (non-RUNNING entries get a None placeholder the
+        # consumer's state check skips).
+        if rep.state != REPLICA_RUNNING or \
+                runtime.actor_liveness(rep.handle._actor_id) != "alive":
+            refs.append(None)
+            continue
         try:
             refs.append(rep.handle.stats.remote())
         except Exception:  # noqa: BLE001

@@ -69,15 +69,41 @@ class ReplicaGroup:
 
         indices = list(indices) if indices is not None \
             else list(range(len(self.ranks)))
+        runtime = ray_tpu._require_runtime()
+        deadline = time.monotonic() + timeout_s
         refs = []
         for rank in indices:
+            handle = self.ranks[rank]
+            # Liveness probe BEFORE submission: submitting to a rank
+            # still in creation blocks UNBOUNDEDLY on address resolution,
+            # so one wedged rank ctor would park every health sweep (the
+            # serve reconcile loop among them). A pending rank is polled
+            # only within this call's own deadline — readiness waits
+            # (create_gang's wait_ready) keep their blocking semantics,
+            # short sweeps return "pending" immediately. Backoff on the
+            # poll: each actor_liveness on a pending rank is a GCS
+            # directory RPC, and a gang readiness wait at a fixed 50ms
+            # cadence would hammer the GCS with ~20 RPCs/s per rank for
+            # the whole spawn+__init__ window.
+            poll = 0.05
+            liveness = runtime.actor_liveness(handle._actor_id)
+            while liveness == "pending" and time.monotonic() < deadline:
+                time.sleep(min(poll, max(0.0,
+                                         deadline - time.monotonic())))
+                poll = min(poll * 2, 0.5)
+                liveness = runtime.actor_liveness(handle._actor_id)
+            if liveness != "alive":
+                refs.append("dead" if liveness == "dead" else "pending")
+                continue
             try:
-                refs.append(self.ranks[rank].ping.remote())
+                refs.append(handle.ping.remote())
             except Exception:  # noqa: BLE001 — submit to a dead actor
                 refs.append(None)
         out = []
-        deadline = time.monotonic() + timeout_s
         for ref in refs:
+            if isinstance(ref, str):
+                out.append(ref)
+                continue
             if ref is None:
                 out.append("dead")
                 continue

@@ -35,6 +35,12 @@ class BackendExecutor:
         self.scaling_config = scaling_config
         self.max_failures = max_failures
         self.worker_group: Optional[WorkerGroup] = None
+        # Latest checkpoint REPORTED by the run (rank 0), so a gang
+        # restart resumes at the last reported step — not from the
+        # checkpoint the run originally started from.
+        self.latest_checkpoint = None
+        # (restart_count, world_size) history for observability/benches.
+        self.restarts: List[Dict[str, Any]] = []
 
     def start(self):
         sc = self.scaling_config
@@ -50,23 +56,51 @@ class BackendExecutor:
             checkpoint=None, datasets_per_worker: Optional[List[Dict]] = None,
             experiment_name: str = "") -> Iterator[List[Dict[str, Any]]]:
         """Generator: yields one list of per-worker results per report round;
-        returns when all workers finish. Restarts the whole group on worker
-        failure, up to max_failures (reference semantics — no partial
-        elasticity: ICI slice membership is static, SURVEY.md §7)."""
+        returns when all workers finish.
+
+        Failure semantics (gang-native elastic restart): any rank death
+        aborts the whole gang (PR-8 death-hook discipline — ICI slice
+        membership is static, SURVEY.md §7: no partial elasticity WITHIN
+        a run), then the gang restarts as a unit on a FRESH placement
+        group, shrinking the world if the surviving topology cannot place
+        it, and the loop resumes from the LATEST reported checkpoint (the
+        worker's session hands it to train_fn via session.get_checkpoint;
+        restore reshards when the world changed). Up to max_failures."""
         failures = 0
+        self.latest_checkpoint = checkpoint
         while True:
             try:
-                yield from self._run_once(train_fn, config, checkpoint,
-                                          datasets_per_worker, experiment_name)
+                yield from self._run_once(
+                    train_fn, config, self.latest_checkpoint,
+                    datasets_per_worker, experiment_name)
                 return
             except (RayActorError, TrainingFailedError):
                 failures += 1
                 if failures > self.max_failures:
                     raise
-                logger.warning("worker group failed; restart %d/%d",
-                               failures, self.max_failures)
-                self.shutdown()
-                self.start()
+                logger.warning("worker group failed; gang restart %d/%d "
+                               "(resuming from %s checkpoint)",
+                               failures, self.max_failures,
+                               "latest" if self.latest_checkpoint is not None
+                               else "no")
+                self._restart_group()
+
+    def _restart_group(self):
+        """Gang restart: abort + recreate as a unit (fresh pg), elastic
+        shrink on an unplaceable world, backend re-setup on the new
+        incarnation. Falls back to a cold start() when no group exists."""
+        if self.worker_group is None:
+            self.start()
+            return
+        try:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+        except Exception:  # noqa: BLE001 — dead ranks can't shut down
+            logger.debug("backend on_shutdown during restart failed",
+                         exc_info=True)
+        world = self.worker_group.restart()
+        self.restarts.append({"world_size": world,
+                              "incarnation": self.worker_group.incarnation})
+        self.backend.on_start(self.worker_group, self.backend_config)
 
     def _run_once(self, train_fn, config, checkpoint, datasets_per_worker,
                   experiment_name):
@@ -102,6 +136,11 @@ class BackendExecutor:
                             f"worker {idx} train loop failed") from err
                 else:
                     round_results.append({"rank": idx, **res})
+                    if idx == 0 and res.get("checkpoint") is not None:
+                        # Rank 0's reported checkpoint is the resume
+                        # point for a gang restart (same choice the
+                        # trainer makes for its CheckpointManager).
+                        self.latest_checkpoint = res["checkpoint"]
             if round_results:
                 yield round_results
 

@@ -151,10 +151,32 @@ class WorkerGroup:
                  placement_strategy: str = "PACK",
                  use_placement_group: bool = True):
         self.num_workers = num_workers
-        resources = dict(resources_per_worker or {"CPU": 1.0})
+        self._resources_per_worker = dict(
+            resources_per_worker or {"CPU": 1.0})
+        self._placement_strategy = placement_strategy
+        self._use_placement_group = use_placement_group
+        # Bumped on every successful (re)creation: consumers key run-scoped
+        # names (collective groups) off it so a restarted gang can never
+        # collide with its previous incarnation.
+        self.incarnation = 0
+        self.workers: List[Any] = []
+        self._pg = None
+        self._dead_rank: Optional[int] = None
+        self._monitor = None
+        self._create(num_workers)
+
+    @property
+    def dead_rank(self) -> Optional[int]:
+        return self._dead_rank
+
+    def _create(self, num_workers: int, pg_timeout_s: float = 120.0):
+        resources = dict(self._resources_per_worker)
+        self.num_workers = num_workers
         self._pg = None
         actor_cls = ray_tpu.remote(TrainWorker)
         options: Dict[str, Any] = {}
+        placement_strategy = self._placement_strategy
+        use_placement_group = self._use_placement_group
         num_cpus = resources.pop("CPU", 1.0)
         num_tpus = resources.pop("TPU", 0)
         # CPU is a *logical* resource: scale the per-worker request down so
@@ -183,9 +205,9 @@ class WorkerGroup:
             bundle.update(resources)
             self._pg = placement_group([dict(bundle)] * num_workers,
                                        strategy=placement_strategy)
-            self._pg.ready(timeout=120)
+            self._pg.ready(timeout=pg_timeout_s)
         self.workers = []
-        self._dead_rank: Optional[int] = None
+        self._dead_rank = None
         self._monitor = None
         try:
             for rank in range(num_workers):
@@ -229,6 +251,51 @@ class WorkerGroup:
                 None, self.workers,
                 [f"rank{r}" for r in range(num_workers)])
             self._monitor = GangMonitor(grp, self._on_worker_death)
+        self.incarnation += 1
+
+    def restart(self, num_workers: Optional[int] = None,
+                deadline_s: Optional[float] = None) -> int:
+        """Gang-native elastic restart: abort the whole gang (every rank
+        AND the placement group), then re-create it on a FRESH placement
+        group under the recovery deadline — shrinking the world when the
+        surviving topology cannot place the full gang (a killed node
+        whose replacement never came). Returns the new world size; raises
+        a loudly-attributed RuntimeError when no gang of ANY size could
+        be formed within the deadline (never hangs in pg.ready)."""
+        import time as _time
+
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        if deadline_s is None:
+            deadline_s = GLOBAL_CONFIG.chaos_recovery_deadline_s or 300.0
+        deadline = _time.monotonic() + deadline_s
+        self.shutdown()
+        n = num_workers if num_workers is not None else self.num_workers
+        last_err: Optional[BaseException] = None
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"train gang restart stuck: no {n}-worker gang could "
+                    f"be formed within the {deadline_s:.0f}s recovery "
+                    f"deadline (last error: {last_err})") from last_err
+            try:
+                self._create(n, pg_timeout_s=min(30.0, remaining))
+                logger.info("train gang restarted: world=%d incarnation=%d",
+                            n, self.incarnation)
+                return n
+            except Exception as e:  # noqa: BLE001 — retried under deadline
+                last_err = e
+                self._abort_gang()
+                if n > 1:
+                    # Elastic shrink: the full gang no longer places —
+                    # try a smaller world (checkpoint restore reshards).
+                    logger.warning(
+                        "train gang restart at world=%d failed (%s); "
+                        "shrinking to %d", n, e, n - 1)
+                    n -= 1
+                _time.sleep(min(0.5, max(0.0,
+                                         deadline - _time.monotonic())))
 
     def _abort_gang(self):
         for w in self.workers:

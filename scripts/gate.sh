@@ -22,6 +22,12 @@ step() {
 
 step "raylint" python -m ray_tpu.analysis ray_tpu/
 step "pytest tests/" python -m pytest tests/ -q
+# Seeded chaos smoke: ONE node kill under light serve load, deterministic
+# seed, <60s — zero hangs + bounded recovery asserted (exit nonzero on
+# either). The full bench_chaos (Poisson serve + training loop under the
+# whole schedule) stays a bench-only run.
+step "chaos smoke (seeded, 1 node kill)" \
+  env JAX_PLATFORMS=cpu python bench.py --chaos-smoke
 step "multichip dryrun (8 virtual devices)" \
   env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py 8

@@ -37,3 +37,123 @@ def test_actor_restart(ray_start_regular):
         except Exception:
             time.sleep(0.3)
     assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_restart_during_inflight_call(ray_start_regular):
+    """Kill the actor's worker process while a call is EXECUTING: the
+    caller must see ActorDiedError (or a successful retry) within a
+    bound — never a hang."""
+    import ray_tpu
+    from ray_tpu.exceptions import RayActorError
+
+    @ray_tpu.remote(max_restarts=1)
+    class Slow:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def slow_echo(self, x):
+            import time as _t
+
+            _t.sleep(3.0)
+            return x
+
+    a = Slow.remote()
+    pid1 = ray_tpu.get(a.pid.remote())
+    ref = a.slow_echo.remote(41)
+    time.sleep(0.5)  # the call is now executing inside the worker
+    import os as _os
+    import signal as _signal
+
+    _os.kill(pid1, _signal.SIGKILL)  # crash, not graceful
+    t0 = time.time()
+    try:
+        out = ray_tpu.get(ref, timeout=30)
+        assert out == 41  # a successful retry is acceptable
+    except RayActorError:
+        pass  # the documented outcome for in-flight calls
+    assert time.time() - t0 < 30, "in-flight call hung past its bound"
+
+    # The restarted incarnation serves fresh calls.
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_restart_hook_and_exhaustion_semantics(ray_start_regular):
+    """__ray_restart__ state-restore hook: never called on first
+    creation, called with the incarnation count on each restart, and
+    once restarts are exhausted the actor is terminally DEAD — callers
+    get ActorDiedError, no further incarnation (and no hook) ever runs."""
+    import ray_tpu
+    from ray_tpu.exceptions import RayActorError
+
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.restored_from = 0  # 0 = fresh __init__, no hook ran
+
+        def __ray_restart__(self, restart_count):
+            self.restored_from = restart_count
+
+        def state(self):
+            import os
+
+            return {"restored_from": self.restored_from,
+                    "pid": os.getpid()}
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    first = ray_tpu.get(p.state.remote())
+    assert first["restored_from"] == 0, "hook must not run on creation"
+
+    try:
+        ray_tpu.get(p.die.remote())
+    except Exception:
+        pass
+    deadline = time.time() + 30
+    second = None
+    while time.time() < deadline:
+        try:
+            second = ray_tpu.get(p.state.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert second is not None, "actor never restarted"
+    assert second["pid"] != first["pid"]
+    assert second["restored_from"] == 1, \
+        "state-restore hook must run with the incarnation count"
+
+    # Exhaust restarts: the second death is terminal.
+    try:
+        ray_tpu.get(p.die.remote())
+    except Exception:
+        pass
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(p.state.remote(), timeout=5)
+            time.sleep(0.3)  # still alive? (shouldn't restart again)
+        except RayActorError:
+            break  # terminal death observed
+        except Exception:
+            time.sleep(0.3)
+    else:
+        raise AssertionError("exhausted actor never reported DEAD")
+    # And it STAYS dead: fresh calls keep failing with the death error.
+    try:
+        ray_tpu.get(p.state.remote(), timeout=10)
+        raise AssertionError("call to a restart-exhausted actor succeeded")
+    except RayActorError:
+        pass

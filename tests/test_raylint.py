@@ -1045,3 +1045,118 @@ RL009_BAD_OPTIONS_CHAIN = """
 def test_rl009_flags_options_chain_gang(tmp_path):
     findings = lint_src(tmp_path, RL009_BAD_OPTIONS_CHAIN, rules=["RL009"])
     assert rule_ids(findings) == ["RL009"]
+
+
+# ------------------------------------------------------------------ RL010
+
+RL010_BAD_POLL = """
+    import time
+
+    def wait_for_peer(peer):
+        while True:
+            if peer.alive():
+                return True
+            time.sleep(0.1)
+"""
+
+RL010_BAD_EVENT_POLL = """
+    def drain(queue_obj, ev):
+        while True:
+            if queue_obj.empty():
+                ev.wait(0.5)
+                continue
+            queue_obj.pop()
+"""
+
+RL010_GOOD_DEADLINE = """
+    import time
+
+    def wait_for_peer(peer, deadline_s=30.0):
+        deadline = time.monotonic() + deadline_s
+        while True:
+            if peer.alive():
+                return True
+            if time.monotonic() > deadline:
+                raise TimeoutError("peer never came up")
+            time.sleep(0.1)
+"""
+
+RL010_GOOD_ATTEMPTS = """
+    import time
+
+    def call_with_retries(fn, max_attempts=5):
+        attempts = 0
+        while True:
+            try:
+                return fn()
+            except ConnectionError:
+                attempts += 1
+                if attempts >= max_attempts:
+                    raise
+                time.sleep(0.1)
+"""
+
+RL010_GOOD_SERVICE_LOOP = """
+    def heartbeat_loop(self):
+        # Event-conditioned service loop: the stop signal is the bound.
+        while not self._stopped.wait(1.0):
+            self.send_heartbeat()
+"""
+
+RL010_GOOD_KEEPALIVE = """
+    import time
+
+    def daemon_main():
+        while True:  # woken only by signals
+            time.sleep(3600)
+"""
+
+RL010_GOOD_TIMEOUT_KWARG = """
+    import time
+
+    def pump(refs, runtime):
+        while True:
+            ready = runtime.wait(refs, timeout=30.0)
+            if not refs:
+                return
+            time.sleep(0.01)
+"""
+
+
+def test_rl010_flags_unbounded_poll(tmp_path):
+    findings = lint_src(tmp_path, RL010_BAD_POLL, rules=["RL010"])
+    assert rule_ids(findings) == ["RL010"]
+    assert "deadline" in findings[0].message
+
+
+def test_rl010_flags_event_poll(tmp_path):
+    findings = lint_src(tmp_path, RL010_BAD_EVENT_POLL, rules=["RL010"])
+    assert rule_ids(findings) == ["RL010"]
+
+
+def test_rl010_quiet_on_deadline(tmp_path):
+    assert lint_src(tmp_path, RL010_GOOD_DEADLINE, rules=["RL010"]) == []
+
+
+def test_rl010_quiet_on_attempt_bound(tmp_path):
+    assert lint_src(tmp_path, RL010_GOOD_ATTEMPTS, rules=["RL010"]) == []
+
+
+def test_rl010_quiet_on_service_loop(tmp_path):
+    assert lint_src(tmp_path, RL010_GOOD_SERVICE_LOOP, rules=["RL010"]) == []
+
+
+def test_rl010_quiet_on_signal_keepalive(tmp_path):
+    assert lint_src(tmp_path, RL010_GOOD_KEEPALIVE, rules=["RL010"]) == []
+
+
+def test_rl010_timeout_kwarg_is_bound_evidence(tmp_path):
+    assert lint_src(tmp_path, RL010_GOOD_TIMEOUT_KWARG,
+                    rules=["RL010"]) == []
+
+
+def test_rl010_suppression(tmp_path):
+    src = RL010_BAD_POLL.replace(
+        "while True:",
+        "while True:  # raylint: disable=RL010")
+    assert lint_src(tmp_path, src, rules=["RL010"]) == []
