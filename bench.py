@@ -9,7 +9,9 @@ baseline (70k tok/s) is the round-1 judge's unoptimized probe on this chip
 microbenchmark suite (`python/ray/_private/ray_perf.py:93-173`): tasks/s,
 actor calls/s, object put/get throughput.
 
-Usage: python bench.py [--quick] [--skip-core] [--skip-train]
+Usage: python bench.py [--quick] [--skip-<plane> ...]
+Every plane is individually skippable: core, train, ppo, serve,
+inference, sharded, zoo, envelope, pull, collective, tracing, chaos.
 """
 
 from __future__ import annotations
@@ -929,16 +931,20 @@ def bench_collective(quick: bool) -> dict:
     return out
 
 
-async def _read_http_response(reader):
+async def _read_http_response(reader) -> int:
     """Minimal keep-alive response read (headers + content-length body)
-    shared by both lean bench clients — one copy of the parsing."""
+    shared by every lean bench client — one copy of the parsing.
+    Returns the status code (the zoo client tells 429 quota rejections
+    from served requests; the other clients ignore it)."""
     hdr = await reader.readuntil(b"\r\n\r\n")
+    status = int(hdr.split(b" ", 2)[1])
     clen = 0
     for line in hdr.split(b"\r\n"):
         if line[:15].lower() == b"content-length:":
             clen = int(line[15:])
     if clen:
         await reader.readexactly(clen)
+    return status
 
 
 def _lean_http_load(port: int, path: str, n: int, conns: int,
@@ -1062,6 +1068,324 @@ def _poisson_http_load(port: int, path: str, rate: float, duration_s: float,
     return _asyncio.run(run())
 
 
+def _zoo_poisson_load(port: int, streams: list, duration_s: float,
+                      seed: int = 0, conns: int = 8) -> dict:
+    """Multi-tenant open-loop load for bench_zoo: every stream draws its
+    own Poisson arrivals (diurnally modulated by thinning against the
+    peak rate) over a zipf-weighted path set, all merged onto one clock.
+    Per-stream connection pools keep client-side queueing of one tenant
+    from polluting another's latencies. Returns per-tag {n, p50_ms,
+    p99_ms, errors, rejected_429, achieved_rps}."""
+    import asyncio as _asyncio
+    import math as _math
+    import random as _random
+
+    def build_req(path: str) -> bytes:
+        body = b"7"
+        return ((f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+                 f"Content-Type: application/json\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+
+    rng = _random.Random(seed)
+    arrivals = []
+    for s in streams:
+        rate, diurnal = s["rate"], s.get("diurnal", 0.0)
+        period = s.get("period", duration_s)
+        phase = s.get("phase", 0.0)
+        peak = rate * (1.0 + diurnal)
+        reqs = [build_req(p) for p in s["paths"]]
+        weights = s["weights"]
+        t = 0.0
+        while True:
+            t += rng.expovariate(peak)
+            if t >= duration_s:
+                break
+            if diurnal:
+                cur = rate * (1.0 + diurnal * _math.sin(
+                    2 * _math.pi * t / period + phase))
+                if rng.random() * peak > max(cur, 0.0):
+                    continue  # thinned away: the diurnal trough
+            i = rng.choices(range(len(reqs)), weights=weights)[0]
+            arrivals.append((t, s["tag"], reqs[i]))
+    arrivals.sort(key=lambda a: a[0])
+    stats = {s["tag"]: {"lats": [], "errors": 0, "rejected_429": 0, "n": 0}
+             for s in streams}
+
+    async def run():
+        pools = {}
+        for s in streams:
+            pool: _asyncio.Queue = _asyncio.Queue()
+            for _ in range(conns):
+                pool.put_nowait(await _asyncio.open_connection(
+                    "127.0.0.1", port))
+            pools[s["tag"]] = pool
+
+        async def one(tag: str, req: bytes):
+            st = stats[tag]
+            st["n"] += 1
+            pool = pools[tag]
+            t0 = time.perf_counter()  # includes conn-pool wait
+            rw = await pool.get()
+            if rw is None:
+                try:
+                    rw = await _asyncio.open_connection("127.0.0.1", port)
+                except Exception:  # noqa: BLE001 — server still down
+                    st["errors"] += 1
+                    pool.put_nowait(None)
+                    return
+            reader, writer = rw
+            try:
+                writer.write(req)
+                await writer.drain()
+                status = await _read_http_response(reader)
+                if status == 429:
+                    st["rejected_429"] += 1
+                elif status >= 400:
+                    st["errors"] += 1
+                else:
+                    st["lats"].append(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — count, replace the conn
+                st["errors"] += 1
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    reader, writer = await _asyncio.open_connection(
+                        "127.0.0.1", port)
+                except Exception:  # noqa: BLE001 — re-dial next use
+                    pool.put_nowait(None)
+                    return
+            pool.put_nowait((reader, writer))
+
+        tasks = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(arrivals):
+            now = time.perf_counter() - t0
+            while i < len(arrivals) and arrivals[i][0] <= now:
+                tasks.append(_asyncio.create_task(
+                    one(arrivals[i][1], arrivals[i][2])))
+                i += 1
+            if i < len(arrivals):
+                await _asyncio.sleep(max(
+                    0.0, arrivals[i][0] - (time.perf_counter() - t0)))
+        await _asyncio.gather(*tasks)
+        for pool in pools.values():
+            while not pool.empty():
+                rw = pool.get_nowait()
+                if rw is not None:
+                    rw[1].close()
+
+    _asyncio.run(run())
+    out = {}
+    for tag, st in stats.items():
+        lats = sorted(st["lats"])
+
+        def pct(p, lats=lats):
+            return round(lats[min(len(lats) - 1, int(p * len(lats)))]
+                         * 1e3, 2) if lats else None
+
+        out[tag] = {"n": st["n"], "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+                    "errors": st["errors"],
+                    "rejected_429": st["rejected_429"],
+                    "achieved_rps": round(len(lats) / duration_s, 1)}
+    return out
+
+
+def bench_zoo(quick: bool) -> dict:
+    """Model-zoo multi-tenancy acceptance (ISSUE 11 / ROADMAP 3): a
+    mostly-parked zoo of deployments under per-tenant QoS — zipf
+    popularity, Poisson diurnal arrivals per tenant, per-tier p99
+    budgets, an isolation A/B proving a quota-saturating tenant cannot
+    move a victim tenant's p99 past budget, controller reconcile cost
+    sublinear in parked deployments, and the multiplexed-LLM compile
+    proof (zero new XLA programs)."""
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    out: dict = {}
+    n_dep = 60 if quick else 200
+    duration = 8.0 if quick else 16.0
+    tiers = ("gold", "silver", "bronze")
+    serve.register_tenant("gold", tier="gold")
+    serve.register_tenant("silver", tier="silver")
+    serve.register_tenant("bronze", tier="bronze")
+    # The attacker: a quota'd bronze tenant that will offer many times
+    # its allowance. Its over-quota excess must die as cheap 429s.
+    serve.register_tenant("attacker", tier="bronze", rps_limit=20,
+                          burst=20, max_inflight=8)
+
+    @serve.deployment
+    class ZooEcho:
+        def __call__(self, payload):
+            return payload
+
+    def _reconcile_stats():
+        c = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        return ray_tpu.get(c.reconcile_stats.remote(), timeout=10)
+
+    def _median_tick_ms(samples=8):
+        vals = []
+        for _ in range(samples):
+            vals.append(_reconcile_stats()["last_tick_ms"])
+            time.sleep(0.12)
+        return sorted(vals)[len(vals) // 2]
+
+    try:
+        # Reconciler cost before the zoo exists (near-empty controller).
+        serve.run(ZooEcho.options(name="zoo_warm").bind())
+        tick_small = _median_tick_ms()
+
+        t0 = time.perf_counter()
+        for i in range(n_dep):
+            serve.run(ZooEcho.options(
+                name=f"zoo{i:03d}", tenant=tiers[i % 3],
+                max_concurrent_queries=32,
+                autoscaling_config=serve.AutoscalingConfig(
+                    min_replicas=0, max_replicas=1, upscale_delay_s=0.2,
+                    downscale_delay_s=5.0)).bind())
+        out["zoo_deployments"] = n_dep
+        out["zoo_deploy_s"] = round(time.perf_counter() - t0, 2)
+        serve.run(ZooEcho.options(
+            name="zoo_attacked", tenant="attacker",
+            max_concurrent_queries=32,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=0, max_replicas=1,
+                downscale_delay_s=30.0)).bind())
+
+        # Reconciler cost with the zoo parked: the sublinearity proof.
+        time.sleep(1.0)
+        tick_parked = _median_tick_ms()
+        st = _reconcile_stats()
+        out["zoo_reconcile_tick_ms_small"] = tick_small
+        out["zoo_reconcile_tick_ms_parked"] = tick_parked
+        out["zoo_reconcile_last_scanned"] = st["last_scanned"]
+        out["zoo_reconcile_parked_skipped"] = st["last_parked_skipped"]
+        # Sublinear: the zoo multiplied deployments ~100x (2 -> 200);
+        # the tick may not grow anywhere near that (10x is the soft
+        # ceiling — the sandbox's ambient noise dwarfs both numbers).
+        out["zoo_reconcile_sublinear"] = \
+            tick_parked <= max(10 * max(tick_small, 0.05), 5.0)
+
+        port = serve.http_port()
+
+        # Zipf popularity over each tier's deployments: the head stays
+        # warm, the tail stays parked and pays a cold start when the
+        # diurnal peak reaches it.
+        def tier_paths(tier_idx, top=8):
+            names = [f"/zoo{i:03d}" for i in range(n_dep)
+                     if i % 3 == tier_idx]
+            names = names[:top]
+            weights = [1.0 / (k + 1) ** 1.1 for k in range(len(names))]
+            return names, weights
+
+        def tier_stream(tag, tier_idx, rate, phase):
+            paths, weights = tier_paths(tier_idx)
+            return {"tag": tag, "paths": paths, "weights": weights,
+                    "rate": rate, "diurnal": 0.6, "period": duration,
+                    "phase": phase}
+
+        base_streams = [
+            tier_stream("gold", 0, 25.0, 0.0),
+            tier_stream("silver", 1, 15.0, 2.1),
+            tier_stream("bronze", 2, 8.0, 4.2),
+        ]
+        # Warm each tier's most popular deployment so the A/B compares
+        # steady traffic, not three simultaneous first-ever cold starts.
+        for s in base_streams:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{s['paths'][0]}", data=b"7",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=60).read()
+
+        # Phase A: the three tiers alone.
+        res_a = _zoo_poisson_load(port, base_streams, duration, seed=1)
+        # Phase B: same tiers + the attacker offering 8x its 20 rps
+        # quota against its own deployment.
+        attacker = {"tag": "attacker", "paths": ["/zoo_attacked"],
+                    "weights": [1.0], "rate": 160.0}
+        res_b = _zoo_poisson_load(port, base_streams + [attacker],
+                                  duration, seed=2)
+
+        for tier in ("gold", "silver", "bronze"):
+            out[f"zoo_{tier}_p50_ms"] = res_b[tier]["p50_ms"]
+            out[f"zoo_{tier}_p99_ms"] = res_b[tier]["p99_ms"]
+            out[f"zoo_{tier}_errors"] = res_b[tier]["errors"]
+        out["zoo_attacker_offered"] = res_b["attacker"]["n"]
+        out["zoo_attacker_429"] = res_b["attacker"]["rejected_429"]
+        out["zoo_attacker_429_rate"] = round(
+            res_b["attacker"]["rejected_429"]
+            / max(1, res_b["attacker"]["n"]), 3)
+
+        # Per-tier p99 budgets (sandbox-calibrated: 2 CPU-throttled
+        # cores, cold starts in the tail) — soft flags, like
+        # serve_scaleup_regressed.
+        budgets = {"gold": 750.0, "silver": 1250.0, "bronze": 2500.0}
+        held = all(res_b[t]["p99_ms"] is not None
+                   and res_b[t]["p99_ms"] <= budgets[t] for t in budgets)
+        out["zoo_tier_budgets_held"] = held
+        if not held:
+            print(f"WARNING: zoo tier p99 budgets missed: "
+                  f"{ {t: res_b[t]['p99_ms'] for t in budgets} }",
+                  file=sys.stderr)
+
+        # Isolation A/B: the victim (gold) tier's p99 with the attacker
+        # saturating its quota vs without. Acceptance: shift < 20%.
+        a99, b99 = res_a["gold"]["p99_ms"], res_b["gold"]["p99_ms"]
+        if a99 and b99:
+            shift = (b99 - a99) / a99 * 100.0
+            out["zoo_isolation_victim_p99_a_ms"] = a99
+            out["zoo_isolation_victim_p99_b_ms"] = b99
+            out["zoo_isolation_p99_shift_pct"] = round(shift, 1)
+            out["zoo_isolation_regressed"] = shift >= 20.0
+            if shift >= 20.0:
+                print(f"WARNING: attacker moved the victim's p99 by "
+                      f"{shift:.0f}% (budget < 20%)", file=sys.stderr)
+
+        # Cold-start sample off a far-tail parked deployment.
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/zoo{n_dep - 1:03d}", data=b"7",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=60).read()
+        out["zoo_coldstart_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+
+        # Multiplexed-LLM compile proof: several adapters on one
+        # replica, one paged arena, and EXACTLY the PR-3 program count.
+        from ray_tpu.inference import LLMServer
+
+        adapters = {f"m{k}": {"seed": 100 + k, "rank": 8}
+                    for k in range(4)}
+        llm = serve.run(LLMServer.options(
+            name="zoo_llm", num_replicas=1, tenant="gold",
+            max_concurrent_queries=16).bind("tiny", 256, 8, None,
+                                            adapters))
+        for k in range(4):
+            ray_tpu.get(llm.generate.remote(
+                {"ids": [1, 2, 3], "max_new_tokens": 4,
+                 "model_id": f"m{k}"}), timeout=120)
+        m = ray_tpu.get(llm.metrics.remote(None), timeout=30)
+        out["zoo_mux_adapters_resident"] = len(
+            m["adapters"]["resident"])
+        out["zoo_mux_adapter_loads"] = m["adapters"]["loads"]
+        out["zoo_mux_prefill_compiles"] = m["prefill_compiles"]
+        out["zoo_mux_decode_compiles"] = m["decode_compiles"]
+        out["zoo_mux_zero_new_programs"] = (
+            m["prefill_compiles"] == 1 and m["decode_compiles"] == 1)
+        out["zoo_mux_leaked_blocks"] = m["kv"]["blocks_in_use"]
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown is best effort
+            pass
+    return out
+
+
 def bench_serve_fastpath(quick: bool) -> dict:
     """Serve fast data plane (ISSUE 8): closed-loop proxy capacity,
     Poisson open-loop latency, the zero-pickle/zero-leak proofs, and the
@@ -1156,6 +1480,16 @@ def bench_serve_fastpath(quick: bool) -> dict:
             (time.perf_counter() - t0) * 1e3, 1)
         st = serve.status().get("ColdEcho", {})
         out["serve_coldstart_controller_ms"] = st.get("cold_start_ms")
+        # Soft regression flag (same convention as serve_scaleup_regressed;
+        # ROADMAP item-3 leftover): the tier-1 acceptance bound is 500ms
+        # against a 60-90ms steady state — flag, don't fail, the sandbox's
+        # ambient variance is high.
+        out["serve_coldstart_regressed"] = \
+            out["serve_coldstart_ms"] > 500.0
+        if out["serve_coldstart_regressed"]:
+            print(f"WARNING: serve_coldstart_ms "
+                  f"{out['serve_coldstart_ms']} exceeds the 500ms soft "
+                  "budget", file=sys.stderr)
     finally:
         serve.delete("ColdEcho")
         serve.shutdown()
@@ -1860,9 +2194,13 @@ def main(out=None):
     ap.add_argument("--skip-ppo", action="store_true")
     ap.add_argument("--skip-serve", action="store_true")
     ap.add_argument("--skip-inference", action="store_true")
+    ap.add_argument("--skip-sharded", action="store_true")
     ap.add_argument("--skip-envelope", action="store_true")
+    ap.add_argument("--skip-collective", action="store_true")
+    ap.add_argument("--skip-pull", action="store_true")
     ap.add_argument("--skip-tracing", action="store_true")
     ap.add_argument("--skip-chaos", action="store_true")
+    ap.add_argument("--skip-zoo", action="store_true")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="run ONLY the seeded chaos smoke (gate step: one "
                          "node kill under light serve load, <60s) and "
@@ -1954,19 +2292,27 @@ def main(out=None):
             extra.update(bench_inference(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["inference_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_sharded:
         try:
             extra.update(bench_sharded(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["sharded_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_zoo:
+        try:
+            extra.update(bench_zoo(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["zoo_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_envelope:
         try:
             extra.update(bench_envelope(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["envelope_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_pull:
         try:
             extra.update(bench_pull_pipelining(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["pull_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_collective:
         try:
             extra.update(bench_collective(args.quick))
         except Exception as e:  # noqa: BLE001

@@ -17,6 +17,9 @@ for the catalog with real before/after examples):
 - RL010 retry-without-deadline — poll/retry loops carry a deadline or a
                                   bounded attempt count (the hang-shaped
                                   class the chaos plane hunts)
+- RL011 unbounded-keyed-state  — per-key dicts on long-lived control-
+                                  plane objects have an eviction path
+                                  (the model-zoo churn leak shape)
 """
 
 from __future__ import annotations
@@ -1094,3 +1097,180 @@ def rl010_retry_without_deadline(ctx: FileContext) -> Iterable[Finding]:
             "deadline, timeout, or attempt bound — under a fault this "
             "spins forever; bound it (deadline/attempts) or justify "
             "with a disable comment and watchdog visibility")
+
+
+# =====================================================================
+# RL011 unbounded-keyed-state
+# =====================================================================
+#
+# The model-zoo churn leak shape (docs/MULTITENANCY.md): a long-lived
+# control-plane object grows a dict keyed by per-request / per-tenant /
+# per-replica identifiers and nothing ever removes an entry. Tenants
+# register and leave, replicas restart forever, deployments churn — a
+# registry keyed by every id that EVER existed passes every test and
+# OOMs in week three. Statically checkable shape:
+#
+#   class Router:                     # control-plane module
+#       def __init__(self):
+#           self._inflight = {}       # dict attribute born empty
+#       def reserve(self, rid):
+#           self._inflight[rid] = 1   # keyed write, non-constant key
+#
+# with NO eviction evidence for that attribute anywhere in the class:
+# no .pop()/.popitem()/.clear(), no `del d[k]`, no whole-dict
+# reassignment outside __init__, and the dict never handed off bare as
+# a call argument (ownership/pruning may live with the callee).
+# Constant keys (fixed enum-like state) are exempt — the key space
+# cannot grow.
+#
+# Caches that are bounded BY CONSTRUCTION (keys drawn from a finite set
+# the checker cannot see, e.g. a user class's method names) annotate
+# with `# raylint: disable=RL011 — <why the key space is bounded>`.
+
+_RL011_PACKAGES = {"core", "serve", "inference", "tenancy", "collective",
+                   "shardgroup", "observability", "chaos", "autoscaler"}
+
+
+def _in_scope_rl011(path: str) -> bool:
+    # Same real-location scoping as RL004: fixtures and out-of-tree
+    # files are always checked; in-tree files only in the long-lived
+    # control-plane packages.
+    parts = os.path.abspath(path).replace("\\", "/").split("/")
+    for idx in range(len(parts) - 1, -1, -1):
+        if parts[idx] != "ray_tpu":
+            continue
+        root = "/".join(parts[:idx + 1])
+        if os.path.isfile(os.path.join(root, "__init__.py")):
+            return (len(parts) > idx + 2
+                    and parts[idx + 1] in _RL011_PACKAGES)
+    return True
+
+
+_RL011_DICT_CTORS = {"dict", "defaultdict", "OrderedDict", "Counter"}
+_RL011_EVICT_METHODS = {"pop", "popitem", "clear"}
+
+
+def _rl011_self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a plain `self.x` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _rl011_dict_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """Attr -> lineno for `self.x = {}`-style dicts born in __init__."""
+    out: Dict[str, int] = {}
+    for fn in cls.body:
+        if not (isinstance(fn, _FUNC_NODES) and fn.name == "__init__"):
+            continue
+        for stmt in statements(fn.body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt, val = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                tgt, val = stmt.target, stmt.value
+            else:
+                continue
+            attr = _rl011_self_attr(tgt)
+            if attr is None:
+                continue
+            if isinstance(val, ast.Dict) and not val.keys:
+                out[attr] = stmt.lineno
+            elif isinstance(val, ast.Call) and not val.args and \
+                    last_segment(dotted(val.func)) in _RL011_DICT_CTORS:
+                out[attr] = stmt.lineno
+    return out
+
+
+def _rl011_cleaned_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attrs with eviction/handoff evidence anywhere in the class."""
+    out: Set[str] = set()
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES):
+            continue
+        init = fn.name == "__init__"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                # self.x.pop(...) / .popitem() / .clear()
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _RL011_EVICT_METHODS:
+                    attr = _rl011_self_attr(node.func.value)
+                    if attr:
+                        out.add(attr)
+                # Bare handoff: helper(self.x) — pruning may live with
+                # the callee (mirrors RL003's ownership-handoff rule).
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    attr = _rl011_self_attr(arg)
+                    if attr:
+                        out.add(attr)
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _rl011_self_attr(tgt.value)
+                        if attr:
+                            out.add(attr)
+            elif not init and isinstance(node, ast.Assign):
+                # Whole-dict reassignment outside __init__ rebuilds /
+                # resets the container.
+                for tgt in node.targets:
+                    attr = _rl011_self_attr(tgt)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _rl011_keyed_writes(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """Attr -> first steady-state keyed write with a non-constant key
+    (`self.x[k] = v`, `self.x[k] += v`, `self.x.setdefault(k, ...)`)."""
+    out: Dict[str, ast.AST] = {}
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES) or fn.name == "__init__":
+            continue
+        for node in ast.walk(fn):
+            attr, key = None, None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        a = _rl011_self_attr(tgt.value)
+                        if a:
+                            attr, key = a, tgt.slice
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setdefault" and node.args:
+                a = _rl011_self_attr(node.func.value)
+                if a:
+                    attr, key = a, node.args[0]
+            if attr is None or isinstance(key, ast.Constant):
+                continue  # constant keys: the key space cannot grow
+            if attr not in out or node.lineno < out[attr].lineno:
+                out[attr] = node
+    return out
+
+
+@rule("RL011", "unbounded-keyed-state: per-key dict on a long-lived "
+               "object with no eviction/cleanup path")
+def rl011_unbounded_keyed_state(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl011(ctx.path):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        dicts = _rl011_dict_attrs(cls)
+        if not dicts:
+            continue
+        cleaned = _rl011_cleaned_attrs(cls)
+        writes = _rl011_keyed_writes(cls)
+        for attr, node in sorted(writes.items(),
+                                 key=lambda kv: kv[1].lineno):
+            if attr not in dicts or attr in cleaned:
+                continue
+            yield ctx.finding(
+                node, "RL011",
+                f"`self.{attr}` grows one entry per key and nothing in "
+                f"{cls.name} ever removes one — under churn (tenants, "
+                "replicas, requests) this dict grows forever; add an "
+                "eviction/prune path or annotate why the key space is "
+                "bounded")

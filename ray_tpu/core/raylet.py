@@ -181,6 +181,10 @@ class WorkerHandle:
     # first) and, if the OOM killer chose this worker, why.
     task_started: float = 0.0
     oom_kill_reason: Optional[str] = None
+    # When mark_dead ran: the reaper prunes long-dead handles from the
+    # pool after a grace window (late exit events / by-id lookups still
+    # resolve inside it) so worker churn cannot grow the pool forever.
+    died_at: float = 0.0
 
 
 class WorkerPool:
@@ -439,9 +443,25 @@ class WorkerPool:
                         "worker logs in %s. Respawns are throttled to one "
                         "per 5s until a worker starts successfully.", log_dir)
             handle.state = "dead"
+            handle.died_at = time.monotonic()
         # Wake spawn-waiters (actor creation) parked on registration.
         handle.registered.set()
         return handle
+
+    def prune_dead(self, grace_s: float = 10.0) -> int:
+        """Drop handles that have been dead past the grace window (the
+        raylet reaper's anti-entropy call). Without this, worker churn
+        grows `_workers` by one dead WorkerHandle — Popen object, env
+        dict and all — per spawn, forever (RL011's leak shape)."""
+        now = time.monotonic()
+        pruned = 0
+        with self._lock:
+            for wid, h in list(self._workers.items()):
+                if h.state == "dead" and h.died_at \
+                        and now - h.died_at > grace_s:
+                    self._workers.pop(wid, None)
+                    pruned += 1
+        return pruned
 
     def spawn_allowed(self) -> bool:
         with self._lock:
@@ -1043,6 +1063,9 @@ class Raylet:
             for h in handles:
                 if h.proc is not None and h.proc.poll() is not None and h.state != "dead":
                     self._on_worker_dead(h, f"process exited with code {h.proc.returncode}")
+            # Long-dead handles leave the pool after a grace window so
+            # worker churn cannot grow it without bound.
+            self.pool.prune_dead()
 
     # ------------------------------------------------------- GCS push events
 
@@ -2396,12 +2419,29 @@ class Raylet:
         return len(peers) > 0 or advertised > 0
 
     def _peer(self, address: str) -> RpcClient:
+        stale = []
         with self._lock:
             client = self._peer_clients.get(address)
             if client is None or client.is_closed:
+                # Amortized pruning on the (rare) dial path: node churn
+                # must not grow the peer cache by one client — reconnect
+                # state and all — per address that ever existed. A peer
+                # is stale once closed or once no live node advertises
+                # its address anymore (closed outside the lock).
+                live = {e.get("address")
+                        for e in self._cluster_view.values()}
+                for addr in list(self._peer_clients):
+                    c = self._peer_clients[addr]
+                    if c.is_closed or (live and addr not in live):
+                        stale.append(self._peer_clients.pop(addr))
                 client = RpcClient(address, name=f"raylet-peer")
                 self._peer_clients[address] = client
-            return client
+        for c in stale:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — already closed/dead peer
+                pass
+        return client
 
     # A puller with no chunk served for this long no longer counts against
     # the sender-side concurrency gate (its transfer finished or died).

@@ -256,6 +256,8 @@ class RpcServer:
         self.on_disconnect: Optional[Callable[[Connection], None]] = None
 
     def register(self, method: str, handler: Callable[[Connection, Any], Any]):
+        # Keyed by method name, registered once at server bring-up.
+        # raylint: disable=RL011 — the key space is fixed by the code
         self._handlers[method] = handler
 
     def register_raw(self, method: str,
@@ -265,6 +267,7 @@ class RpcServer:
         clients (cpp/) frame msgpack envelopes like everyone else but
         cannot produce or parse pickled payloads, so raw methods let them
         carry msgpack (or any agreed encoding) end to end."""
+        # raylint: disable=RL011 — method names, registered at bring-up
         self._raw_handlers[method] = handler
 
     def register_instance(self, obj: Any, prefix: str = ""):

@@ -204,6 +204,10 @@ class EnvCache:
                 or not self._pip_fresh(prepared):
             prepared = prepare(runtime_env, self._gcs)
         with self._lock:
+            # Deliberate prepared-env cache: keys are distinct
+            # runtime_env signatures (bounded by the workload's env
+            # variety) and entries are revalidated, not per-request.
+            # raylint: disable=RL011 — bounded by distinct runtime_envs
             self._entries[key] = (prepared, now)
         return prepared
 

@@ -219,6 +219,10 @@ class WorkerRuntime(CoreRuntime):
             if blob is None:
                 raise RuntimeError(f"function {fn_id} not found in GCS function table")
             fn = serialization.loads(blob)
+            # The exported-function cache (one entry per distinct
+            # @remote definition, same as the reference's function
+            # table): bounded by driver code size.
+            # raylint: disable=RL011 — bounded by @remote definitions
             self._fn_cache[fn_id] = fn
         return fn
 

@@ -12,6 +12,7 @@ Serve deployment streaming tokens as they are produced (`api`).
         ...
 """
 
+from ray_tpu.inference.adapters import AdapterLoadError, AdapterManager
 from ray_tpu.inference.engine import (
     EngineConfig,
     EngineLoop,
@@ -21,6 +22,8 @@ from ray_tpu.inference.engine import (
 from ray_tpu.inference.kv_cache import BlockManager
 
 __all__ = [
+    "AdapterLoadError",
+    "AdapterManager",
     "BlockManager",
     "EngineConfig",
     "EngineLoop",

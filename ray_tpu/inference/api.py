@@ -31,7 +31,8 @@ def _parse(payload: Optional[Dict[str, Any]], default_new: int):
     payload = payload or {}
     ids = [int(t) for t in payload.get("ids", [])] or [0]
     max_new = max(1, int(payload.get("max_new_tokens", default_new)))
-    return ids, max_new
+    model_id = payload.get("model_id") or payload.get("model")
+    return ids, max_new, (str(model_id) if model_id is not None else None)
 
 
 @serve.deployment(max_concurrent_queries=64)
@@ -47,10 +48,32 @@ class LLMServer:
     def __init__(self, model_size: str = "tiny",
                  max_model_len: int = 256,
                  default_new_tokens: int = 16,
-                 engine_config: Optional[Dict[str, Any]] = None):
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 adapters: Optional[Dict[str, Dict[str, Any]]] = None,
+                 max_resident_adapters: int = 0):
         kwargs = dict(engine_config or {})
         kwargs.setdefault("model_size", model_size)
         kwargs.setdefault("max_model_len", max_model_len)
+        # Model multiplexing: `adapters` registers the replica's servable
+        # LoRA models ({model_id: {"seed": int, "rank": r, "scale": s}}).
+        # Weights are DERIVED (deterministically, from the seed) on
+        # demand, loaded into the shared bank LRU-style — a respawned
+        # replica reloads an adapter the moment a request names it, bit-
+        # identical to before the crash. max_resident_adapters bounds
+        # bank rows (default: all registered adapters resident at once).
+        self._adapter_specs = {str(k): dict(v or {})
+                               for k, v in (adapters or {}).items()}
+        if self._adapter_specs:
+            ranks = {int(s.get("rank", 8))
+                     for s in self._adapter_specs.values()}
+            if len(ranks) > 1:
+                raise ValueError(
+                    f"all adapters of a replica share one bank rank; "
+                    f"got {sorted(ranks)}")
+            kwargs.setdefault("max_adapters",
+                              max_resident_adapters
+                              or len(self._adapter_specs))
+            kwargs.setdefault("lora_rank", ranks.pop())
         self._default_new = default_new_tokens
         self._config = EngineConfig(**kwargs)
         # Sharded replica groups: when this replica is a gang rank the
@@ -62,7 +85,25 @@ class LLMServer:
 
         self._engine = InferenceEngine(self._config,
                                        mesh=shardgroup.current_mesh())
+        if self._adapter_specs:
+            self._engine.register_adapter_source(self._load_adapter)
         self._loop = EngineLoop(self._engine)
+
+    def _load_adapter(self, model_id: str):
+        """Engine adapter source: spec -> deterministic weights (the
+        parity and chaos tests depend on seed => same bytes)."""
+        from ray_tpu.models.llama import make_adapter_weights
+
+        spec = self._adapter_specs.get(model_id)
+        if spec is None:
+            raise ValueError(
+                f"unknown model {model_id!r} (registered: "
+                f"{sorted(self._adapter_specs)})")
+        return make_adapter_weights(
+            self._engine._model.config,
+            rank=int(spec.get("rank", 8)),
+            seed=int(spec.get("seed", 0)),
+            scale=float(spec.get("scale", 0.05)))
 
     # ------------------------------------------------------------ complete
 
@@ -76,7 +117,7 @@ class LLMServer:
         return await self.generate(payload)
 
     async def generate(self, payload=None):
-        ids, max_new = _parse(payload, self._default_new)
+        ids, max_new, model_id = _parse(payload, self._default_new)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
 
@@ -90,7 +131,8 @@ class LLMServer:
                     fut.set_result(None)
             loop.call_soon_threadsafe(_resolve)
 
-        req = self._loop.submit(ids, max_new, on_finish=on_finish)
+        req = self._loop.submit(ids, max_new, on_finish=on_finish,
+                                model_id=model_id)
         try:
             await fut
         except asyncio.CancelledError:
@@ -106,7 +148,7 @@ class LLMServer:
         ``{"done": True, "ids": [...]}`` — replica pumps it through the
         stream queue, the proxy relays chunked JSON lines, handles iterate
         it with ``options(stream=True)``."""
-        ids, max_new = _parse(payload, self._default_new)
+        ids, max_new, model_id = _parse(payload, self._default_new)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
@@ -117,7 +159,7 @@ class LLMServer:
             loop.call_soon_threadsafe(queue.put_nowait, ("end", req))
 
         req = self._loop.submit(ids, max_new, on_token=on_token,
-                                on_finish=on_finish)
+                                on_finish=on_finish, model_id=model_id)
         try:
             while True:
                 kind, item = await queue.get()
@@ -143,11 +185,18 @@ class LLMServer:
 
     def __serve_metrics__(self) -> Dict[str, Any]:
         """Autoscaling signal (replica merges this into its stats): queued
-        requests count toward pressure exactly like in-flight ones."""
+        requests count toward pressure exactly like in-flight ones. For
+        multiplexed replicas the resident adapter ids ride along — the
+        controller pushes them in the routing table so routers prefer a
+        replica that already holds the request's adapter."""
         stats = self._engine.stats()
-        return {"queue_depth": stats["queue_depth"],
-                "running": stats["running"],
-                "tokens_per_sec": stats["tokens_per_sec"]}
+        out = {"queue_depth": stats["queue_depth"],
+               "running": stats["running"],
+               "tokens_per_sec": stats["tokens_per_sec"]}
+        adapters = stats.get("adapters")
+        if adapters is not None:
+            out["adapters"] = adapters["resident"]
+        return out
 
     def __serve_shutdown__(self) -> None:
         self._loop.stop()

@@ -66,6 +66,12 @@ class EngineConfig:
     eos_id: Optional[int] = None    # stop token (None = budget only)
     use_jit: bool = True            # False = eager smoke mode
     scheduling: str = "continuous"  # or "static" (@serve.batch emulation)
+    # Model multiplexing (docs/MULTITENANCY.md): >0 hosts that many
+    # LoRA-style adapters on this engine — one shared paged arena, the
+    # SAME two compiled programs (adapter routing is a per-row index
+    # argument), per-replica LRU residency. 0 = classic single model.
+    max_adapters: int = 0
+    lora_rank: int = 8
 
     @property
     def max_context(self) -> int:
@@ -91,6 +97,10 @@ class Request:
     # Trace context captured at submission: the engine's queue/prefill/
     # decode phase spans (a TTFT decomposition) re-parent to it.
     trace_ctx: Optional[Dict] = None
+    # Model multiplexing: which adapter this request routes through
+    # (None = base model, bank row 0 identity).
+    model_id: Optional[str] = None
+    adapter_row: int = 0
     # Scheduler-internal:
     slot: Optional[int] = None
     processed: int = 0                # tokens written into the KV cache
@@ -162,6 +172,16 @@ class InferenceEngine:
         self._arenas = make_paged_arena(model.config, cfg.num_blocks,
                                         cfg.block_size,
                                         sharding=self._arena_sharding)
+        # Model multiplexing: the adapter bank + residency bookkeeping.
+        # `adapter_source(model_id) -> per-layer rows` is registered by
+        # the deployment (api.py) so a miss loads on demand.
+        self._adapters = None
+        self._adapter_source = None
+        if cfg.max_adapters > 0:
+            from ray_tpu.inference.adapters import AdapterManager
+
+            self._adapters = AdapterManager(model.config, cfg.max_adapters,
+                                            cfg.lora_rank, mesh=mesh)
         self._slots: List[Optional[Request]] = [None] * cfg.batch_slots
         self._waiting: List[Request] = []     # kept sorted by arrival
         self._live: Dict[str, Request] = {}   # request_id -> live request
@@ -190,18 +210,45 @@ class InferenceEngine:
 
         model = self._model
 
-        def prefill_fn(params, arenas, ids, bt, pos, wmask, last_idx):
-            logits, arenas = model.apply(params, ids, arenas, bt, pos,
-                                         wmask, method=Llama.decode_paged)
-            nxt = jnp.argmax(jnp.take_along_axis(
-                logits, last_idx[:, None, None], axis=1)[:, 0], axis=-1)
-            return nxt.astype(jnp.int32), arenas
+        if self._adapters is not None:
+            # Multiplexed variants: the adapter banks + per-row index
+            # ride as ARGUMENTS (fixed shape/dtype/sharding), so N
+            # adapters still mean exactly these two programs — same
+            # count as the single-model engine, proven by the compile
+            # counters in the multiplex tests and bench_zoo.
+            def prefill_fn(params, arenas, banks, aidx, ids, bt, pos,
+                           wmask, last_idx):
+                logits, arenas = model.apply(
+                    params, ids, arenas, bt, pos, wmask, banks, aidx,
+                    method=Llama.decode_paged)
+                nxt = jnp.argmax(jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0],
+                    axis=-1)
+                return nxt.astype(jnp.int32), arenas
 
-        def decode_fn(params, arenas, toks, bt, pos, wmask):
-            logits, arenas = model.apply(params, toks, arenas, bt, pos,
-                                         wmask, method=Llama.decode_paged)
-            return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), \
-                arenas
+            def decode_fn(params, arenas, banks, aidx, toks, bt, pos,
+                          wmask):
+                logits, arenas = model.apply(
+                    params, toks, arenas, bt, pos, wmask, banks, aidx,
+                    method=Llama.decode_paged)
+                return jnp.argmax(logits[:, -1],
+                                  axis=-1).astype(jnp.int32), arenas
+        else:
+            def prefill_fn(params, arenas, ids, bt, pos, wmask, last_idx):
+                logits, arenas = model.apply(params, ids, arenas, bt, pos,
+                                             wmask,
+                                             method=Llama.decode_paged)
+                nxt = jnp.argmax(jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0],
+                    axis=-1)
+                return nxt.astype(jnp.int32), arenas
+
+            def decode_fn(params, arenas, toks, bt, pos, wmask):
+                logits, arenas = model.apply(params, toks, arenas, bt, pos,
+                                             wmask,
+                                             method=Llama.decode_paged)
+                return jnp.argmax(logits[:, -1],
+                                  axis=-1).astype(jnp.int32), arenas
 
         if self.config.use_jit:
             # Arenas are donated: the update is in place on the device,
@@ -224,11 +271,40 @@ class InferenceEngine:
 
     # ---------------------------------------------------------- submission
 
+    def register_adapter_source(self, fn: Callable[[str], list]) -> None:
+        """Install the on-demand adapter loader: fn(model_id) returns
+        the per-layer (aq, bq, av, bv) rows (api.py wires the replica's
+        registered adapter specs here)."""
+        self._adapter_source = fn
+
+    def adapter_stats(self) -> Optional[Dict[str, Any]]:
+        if self._adapters is None:
+            return None
+        with self._lock:
+            return self._adapters.stats()
+
+    def _resolve_adapter_locked(self, model_id: Optional[str]) -> int:
+        if model_id is None:
+            return 0
+        if self._adapters is None:
+            raise ValueError(
+                f"request names model {model_id!r} but the engine is not "
+                "multiplexed (max_adapters=0)")
+        if self._adapter_source is None:
+            raise ValueError("no adapter source registered")
+        # Rows of live requests are pinned: LRU must never evict weights
+        # a mid-flight (or queued) generation still routes through.
+        pinned = {r.adapter_row for r in self._live.values()
+                  if r.adapter_row}
+        return self._adapters.ensure(model_id, self._adapter_source,
+                                     pinned_rows=pinned)
+
     def add_request(self, prompt: List[int],
                     max_new_tokens: int = 16,
                     on_token: Optional[Callable] = None,
                     on_finish: Optional[Callable] = None,
-                    request_id: Optional[str] = None) -> Request:
+                    request_id: Optional[str] = None,
+                    model_id: Optional[str] = None) -> Request:
         cfg = self.config
         prompt = [int(t) for t in prompt] or [0]
         max_new_tokens = max(1, int(max_new_tokens))
@@ -245,13 +321,18 @@ class InferenceEngine:
                 # Reject NOW: a duplicate reaching _admit would raise out
                 # of step() and trip the circuit breaker for everyone.
                 raise ValueError(f"request id {rid!r} is already live")
+            # Adapter residency resolves at submit (load-on-miss, LRU
+            # evict): a failure rejects THIS request instead of raising
+            # out of step() for everyone.
+            adapter_row = self._resolve_adapter_locked(model_id)
             req = Request(
                 request_id=rid,
                 prompt=prompt, max_new_tokens=max_new_tokens,
                 arrival=next(self._arrival_seq),
                 on_token=on_token, on_finish=on_finish,
                 submitted_at=time.monotonic(),
-                trace_ctx=_tracing.capture())
+                trace_ctx=_tracing.capture(),
+                model_id=model_id, adapter_row=adapter_row)
             self._live[rid] = req
             # Arrivals are strictly increasing: append preserves the
             # sorted-by-arrival invariant (_preempt_one re-sorts for its
@@ -419,10 +500,17 @@ class InferenceEngine:
         wmask = np.zeros((1, cfg.prefill_chunk), bool)
         wmask[0, :chunk] = True
         bt = self._block_table_rows([req])
-        nxt, self._arenas = self._call(
-            "prefill", self._prefill_fn, self._params, self._arenas,
-            ids, bt, np.asarray([req.processed], np.int32), wmask,
-            np.asarray([chunk - 1], np.int32))
+        args = (ids, bt, np.asarray([req.processed], np.int32), wmask,
+                np.asarray([chunk - 1], np.int32))
+        if self._adapters is not None:
+            aidx = np.asarray([req.adapter_row], np.int32)
+            nxt, self._arenas = self._call(
+                "prefill", self._prefill_fn, self._params, self._arenas,
+                self._adapters.device_banks(), aidx, *args)
+        else:
+            nxt, self._arenas = self._call(
+                "prefill", self._prefill_fn, self._params, self._arenas,
+                *args)
         req.processed += chunk
         if req.processed >= total:
             self._emit_token(req, int(nxt[0]), emissions)
@@ -460,9 +548,17 @@ class InferenceEngine:
             pos[i] = req.processed
             wmask[i, 0] = True
         bt = self._block_table_rows(rows)
-        nxt, self._arenas = self._call(
-            "decode", self._decode_fn, self._params, self._arenas,
-            toks, bt, pos, wmask)
+        if self._adapters is not None:
+            aidx = np.zeros(B, np.int32)
+            for req in active:
+                aidx[req.slot] = req.adapter_row
+            nxt, self._arenas = self._call(
+                "decode", self._decode_fn, self._params, self._arenas,
+                self._adapters.device_banks(), aidx, toks, bt, pos, wmask)
+        else:
+            nxt, self._arenas = self._call(
+                "decode", self._decode_fn, self._params, self._arenas,
+                toks, bt, pos, wmask)
         nxt = np.asarray(nxt)
         for req in active:
             req.processed += 1
@@ -680,6 +776,8 @@ class InferenceEngine:
             "prefill_compiles": self._program_compiles("prefill"),
             "decode_compiles": self._program_compiles("decode"),
             "kv": self._bm.stats(),
+            **({"adapters": self._adapters.stats()}
+               if self._adapters is not None else {}),
         }
 
     def check_no_leaks(self):

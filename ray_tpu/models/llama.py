@@ -113,7 +113,8 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache: Optional[Tuple] = None):
+    def __call__(self, x, positions, cache: Optional[Tuple] = None,
+                 lora: Optional[Tuple] = None):
         """cache=None: full causal forward. cache=(k, v) with layout
         [b, max_len, kv_heads, head_dim]: write this call's K/V at each
         row's `positions` and attend over the cache; returns (x, cache').
@@ -121,7 +122,14 @@ class LlamaBlock(nn.Module):
         [num_blocks, block_size, kv_heads, head_dim]: paged variant —
         writes land at the physical slot the row's block table maps each
         position to (masked-off tokens go to trash block 0), reads gather
-        the row's logical context back out of the arena."""
+        the row's logical context back out of the arena.
+
+        lora=(aq, bq, av, bv, adapter_idx): model-multiplexed low-rank
+        deltas on the q/v projections (classic LoRA targets). The banks
+        hold one row per resident adapter ([n_rows, ...]; row 0 is the
+        zero identity) and `adapter_idx` [b] routes each BATCH ROW to its
+        adapter — routing is data, so one compiled program serves every
+        adapter mix and loading/evicting an adapter never recompiles."""
         cfg = self.cfg
         hd = cfg.head_dim
         b, s, _ = x.shape
@@ -129,6 +137,21 @@ class LlamaBlock(nn.Module):
         q = _dense(cfg.n_head * hd, ("embed", "heads"), cfg, "wq")(h)
         k = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wk")(h)
         v = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wv")(h)
+        if lora is not None:
+            aq, bq, av, bv, aidx = lora
+            # Per-row bank gather, then two thin einsums per target: the
+            # delta path costs O(b*s*e*r) next to the dense O(b*s*e*f).
+            # Compute in the model dtype end to end — bit-identical to a
+            # dedicated replica running the same bank row alone.
+            hq = h
+            dq = jnp.einsum("bsr,brf->bsf",
+                            jnp.einsum("bse,ber->bsr", hq, aq[aidx]),
+                            bq[aidx])
+            dv = jnp.einsum("bsr,brf->bsf",
+                            jnp.einsum("bse,ber->bsr", hq, av[aidx]),
+                            bv[aidx])
+            q = q + dq.astype(q.dtype)
+            v = v + dv.astype(v.dtype)
         q = q.reshape(b, s, cfg.n_head, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
@@ -271,7 +294,7 @@ class Llama(nn.Module):
         return self.lm_head(x), new_cache
 
     def decode_paged(self, input_ids, arenas, block_tables, row_pos,
-                     write_mask):
+                     write_mask, lora_banks=None, adapter_idx=None):
         """Step-shaped paged decode: the continuous-batching engine's
         entry point. `input_ids` [b, s] are each row's next s tokens
         (s = 1 for decode steps, s = chunk for chunked prefill),
@@ -281,7 +304,13 @@ class Llama(nn.Module):
         position, and `write_mask` [b, s] zeroes batch/chunk padding
         (masked writes land in trash block 0). Returns (logits [b, s,
         vocab], new_arenas) — all shapes static, so one jitted program
-        per (b, s) serves the engine forever."""
+        per (b, s) serves the engine forever.
+
+        `lora_banks` (per-layer [(aq, bq, av, bv)]) + `adapter_idx` [b]
+        turn on model multiplexing: each batch row's q/v projections get
+        its adapter's low-rank delta (row 0 = identity). The banks are
+        fixed-shape arguments, so N adapters still compile the SAME two
+        programs and adapter churn is pure data movement."""
         cfg = self.config
         b, s = input_ids.shape
         x = self.embed.astype(cfg.dtype)[input_ids]
@@ -289,8 +318,13 @@ class Llama(nn.Module):
         new_arenas = []
         for i, blk in enumerate(self.blocks):
             k_a, v_a = arenas[i]
+            lora = None
+            if lora_banks is not None:
+                aq, bq, av, bv = lora_banks[i]
+                lora = (aq, bq, av, bv, adapter_idx)
             x, layer_cache = blk(x, positions,
-                                 cache=(k_a, v_a, block_tables, write_mask))
+                                 cache=(k_a, v_a, block_tables, write_mask),
+                                 lora=lora)
             new_arenas.append((layer_cache[0], layer_cache[1]))
         x = self.final_norm(x)
         return self.lm_head(x), new_arenas
@@ -319,6 +353,60 @@ def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int,
         zeros = jax.jit(lambda: jnp.zeros(shape, cfg.dtype),
                         out_shardings=sharding)
     return [(zeros(), zeros()) for _ in range(cfg.n_layer)]
+
+
+# --------------------------------------------------------------------------- #
+# LoRA adapter banks: model multiplexing on one compiled program set
+# --------------------------------------------------------------------------- #
+
+
+def lora_bank_shapes(cfg: LlamaConfig, n_rows: int, rank: int):
+    """Per-layer bank shapes (aq, bq, av, bv): one row per resident
+    adapter, row 0 reserved as the zero identity. q targets the full
+    head width, v the kv-head width (grouped-query attention)."""
+    return ((n_rows, cfg.n_embd, rank),
+            (n_rows, rank, cfg.n_head * cfg.head_dim),
+            (n_rows, cfg.n_embd, rank),
+            (n_rows, rank, cfg.n_kv_head * cfg.head_dim))
+
+
+def lora_bank_shardings(cfg: LlamaConfig, mesh):
+    """NamedShardings for one layer's (aq, bq, av, bv) bank: the B
+    matrices' output dims split over "tp" WITH the heads they feed
+    (bq -> q heads, bv -> kv heads); the A matrices replicate (their
+    rank dim is tiny). Mirrors arena_sharding's no-trailing-None
+    discipline so bank reloads can never perturb the jit cache key."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    validate_tp(cfg, _mesh_tp(mesh))
+    del jax
+    rep = NamedSharding(mesh, P())
+    split = NamedSharding(mesh, P(None, None, "tp"))
+    return (rep, split, rep, split)
+
+
+def make_adapter_weights(cfg: LlamaConfig, rank: int, seed: int,
+                         scale: float = 0.05):
+    """Deterministic per-layer LoRA rows from a seed: the SAME seed
+    always yields the SAME weights, so a respawned replica reloading an
+    adapter on demand — or a dedicated replica built for the parity
+    proof — is bit-identical to the original. Returns per-layer
+    (aq_row, bq_row, av_row, bv_row) numpy arrays in the model dtype."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(cfg.dtype)
+    out = []
+    for _ in range(cfg.n_layer):
+        rows = []
+        for shape in ((cfg.n_embd, rank), (rank, cfg.n_head * cfg.head_dim),
+                      (cfg.n_embd, rank),
+                      (rank, cfg.n_kv_head * cfg.head_dim)):
+            w = rng.standard_normal(shape, dtype=np.float32) * scale
+            rows.append((w * 1.0).astype(dt))  # ml_dtypes casts in numpy
+        out.append(tuple(rows))
+    return out
 
 
 # --------------------------------------------------------------------------- #

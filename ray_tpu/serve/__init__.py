@@ -64,7 +64,8 @@ class Deployment:
                 route_prefix: Optional[str] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
                 user_config: Any = None,
-                shard_spec: Optional["ShardSpec"] = None
+                shard_spec: Optional["ShardSpec"] = None,
+                tenant: Optional[str] = None
                 ) -> "Deployment":
         cfg = _dc_replace(self.config)
         if num_replicas is not None:
@@ -81,6 +82,8 @@ class Deployment:
             cfg.user_config = user_config
         if shard_spec is not None:
             cfg.shard_spec = shard_spec
+        if tenant is not None:
+            cfg.tenant = tenant
         return Deployment(self._target, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -101,7 +104,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                route_prefix: Optional[str] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                user_config: Any = None,
-               shard_spec: Optional["ShardSpec"] = None):
+               shard_spec: Optional["ShardSpec"] = None,
+               tenant: Optional[str] = None):
     """`@serve.deployment` on a class or function."""
 
     def wrap(target):
@@ -113,6 +117,7 @@ def deployment(_target=None, *, name: Optional[str] = None,
             ray_actor_options=dict(ray_actor_options or {}),
             user_config=user_config,
             shard_spec=shard_spec,
+            tenant=tenant,
         )
         return Deployment(target, name or target.__name__, cfg)
 
@@ -348,6 +353,48 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
+def register_tenant(name: str, *, tier: str = "bronze", weight: int = 0,
+                    rps_limit: float = 0.0, burst: float = 0.0,
+                    max_inflight: int = 0,
+                    timeout_s: float = 30.0) -> None:
+    """Create or update a tenant (docs/MULTITENANCY.md): a named
+    principal with a priority tier (gold/silver/bronze), a request-rate
+    quota (token bucket, over-quota requests answer 429 + Retry-After),
+    a per-proxy in-flight cap, and a weighted-fair-queueing weight used
+    when replica capacity is contended. Deployments bind to a tenant via
+    ``@serve.deployment(tenant=...)``; the tenant must be registered
+    before its deployments deploy."""
+    import ray_tpu
+    from ray_tpu.tenancy.registry import TenantSpec
+
+    spec = TenantSpec(name=name, tier=tier, weight=weight,
+                      rps_limit=rps_limit, burst=burst,
+                      max_inflight=max_inflight)
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.register_tenant.remote(spec.qos()),
+                timeout=timeout_s)
+
+
+def unregister_tenant(name: str, timeout_s: float = 30.0) -> None:
+    """Remove a tenant; fails while it still owns deployments."""
+    import ray_tpu
+
+    controller = _get_or_create_controller(create=False)
+    ray_tpu.get(controller.unregister_tenant.remote(name),
+                timeout=timeout_s)
+
+
+def tenants(timeout_s: float = 10.0) -> Dict[str, Dict[str, Any]]:
+    """The registered tenants and their QoS specs."""
+    import ray_tpu
+
+    try:
+        controller = _get_or_create_controller(create=False)
+    except Exception:  # noqa: BLE001 — no live controller: no tenants
+        return {}
+    return ray_tpu.get(controller.tenants.remote(), timeout=timeout_s)
+
+
 def status() -> Dict[str, Any]:
     import ray_tpu
 
@@ -446,5 +493,6 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "ShardSpec", "batch", "build", "delete",
     "deploy_config", "deployment", "get_deployment_handle", "grpc_port",
-    "http_port", "ingress", "run", "shutdown", "start", "status",
+    "http_port", "ingress", "register_tenant", "run", "shutdown", "start",
+    "status", "tenants", "unregister_tenant",
 ]

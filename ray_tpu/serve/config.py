@@ -61,6 +61,11 @@ class DeploymentConfig:
     # autoscaling / scale-to-zero operate on whole groups, and any rank
     # death kills and restarts the group as a unit.
     shard_spec: Optional[ShardSpec] = None
+    # Multi-tenancy (docs/MULTITENANCY.md): the registered tenant that
+    # owns this deployment. Its QoS (tier/weight/rps/in-flight quotas)
+    # is pushed to proxies inside the routing-table entry and enforced
+    # there; None = untenanted (unmetered, default fair-queue weight).
+    tenant: Optional[str] = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling is not None:

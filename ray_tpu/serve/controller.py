@@ -22,7 +22,7 @@ import logging
 import math
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.chaos.deadline import TransitionWatch
 from ray_tpu.serve.config import (
@@ -30,6 +30,7 @@ from ray_tpu.serve.config import (
     REPLICA_STARTING,
     DeploymentConfig,
 )
+from ray_tpu.tenancy.registry import TenantSpec
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +67,10 @@ class _ReplicaInfo:
         # the only endpoint routers ever see; lifecycle ops (ping
         # promotion, health check, stop) treat the gang as one unit.
         self.group = None
+        # Model-multiplexed replicas: resident adapter ids, reported by
+        # the replica's health stats and pushed in the routing table so
+        # routers can prefer replicas that already hold an adapter.
+        self.adapters: List[str] = []
 
 
 class _DeploymentInfo:
@@ -78,6 +83,12 @@ class _DeploymentInfo:
         self.replicas: List[_ReplicaInfo] = []
         self.target = config.initial_replicas()
         self.next_replica_seq = 0
+        # Checkpoint blob cache: cloudpickle of (cls, args, kwargs, cfg)
+        # is invariant between deploys, and re-pickling it for every one
+        # of a model zoo's deployments on every replica-set change made
+        # checkpointing O(deployments^2) across a zoo bring-up.
+        # Invalidated by deploy().
+        self.ckpt_blob: Optional[bytes] = None
         # Weight/config broadcast plane: the user_config payload is put in
         # the object store ONCE per version; replicas receive the REF, so
         # N replicas pulling a big payload concurrently form a transfer
@@ -101,12 +112,39 @@ class ServeController:
 
     CKPT_KEY = b"serve:controller_ckpt"
 
+    # Anti-entropy sweep width: each tick additionally scans ~1/N of the
+    # parked (inactive) deployments, so a lost dirty mark heals within N
+    # ticks while a 200-deployment zoo still costs ~nothing per tick.
+    ANTI_ENTROPY_SHARDS = 16
+
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._version = 0
         self._routing_table: Dict[str, Any] = {}
         self._shutdown = False
         self._change: Optional[asyncio.Condition] = None
+        # Multi-tenant QoS registry (docs/MULTITENANCY.md): named tenants
+        # with tier/weight/quotas. Checkpointed with the controller;
+        # pushed to proxies inside each owned deployment's routing-table
+        # entry. qos_version is PER TENANT (stamped from one monotonic
+        # counter): proxies rebuild a tenant's token bucket only when
+        # THAT tenant's spec changed — a global version would hand every
+        # tenant a full burst of fresh tokens each time any unrelated
+        # tenant registered.
+        self._tenants: Dict[str, TenantSpec] = {}
+        self._tenant_versions: Dict[str, int] = {}
+        self._tenant_version = 1
+        # Sharded reconciler state: reconcile scans the ACTIVE set (any
+        # replicas, nonzero target, or a cold start in flight) plus
+        # explicitly DIRTIED names (deploy/delete/wake) plus a rotating
+        # anti-entropy shard of the parked majority — tick cost scales
+        # with live work, not with how many deployments exist.
+        self._dirty: set = set()
+        self._active: set = set()
+        self._parked_cursor = 0
+        self._reconcile_stats: Dict[str, Any] = {
+            "ticks": 0, "last_tick_ms": 0.0, "last_scanned": 0,
+            "last_parked_skipped": 0, "deployments": 0}
         # Per-node proxy management (reference http_state.py:110): set via
         # set_proxy_config; reconcile keeps one proxy per alive node.
         self._proxy_cfg: Optional[Dict[str, Any]] = None
@@ -166,12 +204,14 @@ class ServeController:
             # every checkpoint. Post-crash, surviving replicas keep their
             # applied config; pushing it to NEW replicas requires a
             # redeploy (restore() zeroes the version accordingly).
-            cfg = info.config
-            if cfg.user_config is not None:
-                cfg = dataclasses.replace(cfg, user_config=None)
+            if info.ckpt_blob is None:
+                cfg = info.config
+                if cfg.user_config is not None:
+                    cfg = dataclasses.replace(cfg, user_config=None)
+                info.ckpt_blob = cloudpickle.dumps(
+                    (info.user_cls, info.init_args, info.init_kwargs, cfg))
             state[name] = {
-                "blob": cloudpickle.dumps(
-                    (info.user_cls, info.init_args, info.init_kwargs, cfg)),
+                "blob": info.ckpt_blob,
                 "target": info.target,
                 "next_replica_seq": info.next_replica_seq,
                 # Groups are never re-adopted (a gang with a dead owner
@@ -183,7 +223,10 @@ class ServeController:
                            if r.group is not None],
             }
         payload = pickle.dumps(
-            {"deployments": state, "proxy_cfg": self._proxy_cfg})
+            {"deployments": state, "proxy_cfg": self._proxy_cfg,
+             "tenants": {n: s.qos() for n, s in self._tenants.items()},
+             "tenant_versions": dict(self._tenant_versions),
+             "tenant_version": self._tenant_version})
         self._enqueue_ckpt(payload)
 
     def _enqueue_ckpt(self, payload: Optional[bytes]) -> int:
@@ -291,10 +334,20 @@ class ServeController:
         snap = pickle.loads(value)
         import cloudpickle
 
+        self._tenants = {
+            name: TenantSpec(**qos)
+            for name, qos in (snap.get("tenants") or {}).items()}
+        self._tenant_versions = dict(snap.get("tenant_versions") or {})
+        self._tenant_version = snap.get("tenant_version", 1)
         for name, rec in snap.get("deployments", {}).items():
             user_cls, init_args, init_kwargs, config = cloudpickle.loads(
                 rec["blob"])
             info = _DeploymentInfo(user_cls, init_args, init_kwargs, config)
+            # Seed the blob cache with the exact bytes we just loaded:
+            # the first post-restore checkpoint must not re-pickle all N
+            # deployments in one tick — recovery is precisely the path
+            # the cache exists to protect.
+            info.ckpt_blob = rec["blob"]
             info.target = rec["target"]
             info.next_replica_seq = rec["next_replica_seq"]
             for replica_id in rec["replica_ids"]:
@@ -314,9 +367,15 @@ class ServeController:
             for desc in rec.get("groups", ()):
                 _cleanup_stale_group(desc)
             self._deployments[name] = info
-            logger.info("serve: restored deployment %s (re-adopted %d/%d "
-                        "replicas)", name, len(info.replicas),
-                        len(rec["replica_ids"]))
+            # One post-restore sweep per deployment (classification +
+            # re-proving re-adopted replicas); parked deployments then
+            # leave the scan set until woken. Restore itself stays
+            # bounded: no pings, no spawns — reconcile owns both.
+            self._dirty.add(name)
+            if rec["replica_ids"] or rec.get("groups") or info.target:
+                logger.info("serve: restored deployment %s (re-adopted "
+                            "%d/%d replicas)", name, len(info.replicas),
+                            len(rec["replica_ids"]))
         self._proxy_cfg = snap.get("proxy_cfg")
         self._rebuild_routing_table()
         return True
@@ -338,6 +397,10 @@ class ServeController:
 
     async def deploy(self, name: str, user_cls, init_args, init_kwargs,
                      config: DeploymentConfig) -> None:
+        if config.tenant and config.tenant not in self._tenants:
+            raise ValueError(
+                f"deployment {name!r} names unregistered tenant "
+                f"{config.tenant!r} — serve.register_tenant() it first")
         info = self._deployments.get(name)
         if info is None:
             self._deployments[name] = _DeploymentInfo(
@@ -367,9 +430,12 @@ class ServeController:
                 for rep in info.replicas:
                     self._stop_replica(rep)
                 info.replicas = []
+            info.ckpt_blob = None   # cls/args/config may all have moved
         # Config-only updates (route_prefix, max_concurrent_queries) must
         # reach routers even when the replica set doesn't change.
-        self._rebuild_routing_table()
+        self._dirty.add(name)
+        self._publish_entry(name)
+        self._bump()
         self._checkpoint()
         logger.info("serve: deployed %s (target=%d)", name,
                     self._deployments[name].target)
@@ -379,8 +445,55 @@ class ServeController:
         if info is not None:
             for rep in info.replicas:
                 self._stop_replica(rep)
-            self._rebuild_routing_table()
+            self._dirty.discard(name)
+            self._active.discard(name)
+            self._routing_table.pop(name, None)
+            self._bump()
             self._checkpoint()
+
+    # ---------------------------------------------------------- tenants
+
+    async def register_tenant(self, qos: Dict[str, Any]) -> None:
+        """Create or update a tenant (serve.register_tenant). Updates
+        re-push every owned deployment's entry with a bumped qos_version
+        so proxies rebuild their local buckets."""
+        spec = TenantSpec(**qos)
+        self._tenants[spec.name] = spec
+        self._tenant_version += 1
+        self._tenant_versions[spec.name] = self._tenant_version
+        republished = False
+        for name, info in self._deployments.items():
+            if info.config.tenant == spec.name:
+                self._publish_entry(name)
+                republished = True
+        if republished:
+            self._bump()
+        self._checkpoint()
+        logger.info("serve: tenant %s registered (tier=%s weight=%d "
+                    "rps=%g inflight=%d)", spec.name, spec.tier,
+                    spec.weight, spec.rps_limit, spec.max_inflight)
+
+    async def unregister_tenant(self, name: str) -> None:
+        owned = [d for d, info in self._deployments.items()
+                 if info.config.tenant == name]
+        if owned:
+            raise ValueError(
+                f"tenant {name!r} still owns deployments {sorted(owned)} "
+                "— delete them first")
+        if self._tenants.pop(name, None) is not None:
+            self._tenant_versions.pop(name, None)
+            self._checkpoint()
+
+    async def tenants(self) -> Dict[str, Dict[str, Any]]:
+        return {name: spec.qos() for name, spec in self._tenants.items()}
+
+    async def reconcile_stats(self) -> Dict[str, Any]:
+        """Reconciler introspection (bench_zoo's sublinearity proof):
+        last tick wall time, how many deployments it actually scanned,
+        and how many parked ones it skipped."""
+        return dict(self._reconcile_stats,
+                    active=len(self._active),
+                    deployments=len(self._deployments))
 
     async def wait_ready(self, name: str, timeout_s: float = 60.0) -> bool:
         deadline = time.time() + timeout_s
@@ -416,6 +529,12 @@ class ServeController:
         info.idle_since = None
         if info.target < 1:
             info.target = 1
+        # A woken deployment re-enters the reconcile scan set NOW — the
+        # sharded reconciler skips parked deployments, and the cold
+        # start's STARTING->RUNNING promotion must not wait for the
+        # anti-entropy sweep to rediscover it.
+        self._dirty.add(name)
+        self._active.add(name)
         if not info.replicas:
             if info.cold_start_t0 is None:
                 info.cold_start_t0 = time.time()
@@ -457,6 +576,8 @@ class ServeController:
                 "cold_start_ms": info.last_cold_start_ms,
                 "stuck_transitions": self._transitions.stuck_total,
             }
+            if info.config.tenant:
+                out[name]["tenant"] = info.config.tenant
             if info.config.shard_spec is not None:
                 spec = info.config.shard_spec
                 out[name]["shard"] = {"world_size": spec.world_size,
@@ -471,6 +592,11 @@ class ServeController:
             for rep in info.replicas:
                 self._stop_replica(rep)
         self._deployments.clear()
+        self._dirty.clear()
+        self._active.clear()
+        self._tenants.clear()
+        self._tenant_versions.clear()
+        self._routing_table = {}
         for handle in self._proxies.values():
             try:
                 ray_tpu.kill(handle)
@@ -585,178 +711,252 @@ class ServeController:
             logger.info("serve: started proxy on node %s (port %s)",
                         node_hex[:12], port or "ephemeral")
 
+    @staticmethod
+    def _is_active(info: _DeploymentInfo) -> bool:
+        """Whether a deployment needs per-tick reconcile work. Parked
+        (scale-to-zero at zero replicas, target 0, no cold start in
+        flight) deployments have nothing time-driven to do — wake/deploy
+        /delete all dirty them explicitly."""
+        return bool(info.replicas or info.target > 0
+                    or info.cold_start_t0 is not None)
+
+    def _scan_set(self) -> Tuple[list, int]:
+        """Names to reconcile this tick: every active deployment, every
+        dirtied one, plus a rotating anti-entropy shard of the parked
+        majority (a lost dirty mark heals within ANTI_ENTROPY_SHARDS
+        ticks instead of never). Returns (names, parked_skipped)."""
+        dirty, self._dirty = self._dirty, set()
+        scan = [n for n in self._deployments
+                if n in self._active or n in dirty]
+        parked = [n for n in self._deployments
+                  if n not in self._active and n not in dirty]
+        take = -(-len(parked) // self.ANTI_ENTROPY_SHARDS) if parked else 0
+        for i in range(take):
+            scan.append(parked[(self._parked_cursor + i) % len(parked)])
+        self._parked_cursor += take
+        return scan, len(parked) - take
+
     async def _reconcile_once(self) -> None:
         loop = asyncio.get_running_loop()
-        changed = False
-        depths_moved = False
+        t0 = time.perf_counter()
         tracked_keys = set()
-        for name, info in list(self._deployments.items()):
-            # 1. Promote STARTING replicas that answer ping; cull ones that
-            # died in __init__ (ping resolves to an actor error) or never
-            # came up within the startup timeout.
-            for rep in [r for r in info.replicas
-                        if r.state == REPLICA_STARTING]:
-                state, node = await loop.run_in_executor(
-                    None, functools.partial(_try_ping_replica, rep, 0.05))
-                if state == "ok":
-                    if node:
-                        rep.node_hex = node
-                    # Deliver the current user_config BEFORE the replica
-                    # becomes routable: a request must never reach user
-                    # code whose reconfigure(weights) hasn't run. A failed
-                    # push leaves it STARTING (retried next tick until the
-                    # startup timeout below replaces it).
-                    needs_cfg = (info.user_config_version
-                                 and info.config.user_config is not None
-                                 and rep.user_config_version
-                                 < info.user_config_version)
-                    if not needs_cfg or await self._push_user_config(
-                            loop, info, rep):
-                        rep.state = REPLICA_RUNNING
-                        changed = True
-                        if info.cold_start_t0 is not None:
-                            info.last_cold_start_ms = round(
-                                (time.time() - info.cold_start_t0) * 1e3, 1)
-                            info.cold_start_t0 = None
-                            logger.info(
-                                "serve: %s cold start served in %.0fms",
-                                name, info.last_cold_start_ms)
-                if rep.state == REPLICA_STARTING and (
-                        state == "dead"
-                        or time.time() - rep.started_at
-                        > info.config.replica_startup_timeout_s):
-                    logger.warning(
-                        "serve: replica %s of %s failed to start — "
-                        "replacing", rep.replica_id, name)
-                    self._stop_replica(rep, graceful=False)
-                    info.replicas.remove(rep)
-                    changed = True
-
-            # 1.5 Weight/config broadcast: push the current user_config to
-            # RUNNING replicas behind on it (a live update bumped the
-            # version). The payload lives in the object store once per
-            # version; each replica receives the REF as its reconfigure
-            # argument and pulls the bytes over the transfer plane
-            # (concurrent replicas self-organize into a tree there — the
-            # controller never re-pickles the payload per replica).
-            if info.user_config_version and info.config.user_config is not None:
-                behind = [r for r in info.replicas
-                          if r.state == REPLICA_RUNNING
-                          and r.user_config_version < info.user_config_version]
-                if behind:
-                    # Materialize the ref BEFORE fanning out: concurrent
-                    # pushes racing the first put would each serialize
-                    # their own copy of the payload.
-                    await self._ensure_user_config_ref(loop, info)
-                    await asyncio.gather(
-                        *(self._push_user_config(loop, info, rep)
-                          for rep in behind))
-
-            # 2. Health-check RUNNING replicas; replace the dead.
-            if (time.time() - info.last_health_check
-                    >= info.config.health_check_period_s):
-                info.last_health_check = time.time()
-                stats = await loop.run_in_executor(
-                    None, functools.partial(_gather_stats, info.replicas))
-                dead = []
-                for rep, st in zip(list(info.replicas), stats):
-                    if rep.state != REPLICA_RUNNING:
-                        continue
-                    if st is None:
-                        dead.append(rep)
-                    else:
-                        # Deployment-exported backlog (__serve_metrics__,
-                        # e.g. the inference engine's queued + running
-                        # sequences) counts as pressure: streamed
-                        # generations leave `ongoing` as soon as the
-                        # stream marker returns, so the engine's own
-                        # counts are the only saturation signal for them.
-                        # max() against ongoing, not sum — a unary
-                        # generate() is BOTH an ongoing RPC and an engine
-                        # request, and adding them would double-count it.
-                        user = st.get("user") or {}
-
-                        def _n(key):
-                            try:
-                                return int(user.get(key, 0) or 0)
-                            except (TypeError, ValueError):
-                                return 0
-
-                        new_load = max(
-                            st.get("ongoing", 0),
-                            _n("queue_depth") + _n("running"))
-                        if new_load != rep.last_ongoing:
-                            depths_moved = True
-                        rep.last_ongoing = new_load
-                        if st.get("node"):
-                            rep.node_hex = st["node"]
-                for rep in dead:
-                    logger.warning("serve: replica %s of %s failed health "
-                                   "check — replacing", rep.replica_id, name)
-                    self._stop_replica(rep, graceful=False)
-                    info.replicas.remove(rep)
-                    changed = True
-
-            # 3. Autoscaling decision.
-            if info.config.autoscaling is not None:
-                new_target = self._autoscale_decision(info)
-                if new_target != info.target:
-                    logger.info("serve: autoscaling %s %d -> %d",
-                                name, info.target, new_target)
-                    info.target = new_target
-
-            # 4. Converge replica count toward target.
-            live = [r for r in info.replicas]
-            if len(live) < info.target:
-                for _ in range(info.target - len(live)):
-                    info.replicas.append(self._start_replica(name, info))
-                changed = True
-            elif len(live) > info.target:
-                # Drain the newest first (stable prefix keeps warm caches).
-                excess = live[info.target:]
-                for rep in excess:
-                    self._stop_replica(rep)
-                    info.replicas.remove(rep)
-                changed = True
-
-            # 5. Recovery-deadline tracking: every STARTING replica and
-            # the deployment's convergence toward target are in-flight
-            # transitions; anything stuck past chaos_recovery_deadline_s
-            # is failed loudly below (attributed), never left to spin.
-            running_n = sum(1 for r in info.replicas
-                            if r.state == REPLICA_RUNNING)
-            for rep in info.replicas:
-                if rep.state == REPLICA_STARTING:
-                    self._transitions.enter(rep.replica_id, "STARTING")
-                    tracked_keys.add(rep.replica_id)
-            if running_n < info.target:
-                key = f"deployment:{name}"
-                self._transitions.enter(
-                    key, f"converging({running_n}/{info.target})")
-                tracked_keys.add(key)
+        publish: set = set()
+        any_changed = False
+        scan, parked_skipped = self._scan_set()
+        for name in scan:
+            info = self._deployments.get(name)
+            if info is None:
+                continue  # deleted between dirtying and this tick
+            changed, depths_moved = await self._reconcile_deployment(
+                loop, name, info, tracked_keys)
+            if changed:
+                any_changed = True
+            if changed or depths_moved:
+                publish.add(name)
+            # Re-classify for the next tick's scan set.
+            if self._is_active(info):
+                self._active.add(name)
+            else:
+                self._active.discard(name)
 
         # Prune transitions whose subject completed or vanished this tick,
         # then enforce the deadline: a stuck replica is force-replaced
         # (reconcile respawns it), a stuck deployment is counted and
         # re-armed — both land in status()["stuck_transitions"] and a
-        # CRITICAL log with the stuck state attributed.
+        # CRITICAL log with the stuck state attributed. Transitions only
+        # ever belong to ACTIVE deployments, which every tick scans, so
+        # the sharded scan cannot mis-prune a parked deployment's state.
         self._transitions.prune(tracked_keys)
         for key, state, elapsed in self._transitions.fail_stuck():
-            for info in self._deployments.values():
+            for name in list(self._active):
+                info = self._deployments.get(name)
+                if info is None:
+                    continue
                 for rep in list(info.replicas):
                     if rep.replica_id == key:
                         self._stop_replica(rep, graceful=False)
                         info.replicas.remove(rep)
-                        changed = True
+                        any_changed = True
+                        publish.add(name)
 
-        if changed:
-            self._rebuild_routing_table()
-            self._checkpoint()  # replica set moved: keep recovery current
-        elif depths_moved:
-            # Queue depths piggyback on the routing-table push (routers
-            # never poll per-request): membership is unchanged so no
-            # checkpoint, just a version bump at the health-check cadence.
-            self._rebuild_routing_table()
+        if publish:
+            for name in publish:
+                self._publish_entry(name)
+            # Depth-only changes bump the version without a checkpoint
+            # (routers never poll per-request); membership moves below
+            # also checkpoint so recovery stays current.
+            self._bump()
+        if any_changed:
+            self._checkpoint()
+        stats = self._reconcile_stats
+        stats["ticks"] += 1
+        stats["last_tick_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        stats["last_scanned"] = len(scan)
+        stats["last_parked_skipped"] = parked_skipped
+        stats["deployments"] = len(self._deployments)
+
+    async def _reconcile_deployment(self, loop, name: str,
+                                    info: _DeploymentInfo,
+                                    tracked_keys: set) -> Tuple[bool, bool]:
+        """One deployment's reconcile step (the body of the old
+        monolithic loop): promote/cull STARTING replicas, push
+        user_config, health-check, autoscale, converge toward target.
+        Returns (membership_changed, depths_moved)."""
+        changed = False
+        depths_moved = False
+        # 1. Promote STARTING replicas that answer ping; cull ones that
+        # died in __init__ (ping resolves to an actor error) or never
+        # came up within the startup timeout.
+        for rep in [r for r in info.replicas
+                    if r.state == REPLICA_STARTING]:
+            state, node = await loop.run_in_executor(
+                None, functools.partial(_try_ping_replica, rep, 0.05))
+            if state == "ok":
+                if node:
+                    rep.node_hex = node
+                # Deliver the current user_config BEFORE the replica
+                # becomes routable: a request must never reach user
+                # code whose reconfigure(weights) hasn't run. A failed
+                # push leaves it STARTING (retried next tick until the
+                # startup timeout below replaces it).
+                needs_cfg = (info.user_config_version
+                             and info.config.user_config is not None
+                             and rep.user_config_version
+                             < info.user_config_version)
+                if not needs_cfg or await self._push_user_config(
+                        loop, info, rep):
+                    rep.state = REPLICA_RUNNING
+                    changed = True
+                    if info.cold_start_t0 is not None:
+                        info.last_cold_start_ms = round(
+                            (time.time() - info.cold_start_t0) * 1e3, 1)
+                        info.cold_start_t0 = None
+                        logger.info(
+                            "serve: %s cold start served in %.0fms",
+                            name, info.last_cold_start_ms)
+            if rep.state == REPLICA_STARTING and (
+                    state == "dead"
+                    or time.time() - rep.started_at
+                    > info.config.replica_startup_timeout_s):
+                logger.warning(
+                    "serve: replica %s of %s failed to start — "
+                    "replacing", rep.replica_id, name)
+                self._stop_replica(rep, graceful=False)
+                info.replicas.remove(rep)
+                changed = True
+
+        # 1.5 Weight/config broadcast: push the current user_config to
+        # RUNNING replicas behind on it (a live update bumped the
+        # version). The payload lives in the object store once per
+        # version; each replica receives the REF as its reconfigure
+        # argument and pulls the bytes over the transfer plane
+        # (concurrent replicas self-organize into a tree there — the
+        # controller never re-pickles the payload per replica).
+        if info.user_config_version and info.config.user_config is not None:
+            behind = [r for r in info.replicas
+                      if r.state == REPLICA_RUNNING
+                      and r.user_config_version < info.user_config_version]
+            if behind:
+                # Materialize the ref BEFORE fanning out: concurrent
+                # pushes racing the first put would each serialize
+                # their own copy of the payload.
+                await self._ensure_user_config_ref(loop, info)
+                await asyncio.gather(
+                    *(self._push_user_config(loop, info, rep)
+                      for rep in behind))
+
+        # 2. Health-check RUNNING replicas; replace the dead.
+        if (time.time() - info.last_health_check
+                >= info.config.health_check_period_s):
+            info.last_health_check = time.time()
+            stats = await loop.run_in_executor(
+                None, functools.partial(_gather_stats, info.replicas))
+            dead = []
+            for rep, st in zip(list(info.replicas), stats):
+                if rep.state != REPLICA_RUNNING:
+                    continue
+                if st is None:
+                    dead.append(rep)
+                else:
+                    # Deployment-exported backlog (__serve_metrics__,
+                    # e.g. the inference engine's queued + running
+                    # sequences) counts as pressure: streamed
+                    # generations leave `ongoing` as soon as the
+                    # stream marker returns, so the engine's own
+                    # counts are the only saturation signal for them.
+                    # max() against ongoing, not sum — a unary
+                    # generate() is BOTH an ongoing RPC and an engine
+                    # request, and adding them would double-count it.
+                    user = st.get("user") or {}
+
+                    def _n(key):
+                        try:
+                            return int(user.get(key, 0) or 0)
+                        except (TypeError, ValueError):
+                            return 0
+
+                    new_load = max(
+                        st.get("ongoing", 0),
+                        _n("queue_depth") + _n("running"))
+                    if new_load != rep.last_ongoing:
+                        depths_moved = True
+                    rep.last_ongoing = new_load
+                    if st.get("node"):
+                        rep.node_hex = st["node"]
+                    # Model-multiplexed replicas report resident
+                    # adapters; pushed in the table so routers can
+                    # prefer a replica that already holds one.
+                    adapters = user.get("adapters")
+                    if adapters is not None:
+                        adapters = [str(a) for a in adapters]
+                        if adapters != rep.adapters:
+                            rep.adapters = adapters
+                            depths_moved = True
+            for rep in dead:
+                logger.warning("serve: replica %s of %s failed health "
+                               "check — replacing", rep.replica_id, name)
+                self._stop_replica(rep, graceful=False)
+                info.replicas.remove(rep)
+                changed = True
+
+        # 3. Autoscaling decision.
+        if info.config.autoscaling is not None:
+            new_target = self._autoscale_decision(info)
+            if new_target != info.target:
+                logger.info("serve: autoscaling %s %d -> %d",
+                            name, info.target, new_target)
+                info.target = new_target
+
+        # 4. Converge replica count toward target.
+        live = [r for r in info.replicas]
+        if len(live) < info.target:
+            for _ in range(info.target - len(live)):
+                info.replicas.append(self._start_replica(name, info))
+            changed = True
+        elif len(live) > info.target:
+            # Drain the newest first (stable prefix keeps warm caches).
+            excess = live[info.target:]
+            for rep in excess:
+                self._stop_replica(rep)
+                info.replicas.remove(rep)
+            changed = True
+
+        # 5. Recovery-deadline tracking: every STARTING replica and
+        # the deployment's convergence toward target are in-flight
+        # transitions; anything stuck past chaos_recovery_deadline_s
+        # is failed loudly below (attributed), never left to spin.
+        running_n = sum(1 for r in info.replicas
+                        if r.state == REPLICA_RUNNING)
+        for rep in info.replicas:
+            if rep.state == REPLICA_STARTING:
+                self._transitions.enter(rep.replica_id, "STARTING")
+                tracked_keys.add(rep.replica_id)
+        if running_n < info.target:
+            key = f"deployment:{name}"
+            self._transitions.enter(
+                key, f"converging({running_n}/{info.target})")
+            tracked_keys.add(key)
+        return changed, depths_moved
 
     async def _ensure_user_config_ref(self, loop, info: _DeploymentInfo):
         """Put the payload ONCE per version, serially — concurrent
@@ -907,29 +1107,59 @@ class ServeController:
         except Exception:  # noqa: BLE001 — already dead is fine
             pass
 
+    def _publish_entry(self, name: str) -> None:
+        """(Re)build ONE deployment's routing-table entry in place —
+        with a zoo of mostly-parked deployments, rebuilding all N
+        entries because one replica's depth moved made every push
+        O(deployments). The caller owns the version bump."""
+        info = self._deployments.get(name)
+        if info is None:
+            self._routing_table.pop(name, None)
+            return
+        running = [r for r in info.replicas
+                   if r.state == REPLICA_RUNNING]
+        prefix = info.config.route_prefix or f"/{name}"
+        auto = info.config.autoscaling
+        entry = {
+            "replicas": [(r.replica_id, r.handle) for r in running],
+            "max_concurrent_queries":
+                info.config.max_concurrent_queries,
+            "route_prefix": prefix,
+            # Placement + depth piggyback for the routers' locality /
+            # power-of-two-choices pick (pushed, never polled).
+            "nodes": {r.replica_id: r.node_hex for r in running
+                      if r.node_hex},
+            "depths": {r.replica_id: r.last_ongoing for r in running},
+            # Scale-to-zero marker: an empty replica list means "wake
+            # me", not "unknown deployment".
+            "parked": bool(auto is not None and auto.min_replicas == 0
+                           and not running),
+        }
+        # Tenant QoS piggyback: proxies enforce quotas/WFQ off the
+        # pushed entry (tenancy/admission.py), never a per-request RPC.
+        tenant = info.config.tenant
+        if tenant and tenant in self._tenants:
+            entry["tenant"] = tenant
+            entry["qos"] = self._tenants[tenant].qos()
+            entry["qos_version"] = self._tenant_versions.get(tenant, 1)
+        # Adapter residency (model-multiplexed replicas): lets the
+        # router prefer a replica that already holds the request's
+        # model_id (avoids a load+evict on every dispatch).
+        adapters = {r.replica_id: r.adapters for r in running
+                    if r.adapters}
+        if adapters:
+            entry["adapters"] = adapters
+            entry["mux"] = True
+        self._routing_table[name] = entry
+
     def _rebuild_routing_table(self) -> None:
-        table = {}
-        for name, info in self._deployments.items():
-            running = [r for r in info.replicas
-                       if r.state == REPLICA_RUNNING]
-            prefix = info.config.route_prefix or f"/{name}"
-            auto = info.config.autoscaling
-            table[name] = {
-                "replicas": [(r.replica_id, r.handle) for r in running],
-                "max_concurrent_queries":
-                    info.config.max_concurrent_queries,
-                "route_prefix": prefix,
-                # Placement + depth piggyback for the routers' locality /
-                # power-of-two-choices pick (pushed, never polled).
-                "nodes": {r.replica_id: r.node_hex for r in running
-                          if r.node_hex},
-                "depths": {r.replica_id: r.last_ongoing for r in running},
-                # Scale-to-zero marker: an empty replica list means "wake
-                # me", not "unknown deployment".
-                "parked": bool(auto is not None and auto.min_replicas == 0
-                               and not running),
-            }
-        self._routing_table = table
+        """Full rebuild + bump (restore / teardown); steady-state paths
+        publish single entries and bump once per batch."""
+        for name in list(self._routing_table):
+            if name not in self._deployments:
+                self._routing_table.pop(name, None)
+        for name in self._deployments:
+            self._publish_entry(name)
         self._bump()
 
     def _bump(self) -> None:

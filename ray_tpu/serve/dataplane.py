@@ -56,6 +56,11 @@ import msgpack
 
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.observability import tracing as _tracing
+from ray_tpu.tenancy.admission import (
+    QuotaExceeded,
+    TenantAdmission,
+    WfqScheduler,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +79,8 @@ COUNTERS: Dict[str, int] = {
     "stream_pulls": 0,        # raw stream chunk frames pulled
     "park_buffered": 0,       # requests buffered for a parked deployment
     "park_rejected": 0,       # requests refused by the park byte cap
+    "quota_rejected": 0,      # tenant over-quota 429s (never parked)
+    "wfq_queued": 0,          # requests that waited in the fair queue
     "raw_dispatch_frames": 0,    # replica side: frames received
     "raw_dispatch_requests": 0,  # replica side: requests decoded from frames
 }
@@ -129,6 +136,13 @@ class FrameLostError(ConnectionError):
     """The connection to the replica died with the frame in flight."""
 
 
+# Resolved to a fair-queued waiter whose deployment stopped being
+# routable ("active") while it waited — deleted, redeployed, or parked.
+# The dispatch loop re-runs its state handling (classic-lane fallback /
+# cold-start buffering) instead of polling a dead closure to timeout.
+_STATE_CHANGED = object()
+
+
 class PreExecError(Exception):
     """The replica provably never started executing the frame (transport
     refused pre-send, or the server rejected it before dispatch) — safe
@@ -178,43 +192,113 @@ class FastLane:
         # deployment's cold-start backlog must not 503 another's first
         # request.
         self._park_bytes: Dict[str, int] = {}
+        # Multi-tenant QoS (docs/MULTITENANCY.md): per-tenant token
+        # buckets + in-flight caps off the table-pushed QoS, and the
+        # weighted fair queue that orders waiters under contention.
+        self._admission = TenantAdmission()
+        self._wfq = WfqScheduler()
 
     # ------------------------------------------------------------ dispatch
 
     async def dispatch(self, loop, deployment: str, entry: Dict[str, Any],
-                       body) -> Optional[Tuple[Dict[str, Any], memoryview]]:
+                       body, model_id: Optional[str] = None
+                       ) -> Optional[Tuple[Dict[str, Any], memoryview]]:
         """Route one request entry (+ raw body) to a replica over the raw
         frame lane. Returns (response entry, body view) — the entry may
         carry a per-request "err" — or None when the fast lane cannot
         serve it (disabled, unknown deployment, saturated, or a transport
-        path that is safer on the classic lane). Raises ParkBufferFull /
-        TimeoutError / ConnectionError for terminal fast-lane failures."""
+        path that is safer on the classic lane). Raises QuotaExceeded /
+        ParkBufferFull / TimeoutError / ConnectionError for terminal
+        fast-lane failures."""
         if not GLOBAL_CONFIG.serve_fastpath_enabled:
             return None
         self._prune_channels()
+        table_entry = self._router.entry_snapshot(deployment)
+        tenant = self._admission.resolve(table_entry)
+        # Admission ordering: the quota gate runs FIRST — an over-quota
+        # request answers 429 in one dict lookup, never occupying a
+        # replica slot, a park buffer, or a fair-queue position.
+        try:
+            self._admission.admit(tenant)
+        except QuotaExceeded:
+            COUNTERS["quota_rejected"] += 1
+            raise
+        try:
+            return await self._dispatch_admitted(
+                loop, deployment, entry, body, model_id, table_entry)
+        finally:
+            # In-flight accounting covers queue time + execution: that is
+            # what max_inflight bounds.
+            self._admission.release(tenant)
+
+    async def _dispatch_admitted(self, loop, deployment: str,
+                                 entry: Dict[str, Any], body,
+                                 model_id, table_entry
+                                 ) -> Optional[Tuple[Dict[str, Any],
+                                                     memoryview]]:
         nbytes = len(body) if body is not None else 0
         entry = dict(entry)
         entry["n"] = nbytes
         attempts = 0
         exclude: Optional[set] = None
         deadline = loop.time() + self.REQUEST_TIMEOUT_S
-        backoff = 0.002
         while True:
-            choice = self._router.reserve_fast(deployment, exclude=exclude)
+            choice = None
+            if not self._wfq.has_waiters() \
+                    or not self._wfq.has_waiters_for(deployment):
+                # With a backlog queued FOR THIS deployment, newcomers
+                # must not jump it — contended reservations go through
+                # the fair queue's virtual-time order. A backlog on
+                # some other deployment's pool is irrelevant: routing
+                # an idle deployment's request through the pump would
+                # tax every tenant with the pump's backoff latency.
+                choice = self._router.reserve_fast(deployment,
+                                                   exclude=exclude,
+                                                   model_id=model_id)
             if choice is None:
-                waited = await self._wait_for_capacity(loop, deployment,
-                                                       nbytes, deadline,
-                                                       backoff)
-                if waited:
-                    # Exponential admission backoff: hundreds of waiters
-                    # each polling at a fixed 2ms would grind the loop +
-                    # router lock exactly under overload; capped doubling
-                    # bounds the wakeup rate while the first retries stay
-                    # fast.
-                    backoff = min(backoff * 2, 0.032)
+                state = self._router.deployment_state(deployment)
+                if state == "unknown":
+                    return None  # classic lane owns the KeyError grace
+                if state == "parked":
+                    await self._await_cold_start(loop, deployment, nbytes)
                     continue
-                return None  # unknown deployment: classic lane owns errors
-            backoff = 0.002
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no replica of {deployment!r} available within "
+                        f"{self.REQUEST_TIMEOUT_S}s")
+                # Saturated: park in the weighted fair queue. A hot
+                # tenant's backlog drains behind its own weight; other
+                # tiers interleave by theirs, so saturation by one
+                # tenant cannot starve the rest.
+                qos = (table_entry or {}).get("qos") or {}
+                COUNTERS["wfq_queued"] += 1
+                excl = exclude
+
+                def try_reserve():
+                    c = self._router.reserve_fast(
+                        deployment, exclude=excl, model_id=model_id)
+                    if c is not None:
+                        return c
+                    if self._router.deployment_state(deployment) \
+                            != "active":
+                        # Deleted or parked mid-wait: leave the queue
+                        # NOW — the dispatch loop owns state handling.
+                        return _STATE_CHANGED
+                    return None
+
+                def drop_grant(c):
+                    # A granted choice the waiter can't consume carries
+                    # a reserved router slot — return it.
+                    if c is not _STATE_CHANGED:
+                        self._router.release(c[0])
+
+                choice = await self._wfq.acquire(
+                    loop, qos.get("name"), qos.get("weight", 1),
+                    try_reserve, remaining, deployment=deployment,
+                    on_drop=drop_grant)
+                if choice is _STATE_CHANGED:
+                    continue
             replica_id, handle, colocated = choice
             if _tracing._ENABLED:
                 span = _tracing.get_tracer().start_span(
@@ -249,26 +333,6 @@ class FastLane:
                 continue
             COUNTERS["raw_requests"] += 1
             return resp, view
-
-    async def _wait_for_capacity(self, loop, deployment: str, nbytes: int,
-                                 deadline: float, backoff: float) -> bool:
-        """No replica reservable right now. Parked deployment → buffer
-        (bounded) while the controller cold-starts one; saturated → sleep
-        `backoff` (the caller escalates it). Returns False when the
-        deployment is unknown (the classic lane owns the KeyError
-        grace)."""
-        state = self._router.deployment_state(deployment)
-        if state == "unknown":
-            return False
-        if state == "parked":
-            await self._await_cold_start(loop, deployment, nbytes)
-            return True
-        if loop.time() >= deadline:
-            raise TimeoutError(
-                f"no replica of {deployment!r} available within "
-                f"{self.REQUEST_TIMEOUT_S}s")
-        await asyncio.sleep(backoff)  # saturated: admission backoff
-        return True
 
     async def _await_cold_start(self, loop, deployment: str, nbytes: int):
         cap = GLOBAL_CONFIG.serve_park_max_bytes
@@ -326,6 +390,9 @@ class FastLane:
             # about to consume ch.pending.
             if rid not in live and not ch.pending:
                 self._channels.pop(rid, None)
+        # Tenant admission state follows the table too: quota buckets for
+        # tenants whose deployments all left must not accumulate forever.
+        self._admission.prune(self._router.live_tenants())
 
     def _send(self, loop, replica_id: str, handle, entry, body):
         """Queue one request on the replica's channel and return the

@@ -162,6 +162,10 @@ class GrpcProxy:
         try:
             out = await self._dispatcher.dispatch_call(loop, deployment,
                                                        bytes(request))
+        except dataplane.QuotaExceeded as e:
+            # Tenant over quota: the gRPC spelling of the HTTP 429.
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                f"{e} (retry after {e.retry_after_s:.3f}s)")
         except dataplane.ParkBufferFull as e:
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (asyncio.TimeoutError, TimeoutError):

@@ -154,9 +154,26 @@ class HTTPProxy:
         if want_headers:
             req_entry["h"] = [(k.lower(), v)
                               for k, v in request.headers.items()]
+        # Adapter-affinity routing hint for multiplexed deployments: the
+        # model_id query param (the body stays opaque bytes on the fast
+        # lane — the replica's engine reads the authoritative copy from
+        # the payload). Parsed only when the table marks the deployment
+        # multiplexed, so plain deployments never pay the query parse.
+        model_id = None
+        if entry.get("mux"):
+            model_id = request.query.get("model_id") \
+                or request.headers.get("x-model-id")
         try:
             out = await self._dispatcher.dispatch_raw_http(
-                loop, deployment, req_entry, body)
+                loop, deployment, req_entry, body, model_id=model_id)
+        except dataplane.QuotaExceeded as e:
+            # Fast 429 + Retry-After: over-quota traffic is answered at
+            # the proxy door, never parked or fair-queued.
+            retry_after = max(e.retry_after_s, 0.001)
+            return web.json_response(
+                {"error": str(e), "retry_after_s": round(retry_after, 3)},
+                status=429,
+                headers={"Retry-After": f"{retry_after:.3f}"})
         except dataplane.ParkBufferFull as e:
             return web.json_response({"error": str(e)}, status=503)
         except (asyncio.TimeoutError, TimeoutError):
@@ -460,17 +477,20 @@ class ReplicaDispatcher:
         self._light_version = -2  # != router's initial -1: prune on first use
 
     async def dispatch_raw_http(self, loop, deployment: str,
-                                entry: dict, body):
+                                entry: dict, body, model_id=None):
         """HTTP request over the raw fast lane; None = use the classic
         lanes (the caller owns the fallback and its counter)."""
-        return await self.fastlane.dispatch(loop, deployment, entry, body)
+        return await self.fastlane.dispatch(loop, deployment, entry, body,
+                                            model_id=model_id)
 
-    async def dispatch_call(self, loop, deployment: str, body: bytes):
+    async def dispatch_call(self, loop, deployment: str, body: bytes,
+                            model_id=None):
         """Unary call (gRPC ingress parity) over the raw fast lane: the
         request bytes pass through untouched; the replica decodes
         msgpack-decodable bodies and encodes the result symmetrically."""
         return await self.fastlane.dispatch(
-            loop, deployment, {"k": "call", "m": "__call__"}, body)
+            loop, deployment, {"k": "call", "m": "__call__"}, body,
+            model_id=model_id)
 
     @staticmethod
     def _light_call(method: str, args: tuple) -> dict:

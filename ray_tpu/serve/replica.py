@@ -403,6 +403,9 @@ class Replica:
                     f"{name!r}")
             needs_await = inspect.iscoroutinefunction(method) or bool(
                 getattr(method, "__serve_is_batched__", False))
+            # Keys are the user class's method names (getattr above
+            # rejects anything else): bounded by the deployment's code.
+            # raylint: disable=RL011 — bounded by user-class methods
             cached = self._raw_methods[name] = (method, needs_await)
         return cached
 

@@ -146,17 +146,20 @@ class Router:
             return None
         return choice[0], choice[1]
 
-    def reserve_fast(self, deployment: str, exclude: Optional[set] = None
+    def reserve_fast(self, deployment: str, exclude: Optional[set] = None,
+                     model_id: Optional[str] = None
                      ) -> Optional[Tuple[str, object, bool]]:
         """reserve() for the raw fast lane: returns (replica_id, handle,
         colocated) — `colocated` reports whether the locality-first pick
         landed on this process's node. `exclude` skips replicas the
-        caller just lost a frame to (the retry-once path)."""
+        caller just lost a frame to (the retry-once path). `model_id`
+        steers multiplexed deployments toward a replica that already
+        holds that adapter (table-pushed residency)."""
         if not self._started:
             return None
         with self._lock:
             return self._reserve_locked(self._table.get(deployment),
-                                        exclude or ())
+                                        exclude or (), model_id)
 
     def deployment_state(self, deployment: str) -> str:
         """Coarse state for the fast lane's no-replica handling:
@@ -182,6 +185,20 @@ class Router:
             return {rid for entry in self._table.values()
                     for rid, _ in entry.get("replicas", ())}
 
+    def live_tenants(self) -> set:
+        """Tenant names referenced by the current table (the proxy's
+        admission registry prunes against this on version changes)."""
+        with self._lock:
+            return {entry["tenant"] for entry in self._table.values()
+                    if entry.get("tenant")}
+
+    def entry_snapshot(self, deployment: str) -> Optional[dict]:
+        """The deployment's current table entry (immutable once pushed —
+        the controller publishes fresh dicts and the router swaps whole
+        tables per version, so returning the reference is safe)."""
+        with self._lock:
+            return self._table.get(deployment)
+
     def wake(self, deployment: str) -> None:
         """Nudge the controller to cold-start a parked deployment.
         Throttled per deployment and fired from a one-shot thread: the
@@ -205,17 +222,27 @@ class Router:
     def release(self, replica_id: str):
         """Return a slot taken with reserve()."""
         with self._lock:
-            n = self._inflight.get(replica_id, 0)
-            self._inflight[replica_id] = max(0, n - 1)
+            self._dec_inflight_locked(replica_id)
             if self._waiters:
                 self._lock.notify_all()
 
-    def _reserve_locked(self, entry, exclude=()):
+    def _dec_inflight_locked(self, replica_id: str) -> None:
+        # Entries vanish at zero instead of lingering at 0: replica ids
+        # churn forever under autoscaling, and a dict keyed by every
+        # replica that ever existed is exactly the unbounded-keyed-state
+        # leak RL011 hunts.
+        n = self._inflight.get(replica_id, 0) - 1
+        if n > 0:
+            self._inflight[replica_id] = n
+        else:
+            self._inflight.pop(replica_id, None)
+
+    def _reserve_locked(self, entry, exclude=(), model_id=None):
         """Pick a replica with headroom and count the in-flight slot —
         the single admission-accounting point for every assign path."""
         if not entry or not entry["replicas"]:
             return None
-        choice = self._pick(entry, exclude)
+        choice = self._pick(entry, exclude, model_id)
         if choice is None:
             return None
         replica_id = choice[0]
@@ -260,26 +287,41 @@ class Router:
                 self._local_node = ""  # resolved-and-absent: don't retry
         return self._local_node or None
 
-    def _pick(self, entry: dict, exclude=()
+    def _pick(self, entry: dict, exclude=(), model_id=None
               ) -> Optional[Tuple[str, object, bool]]:
-        """Replica choice: locality first, then power-of-two-choices.
+        """Replica choice: adapter affinity, then locality, then
+        power-of-two-choices.
 
-        A co-located replica (same node as this router, per the table's
-        pushed placement map) with headroom always wins — that request
-        skips the network entirely. Otherwise two random candidates are
-        compared by local in-flight + the controller-pushed queue depth
-        (stale by at most the health-check cadence; the local in-flight
-        half is exact) and the lighter one is picked — the classic p2c
-        bound on max load without scanning every replica under the lock.
-        Only RUNNING replicas ever appear in the table, so DEAD and
-        draining replicas are structurally unroutable here."""
+        For a multiplexed deployment with a request `model_id`, replicas
+        already holding that adapter (per the table-pushed residency map)
+        are preferred — routing a hot adapter's traffic to a cold replica
+        costs that replica a load (and possibly an LRU eviction of
+        someone else's adapter). A co-located replica (same node as this
+        router, per the table's pushed placement map) with headroom wins
+        within the preferred set — that request skips the network
+        entirely. Otherwise two random candidates are compared by local
+        in-flight + the controller-pushed queue depth (stale by at most
+        the health-check cadence; the local in-flight half is exact) and
+        the lighter one is picked — the classic p2c bound on max load
+        without scanning every replica under the lock. Only RUNNING
+        replicas ever appear in the table, so DEAD and draining replicas
+        are structurally unroutable here."""
         limit = entry["max_concurrent_queries"]
         nodes = entry.get("nodes") or {}
         depths = entry.get("depths") or {}
+        replicas = entry["replicas"]
+        if model_id is not None:
+            residency = entry.get("adapters") or {}
+            holders = [(rid, h) for rid, h in replicas
+                       if rid not in exclude
+                       and model_id in residency.get(rid, ())
+                       and self._inflight.get(rid, 0) < limit]
+            if holders:
+                replicas = holders
         local = self._local_node_hex() if nodes else None
         co_best, co_load = None, None
         candidates = []
-        for replica_id, handle in entry["replicas"]:
+        for replica_id, handle in replicas:
             if replica_id in exclude:
                 continue
             load = self._inflight.get(replica_id, 0)
@@ -328,6 +370,12 @@ class Router:
             if version != self._version:
                 self._version = version
                 self._table = table
+                # Wake-throttle entries are keyed by deployment name:
+                # prune against the fresh table so deleted deployments
+                # don't accumulate here forever (RL011 discipline).
+                for dep in list(self._last_wake):
+                    if dep not in table:
+                        self._last_wake.pop(dep, None)
                 self._lock.notify_all()
 
     def _poll_loop(self):
@@ -354,7 +402,6 @@ class Router:
                     for ref in ready:
                         replica_id = self._outstanding.pop(ref, None)
                         if replica_id is not None:
-                            n = self._inflight.get(replica_id, 0)
-                            self._inflight[replica_id] = max(0, n - 1)
+                            self._dec_inflight_locked(replica_id)
                     if self._waiters:
                         self._lock.notify_all()

@@ -1160,3 +1160,111 @@ def test_rl010_suppression(tmp_path):
         "while True:",
         "while True:  # raylint: disable=RL010")
     assert lint_src(tmp_path, src, rules=["RL010"]) == []
+
+
+# ------------------------------------------------------------------ RL011
+
+RL011_BAD_NO_EVICTION = """
+    class TenantRegistry:
+        def __init__(self):
+            self._buckets = {}
+
+        def admit(self, tenant):
+            self._buckets[tenant] = self._buckets.get(tenant, 0) + 1
+"""
+
+RL011_GOOD_PRUNE = """
+    class TenantRegistry:
+        def __init__(self):
+            self._buckets = {}
+
+        def admit(self, tenant):
+            self._buckets[tenant] = self._buckets.get(tenant, 0) + 1
+
+        def prune(self, live):
+            for name in list(self._buckets):
+                if name not in live:
+                    self._buckets.pop(name, None)
+"""
+
+RL011_GOOD_DEL = """
+    class AdapterBank:
+        def __init__(self):
+            self._rows = {}
+
+        def load(self, model_id, row):
+            self._rows[model_id] = row
+
+        def evict(self, model_id):
+            del self._rows[model_id]
+"""
+
+RL011_GOOD_CONSTANT_KEYS = """
+    class Counters:
+        def __init__(self):
+            self._c = {}
+
+        def on_hit(self):
+            # Fixed key space: cannot grow under churn.
+            self._c["hits"] = self._c.get("hits", 0) + 1
+"""
+
+RL011_GOOD_REASSIGNED = """
+    class Snapshot:
+        def __init__(self):
+            self._view = {}
+
+        def update(self, key, value):
+            self._view[key] = value
+
+        def refresh(self, table):
+            self._view = dict(table)   # rebuilt wholesale: bounded
+"""
+
+RL011_GOOD_HANDOFF = """
+    class Router:
+        def __init__(self):
+            self._inflight = {}
+
+        def reserve(self, rid):
+            self._inflight[rid] = 1
+
+        def sweep(self):
+            prune_against_table(self._inflight)
+"""
+
+
+def test_rl011_flags_keyed_dict_without_eviction(tmp_path):
+    findings = lint_src(tmp_path, RL011_BAD_NO_EVICTION, rules=["RL011"])
+    assert rule_ids(findings) == ["RL011"]
+    assert "_buckets" in findings[0].message
+    assert "churn" in findings[0].message
+
+
+def test_rl011_quiet_with_prune_pop(tmp_path):
+    assert lint_src(tmp_path, RL011_GOOD_PRUNE, rules=["RL011"]) == []
+
+
+def test_rl011_quiet_with_del(tmp_path):
+    assert lint_src(tmp_path, RL011_GOOD_DEL, rules=["RL011"]) == []
+
+
+def test_rl011_quiet_on_constant_keys(tmp_path):
+    assert lint_src(tmp_path, RL011_GOOD_CONSTANT_KEYS,
+                    rules=["RL011"]) == []
+
+
+def test_rl011_quiet_on_wholesale_reassignment(tmp_path):
+    assert lint_src(tmp_path, RL011_GOOD_REASSIGNED, rules=["RL011"]) == []
+
+
+def test_rl011_quiet_on_bare_handoff(tmp_path):
+    assert lint_src(tmp_path, RL011_GOOD_HANDOFF, rules=["RL011"]) == []
+
+
+def test_rl011_suppression_with_reason(tmp_path):
+    src = RL011_BAD_NO_EVICTION.replace(
+        "self._buckets[tenant] = self._buckets.get(tenant, 0) + 1",
+        "self._buckets[tenant] = 1  "
+        "# raylint: disable=RL011 — bounded by the fixed tenant set")
+    assert lint_src(tmp_path, src, rules=["RL011"]) == []

@@ -379,11 +379,17 @@ def test_park_buffer_byte_cap(monkeypatch):
     class _StubRouter:
         _version = 0
 
-        def reserve_fast(self, deployment, exclude=None):
+        def reserve_fast(self, deployment, exclude=None, model_id=None):
             return None
 
         def deployment_state(self, deployment):
             return "parked"
+
+        def live_tenants(self):
+            return set()
+
+        def entry_snapshot(self, deployment):
+            return None
 
         def wake(self, deployment):
             pass
