@@ -89,6 +89,9 @@ def init(
                 raise RaySystemError(
                     'init(address="auto"): no running cluster found — start '
                     "one with `python -m ray_tpu start --head`")
+        # Env vars set since the last session must be observed (the
+        # memoized read cache is per-process; explicit sets persist).
+        GLOBAL_CONFIG.refresh()
         GLOBAL_CONFIG.initialize(_system_config)
         from ray_tpu.core.node import Node
 

@@ -20,6 +20,10 @@ for the catalog with real before/after examples):
 - RL011 unbounded-keyed-state  — per-key dicts on long-lived control-
                                   plane objects have an eviction path
                                   (the model-zoo churn leak shape)
+- RL012 lease-cache-invalidation — structures caching worker/lease
+                                  addresses show a death-hook or a
+                                  sweep-against-liveness removal path
+                                  (the stale-lease double-push shape)
 """
 
 from __future__ import annotations
@@ -1274,3 +1278,212 @@ def rl011_unbounded_keyed_state(ctx: FileContext) -> Iterable[Finding]:
                 "replicas, requests) this dict grows forever; add an "
                 "eviction/prune path or annotate why the key space is "
                 "bounded")
+
+
+# =====================================================================
+# RL012 lease-cache-invalidation
+# =====================================================================
+#
+# RL011 specialized to the fast-task-path contract (docs/TASK_FASTPATH
+# .md): a structure caching WORKER/LEASE NETWORK IDENTITIES — leases,
+# RPC clients, peer connections, worker handles, address maps — is not
+# merely a memory leak when stale, it is a CORRECTNESS hazard: a cached
+# address that outlives its process gets tasks pushed into a dead socket
+# (best case: a timeout-shaped hang) or, after a port reuse, into the
+# WRONG process (worst case: double execution). The contract every such
+# cache must exhibit, statically:
+#
+#   (a) a DEATH HOOK — a method on the death/disconnect path (name
+#       mentioning lost/dead/died/down/disconnect/drop/evict/expire/
+#       invalid/sweep/reap/purge/fail) that removes entries, e.g.
+#       DirectTaskTransport._on_worker_lost purging its lease; or
+#   (b) a LIVENESS SWEEP — a method that consults liveness evidence
+#       (is_closed/alive/dead/heartbeat/last_seen/stale) and removes
+#       what failed the check, e.g. the peer-client sweep dropping
+#       closed RpcClients; or
+#   (c) a bare HANDOFF of the whole structure to a helper (ownership —
+#       and therefore invalidation — lives with the callee, mirroring
+#       RL003/RL011's handoff rule).
+#
+# Cleanup that only runs at shutdown/stop/close does NOT count: a cache
+# purged only at process exit still serves stale addresses for the whole
+# life of the process after a node death. Caches whose entries are
+# provably rebuilt-on-read or process-local annotate with
+# `# raylint: disable=RL012 — <why stale entries are harmless>`.
+
+_RL012_NAME = re.compile(r"lease|client|peer|conn|addr|worker", re.I)
+_RL012_DEATH = re.compile(
+    r"lost|dead|death|died|down|disconnect|drop|invalid|evict|expir"
+    r"|sweep|reap|purge|fail|gone", re.I)
+_RL012_LIVE = re.compile(
+    r"is_closed|closed|alive|dead|live|heartbeat|last_seen|stale", re.I)
+_RL012_CTORS = {"dict", "defaultdict", "OrderedDict", "list", "set",
+                "WeakValueDictionary"}
+_RL012_REMOVALS = {"pop", "popitem", "clear", "remove", "discard"}
+_RL012_GROWERS = {"append", "add", "setdefault", "insert"}
+
+
+def _rl012_cache_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """Attr -> lineno for worker/lease-ish containers born in __init__."""
+    out: Dict[str, int] = {}
+    for fn in cls.body:
+        if not (isinstance(fn, _FUNC_NODES) and fn.name == "__init__"):
+            continue
+        for stmt in statements(fn.body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt, val = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                tgt, val = stmt.target, stmt.value
+            else:
+                continue
+            attr = _rl011_self_attr(tgt)
+            if attr is None or not _RL012_NAME.search(attr):
+                continue
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)) or (
+                    isinstance(val, ast.Call)
+                    and last_segment(dotted(val.func)) in _RL012_CTORS):
+                out[attr] = stmt.lineno
+    return out
+
+
+def _rl012_grown_attrs(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """Attr -> first steady-state write that grows the container."""
+    out: Dict[str, ast.AST] = {}
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES) or fn.name == "__init__":
+            continue
+        for node in ast.walk(fn):
+            attr = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _rl011_self_attr(tgt.value)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _RL012_GROWERS:
+                attr = _rl011_self_attr(node.func.value)
+            if attr is None:
+                continue
+            if attr not in out or node.lineno < out[attr].lineno:
+                out[attr] = node
+    return out
+
+
+def _rl012_method_removes(fn: ast.AST, attr: str) -> bool:
+    """Does `fn` remove entries from `self.<attr>` — directly, via a
+    filtered whole reassignment, or through a local alias drawn from the
+    attr (``leases = self._leases.get(k); ...; leases.remove(x)``)?"""
+    aliases: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            src = node.value
+            # v = self.attr.get(...) / self.attr[...] / list(self.attr...)
+            mentions = any(
+                _rl011_self_attr(sub) == attr for sub in ast.walk(src))
+            if mentions:
+                aliases.add(node.targets[0].id)
+        elif isinstance(node, ast.For):
+            # Loop targets drawn from the attr count as aliases too:
+            # ``for k, leases in self._leases.items(): leases.remove(x)``
+            if any(_rl011_self_attr(sub) == attr
+                   for sub in ast.walk(node.iter)):
+                for tgt in ast.walk(node.target):
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _RL012_REMOVALS:
+            recv = node.func.value
+            if _rl011_self_attr(recv) == attr:
+                return True
+            if isinstance(recv, ast.Name) and recv.id in aliases:
+                return True
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        _rl011_self_attr(tgt.value) == attr:
+                    return True
+        elif isinstance(node, ast.Assign):
+            # Whole reassignment outside __init__: rebuild/filter/reset.
+            for tgt in node.targets:
+                if _rl011_self_attr(tgt) == attr:
+                    return True
+    return False
+
+
+def _rl012_mentions_liveness(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and _RL012_LIVE.search(kw.arg):
+                    return True
+        if name and _RL012_LIVE.search(name):
+            return True
+    return False
+
+
+def _rl012_handed_off(cls: ast.ClassDef, attr: str) -> bool:
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _rl011_self_attr(arg) == attr:
+                    return True
+    return False
+
+
+_RL012_SHUTDOWN_ONLY = re.compile(r"^(close|stop|shutdown|__del__|__exit__)$")
+
+
+@rule("RL012", "lease-cache-invalidation: worker/lease address cache "
+               "with no death-hook or liveness-sweep removal path")
+def rl012_lease_cache_invalidation(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl011(ctx.path):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        caches = _rl012_cache_attrs(cls)
+        if not caches:
+            continue
+        grown = _rl012_grown_attrs(cls)
+        for attr, node in sorted(grown.items(), key=lambda kv: kv[1].lineno):
+            if attr not in caches:
+                continue
+            covered = _rl012_handed_off(cls, attr)
+            shutdown_only_removal = False
+            for fn in cls.body:
+                if covered or not isinstance(fn, _FUNC_NODES) \
+                        or fn.name == "__init__":
+                    continue
+                if not _rl012_method_removes(fn, attr):
+                    continue
+                if _RL012_SHUTDOWN_ONLY.match(fn.name):
+                    shutdown_only_removal = True
+                    continue  # exit-time cleanup is not invalidation
+                if _RL012_DEATH.search(fn.name) or \
+                        _rl012_mentions_liveness(fn):
+                    covered = True
+            if covered:
+                continue
+            why = ("its only removal path runs at shutdown"
+                   if shutdown_only_removal else
+                   "nothing removes entries on a death or liveness signal")
+            yield ctx.finding(
+                node, "RL012",
+                f"`self.{attr}` caches worker/lease network identities "
+                f"and {why} — a node/worker death leaves a stale address "
+                "that pushes tasks into a dead (or reused) socket; purge "
+                "it from the death hook or sweep it against liveness "
+                "(is_closed/alive), or annotate why stale entries are "
+                "harmless")

@@ -139,6 +139,37 @@ class StandardAutoscaler:
         # busy-but-sufficient cluster must not trigger scale-up).
         resp = self._gcs.call("resource_demand", timeout=5)
         view = self._gcs.call("get_resource_view", timeout=5)
+
+        # 2a. Dead-node replacement: a managed node the cluster has marked
+        # DEAD (crashed, not drained by us) is reaped NOW and relaunched
+        # one-for-one in the same tick — waiting out the idle timeout
+        # would leave capacity down for the whole window (the 100-node
+        # chaos envelope kills nodes continuously and measures exactly
+        # this replacement latency). One-for-one, not refill-to-min:
+        # when demand has already scaled the fleet past min_workers, a
+        # crash must restore the PRE-DEATH size, or recovery would wait
+        # on demand re-materializing (idle scale-down reclaims any
+        # overshoot later).
+        replaced = 0
+        pre_death = len(managed)
+        for handle in list(managed):
+            node_hex = self._node_hex(handle, view)
+            if node_hex is None:
+                continue  # still booting: not yet judgeable
+            entry = view.get(node_hex)
+            if entry is not None and not entry.get("alive"):
+                logger.warning("autoscaler: managed node %s is DEAD — "
+                               "replacing", node_hex[:12])
+                self.provider.terminate_node(handle)
+                self._last_busy.pop(self._node_key(handle), None)
+                self.num_terminations += 1
+                replaced += 1
+        if replaced:
+            managed = self.provider.non_terminated_nodes()
+            want = max(cfg.min_workers, min(cfg.max_workers, pre_death))
+            while len(managed) < want:
+                self._launch()
+                managed = self.provider.non_terminated_nodes()
         totals = [dict(e["total"]) for e in view.values() if e.get("alive")]
         unmet: List[Dict[str, float]] = []
         for shape in list(resp.get("demand", [])) + list(
@@ -205,13 +236,19 @@ class StandardAutoscaler:
     def _node_key(handle) -> Any:
         return getattr(handle, "name", None) or id(handle)
 
-    def _node_is_idle(self, handle, view) -> bool:
+    def _node_hex(self, handle, view) -> Optional[str]:
+        """Resolve a provider handle to its ray node id hex (None while
+        the node hasn't joined the view yet)."""
         node_hex = getattr(handle, "node_id", None)
         if node_hex is not None and hasattr(node_hex, "hex"):
             node_hex = node_hex.hex()
         if node_hex is None and hasattr(self.provider, "resolve_node_id"):
-            # Cloud providers map VM -> ray node lazily (label lookup).
             node_hex = self.provider.resolve_node_id(handle, view)
+        return node_hex
+
+    def _node_is_idle(self, handle, view) -> bool:
+        # Cloud providers map VM -> ray node lazily (label lookup).
+        node_hex = self._node_hex(handle, view)
         if node_hex is None:
             return False  # not yet joined: never "idle" (still booting)
         entry = view.get(node_hex)

@@ -46,31 +46,47 @@ class NodeKillInjector(Injector):
     """Crash a non-head node (no drain — the GCS health checker must
     discover it), optionally replacing it so capacity recovers.
     Recovered when the GCS has marked the victim DEAD and the alive node
-    count is back to its pre-kill level."""
+    count is back to its pre-kill level.
+
+    Replacement modes: `replace=True` adds a node inline (the bench's
+    immediate `add_node`); `provider=` hands replacement to the cluster's
+    AUTOSCALER instead — victims are drawn from the provider's managed
+    fleet, nothing is added here, and recovery waits for the autoscaler's
+    dead-node reap + relaunch to bring the alive count back (the
+    production path: a crashed host is replaced by the control loop, not
+    by the test harness)."""
 
     kind = "node_kill"
 
     def __init__(self, cluster, replace: bool = True,
-                 node_args: Optional[Dict] = None):
+                 node_args: Optional[Dict] = None, provider=None):
         self.cluster = cluster
-        self.replace = replace
+        self.replace = replace and provider is None
+        self.provider = provider
         self.node_args = node_args or {}
         self._victim_hex: Optional[str] = None
         self._want_alive = 0
 
     def inject(self, event: ChaosEvent) -> Dict[str, Any]:
-        victims = [r for r in self.cluster.raylets if not r.is_head]
+        if self.provider is not None:
+            victims = [r for r in self.provider.non_terminated_nodes()
+                       if r in self.cluster.raylets and not r.is_head]
+        else:
+            victims = [r for r in self.cluster.raylets if not r.is_head]
         if not victims:
-            return {"skipped": "no non-head nodes"}
+            return {"skipped": "no killable nodes"}
         victims.sort(key=lambda r: r.node_id.hex())
         victim = victims[event.draw % len(victims)]
         self._victim_hex = victim.node_id.hex()
+        replaced = self.replace or self.provider is not None
         self._want_alive = len(self.cluster.raylets) \
-            if self.replace else len(self.cluster.raylets) - 1
+            if replaced else len(self.cluster.raylets) - 1
         self.cluster.crash_node(victim)
         if self.replace:
             self.cluster.add_node(**self.node_args)
-        return {"node": self._victim_hex[:12], "replaced": self.replace}
+        return {"node": self._victim_hex[:12], "replaced": replaced,
+                "via": "autoscaler" if self.provider is not None
+                       else ("inline" if self.replace else "none")}
 
     def recovered(self) -> bool:
         try:

@@ -92,6 +92,50 @@ _flag("direct_max_leases", int, 16,
       "Max concurrent worker leases per scheduling key per owner")
 _flag("direct_lease_idle_s", float, 2.0,
       "Idle time before a cached worker lease is returned to the raylet")
+_flag("direct_flush_tick_ms", float, 0.2,
+      "Owner-side submission flush tick: .remote() calls enqueue and a "
+      "dedicated flusher coalesces everything that accumulated into one "
+      "multi-spec push frame per lease per pump. The tick bounds how "
+      "long a lone submit waits for company; the flusher always wakes "
+      "immediately on the first enqueue, so an idle submit pays one "
+      "thread handoff, not the tick. 0 disables: every submit pumps "
+      "inline on the caller thread (pre-batching behavior, the A-B-A "
+      "inertness baseline)")
+_flag("direct_lease_steal", _parse_bool, True,
+      "Cross-key warm-lease reuse: a backlogged scheduling key may adopt "
+      "another key's idle cached lease when the lease's granted "
+      "resources cover the new key's demand and the runtime-env "
+      "signature matches — skipping the raylet round trip entirely. "
+      "Off: leases only ever serve the key that requested them")
+_flag("direct_result_batch_max", int, 16,
+      "Leased-worker result coalescing: while more direct tasks from the "
+      "same owner are queued locally, the worker buffers up to this many "
+      "task results and flushes them as ONE task_result_batch push (the "
+      "last queued task always flushes immediately, so latency is only "
+      "traded when the pipeline is already deep). 1 disables coalescing")
+_flag("arg_dedupe_cache_entries", int, 512,
+      "Owner-side by-value argument dedupe cache: small immutable args "
+      "(str/bytes/int/float/bool/None) serialize once per owner and "
+      "repeat submissions reuse the blob. LRU-bounded entry count; 0 "
+      "disables")
+_flag("pubsub_delta_flush_ms", float, 5.0,
+      "GCS pubsub delta-batching tick: OBJECT and RESOURCES channel "
+      "publishes accumulate per subscriber (coalesced latest-wins per "
+      "key; resource deltas merge per node) and flush as one bounded "
+      "monotonic pubsub_batch frame per tick, instead of one push frame "
+      "+ one pickle per event per subscriber. 0 disables: every publish "
+      "pushes immediately (pre-batching behavior)")
+_flag("pubsub_batch_max_events", int, 512,
+      "Max coalesced events per pubsub_batch frame; a flush with more "
+      "pending events emits multiple frames (bounded frames, nothing "
+      "dropped)")
+_flag("resource_broadcast_min_interval_ms", int, 100,
+      "Rate limit on full resource-view broadcasts (each heartbeat "
+      "requests one): at most one per interval, with a trailing "
+      "broadcast for the last coalesced request so views still "
+      "converge. 0 broadcasts every time (pre-batching behavior). At "
+      "100 nodes x 1 heartbeat/s, unthrottled full-view fanout is "
+      "10k pickles/s of a 100-entry dict — pure control-plane burn")
 _flag("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for pubsub subscribers")
 _flag("event_stats", bool, False, "Record per-handler event loop stats")
 _flag("task_events_max_buffer", int, 100000, "Max task events retained by the GCS task manager")
@@ -191,23 +235,51 @@ _flag("mesh_default_axes", str, "dp,fsdp,tp", "Default logical mesh axis order")
 
 
 class RayTpuConfig:
-    """Process-wide config instance; values resolved lazily from env."""
+    """Process-wide config instance; values resolved lazily from env.
+
+    Reads are memoized: the task fast path consults several flags per
+    submit, and resolving each from `os.environ` every time costs more
+    than the dict hit that replaces it. Explicit assignment
+    (`GLOBAL_CONFIG.flag = x`, the test idiom) lands in `_overrides`
+    and always wins; env-derived values land in `_cache`, which
+    `refresh()` drops so an env var set before `ray_tpu.init()` takes
+    effect in the same process (the bench's A-B-A off-path pattern)."""
 
     def __init__(self):
-        self._overrides: Dict[str, Any] = {}
+        object.__setattr__(self, "_overrides", {})
+        object.__setattr__(self, "_cache", {})
+
+    def __setattr__(self, name: str, value) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._overrides[name] = value
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
+        overrides = self._overrides
+        if name in overrides:
+            return overrides[name]
+        cache = self._cache
+        if name in cache:
+            return cache[name]
         flag = _FLAG_TABLE.get(name)
         if flag is None:
             raise AttributeError(f"Unknown config flag: {name}")
-        if name in self._overrides:
-            return self._overrides[name]
         env = os.environ.get(_ENV_PREFIX + name.upper())
         if env is not None:
-            return _parse_bool(env) if flag.type is bool else flag.type(env)
-        return flag.default
+            value = _parse_bool(env) if flag.type is bool else flag.type(env)
+        else:
+            value = flag.default
+        cache[name] = value
+        return value
+
+    def refresh(self):
+        """Drop env-derived memoized values (explicit sets persist) —
+        called at init() so env changes made since the last session are
+        observed."""
+        self._cache.clear()
 
     def initialize(self, system_config: Dict[str, Any] | None):
         """Apply a `_system_config` dict (propagated cluster-wide via env)."""

@@ -43,23 +43,41 @@ def _env_signature(runtime_env: Optional[Dict[str, Any]]) -> str:
 
 class _Lease:
     __slots__ = ("lease_id", "key", "address", "raylet_address", "client",
-                 "inflight", "last_used", "closed", "worker_id")
+                 "inflight", "last_used", "closed", "worker_id",
+                 "resources", "env_sig")
 
     def __init__(self, lease_id: bytes, key, address: str,
-                 raylet_address: str, worker_id=None):
+                 raylet_address: str, worker_id=None,
+                 resources: Optional[Dict[str, float]] = None,
+                 env_sig: str = ""):
         self.lease_id = lease_id
         self.key = key
         self.address = address
         self.raylet_address = raylet_address
         self.worker_id = worker_id
+        # What the raylet actually reserved for this lease — the adoption
+        # contract for cross-key reuse (a lease may serve any key whose
+        # demand it covers; it never serves one that needs more).
+        self.resources: Dict[str, float] = dict(resources or {})
+        self.env_sig = env_sig
         self.client: Optional[RpcClient] = None
         self.inflight: set = set()      # task_id bytes pushed, not yet done
         self.last_used = time.monotonic()
         self.closed = False
 
+    def covers(self, resources: Dict[str, float], env_sig: str) -> bool:
+        """Can this lease legally run tasks of that shape? The runtime-env
+        signature must match exactly (the leased worker was built for it);
+        the granted resources must dominate pointwise (over-reservation is
+        safe — the raylet accounted for MORE than the task uses)."""
+        if env_sig != self.env_sig:
+            return False
+        return all(self.resources.get(r, 0.0) >= amt
+                   for r, amt in resources.items())
+
 
 class DirectTaskTransport:
-    """Per-owner lease cache + pipelined direct submission."""
+    """Per-owner lease cache + pipelined, flush-tick-batched submission."""
 
     def __init__(self, runtime):
         self._rt = runtime
@@ -77,6 +95,23 @@ class DirectTaskTransport:
         self._task_lease: Dict[bytes, _Lease] = {}    # task_id -> lease
         self._closed = False
         self._reaper: Optional[threading.Thread] = None
+        # Flush-tick submission pipeline: submit() enqueues and marks the
+        # key dirty; one flusher thread coalesces everything that landed
+        # since its last pass into multi-spec frames. Off-path (tick=0):
+        # submit() pumps inline on the caller thread, exactly as before.
+        self._dirty: set = set()
+        self._flush_event = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+        # Observability counters (tests + bench assertions).
+        self.stats: Dict[str, int] = {
+            "lease_requests": 0,   # raylet round trips for new leases
+            "lease_steals": 0,     # cross-key warm-lease adoptions
+            "batch_frames": 0,     # multi-spec frames sent
+            "batched_specs": 0,    # specs that rode a multi-spec frame
+            "single_frames": 0,    # one-spec frames sent
+            "leases_lost": 0,      # leases invalidated by worker death
+            "leases_swept": 0,     # leases dropped by the liveness sweep
+        }
 
     # ------------------------------------------------------------ submission
 
@@ -121,6 +156,7 @@ class DirectTaskTransport:
         spec.direct = True
         key = (tuple(sorted(spec.resources.items())),
                _env_signature(spec.runtime_env))
+        batched = GLOBAL_CONFIG.direct_flush_tick_ms > 0
         with self._lock:
             if self._closed:
                 raise ConnectionLost("direct transport closed")
@@ -132,7 +168,60 @@ class DirectTaskTransport:
             self._last_template[key] = (dict(spec.resources),
                                         spec.runtime_env)
             self._ensure_reaper()
-        self._pump(key)
+            if batched:
+                self._dirty.add(key)
+                self._ensure_flusher()
+        if batched:
+            self._flush_event.set()
+        else:
+            self._pump(key)
+
+    def _schedule_pump(self, key):
+        """Request a pump for `key`: via the flusher when the flush-tick
+        pipeline is on (completion events mark-dirty instead of scanning
+        the lease table inline on the push thread), inline otherwise."""
+        if GLOBAL_CONFIG.direct_flush_tick_ms > 0 and not self._closed:
+            with self._lock:
+                self._dirty.add(key)
+                self._ensure_flusher()
+            self._flush_event.set()
+        else:
+            self._pump(key)
+
+    def _ensure_flusher(self):
+        # Caller holds self._lock.
+        if self._flusher is None and not self._closed:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="direct-submit-flush",
+                daemon=True)
+            self._flusher.start()
+
+    def _flush_loop(self):
+        """One pass = everything that accumulated since the last pass,
+        coalesced into one multi-spec frame per lease. The tick is a
+        COALESCING window, not a polling period: the loop sleeps on the
+        event and wakes on the first enqueue, so an isolated submit pays
+        one thread handoff; only bursts wait out the (sub-ms) tick — and
+        buy frame density for it."""
+        while not self._closed:
+            if not self._flush_event.wait(timeout=0.5):
+                continue
+            self._flush_event.clear()
+            tick = GLOBAL_CONFIG.direct_flush_tick_ms / 1000.0
+            if tick > 0:
+                time.sleep(tick)  # let the burst land behind one pump
+                self._flush_event.clear()
+            while not self._closed:
+                with self._lock:
+                    keys = list(self._dirty)
+                    self._dirty.clear()
+                if not keys:
+                    break
+                for key in keys:
+                    try:
+                        self._pump(key)
+                    except Exception:  # noqa: BLE001 — one key's failure
+                        logger.exception("direct flush pump failed")
 
     def _pump(self, key):
         """Push pending specs onto idle lease capacity; request more leases
@@ -153,8 +242,18 @@ class DirectTaskTransport:
             if pending:
                 leases = [l for l in self._leases.get(key, ())
                           if not l.closed and l.client is not None]
-                n_leases = len(leases)
                 cap = GLOBAL_CONFIG.direct_max_leases
+                # Cross-key warm reuse: a backlogged key adopts another
+                # key's IDLE cached lease when the grant covers its shape
+                # — the whole GCS/raylet round trip skipped (leases are
+                # stolen/rebalanced across keys instead of idling back).
+                if GLOBAL_CONFIG.direct_lease_steal:
+                    desired_now = min(cap, -(-backlog // max(1, pipeline)))
+                    if len(leases) < desired_now:
+                        adopted = self._adopt_leases_locked(
+                            key, desired_now - len(leases))
+                        leases.extend(adopted)
+                n_leases = len(leases)
                 # Phase 1 — steady state: fill each lease to the base
                 # pipeline depth (latency + cross-lease balance).
                 for lease in leases:
@@ -242,6 +341,38 @@ class DirectTaskTransport:
                 except Exception:  # noqa: BLE001 — raylet gone: queue died
                     pass
 
+    def _adopt_leases_locked(self, key, max_n: int) -> List[_Lease]:
+        """Steal up to `max_n` idle leases from OTHER keys whose grant
+        covers this key's shape (caller holds the lock). The lease is
+        re-keyed in place: its worker connection, raylet accounting and
+        idle clock all carry over — the new key's first task is one
+        framed write away instead of a lease round trip."""
+        resources = dict(key[0])
+        env_sig = key[1]
+        out: List[_Lease] = []
+        for other_key, leases in list(self._leases.items()):
+            if other_key == key:
+                continue
+            # Never strip a key that still has queued work of its own.
+            if self._pending.get(other_key):
+                continue
+            for lease in list(leases):
+                if len(out) >= max_n:
+                    return out
+                if lease.closed or lease.client is None or lease.inflight:
+                    continue
+                if not lease.covers(resources, env_sig):
+                    continue
+                leases.remove(lease)
+                lease.key = key
+                lease.last_used = time.monotonic()
+                self._leases[key].append(lease)
+                self.stats["lease_steals"] += 1
+                out.append(lease)
+            if not leases:
+                self._leases.pop(other_key, None)
+        return out
+
     def _send_batch(self, lease: _Lease, specs: List[TaskSpec]):
         def cb(env, _payload, specs=specs, lease=lease):
             if env.get("_lost") or env.get("e"):
@@ -254,9 +385,12 @@ class DirectTaskTransport:
 
         try:
             if len(specs) == 1:
+                self.stats["single_frames"] += 1
                 lease.client.call_async("direct_call", {"spec": specs[0]},
                                         cb)
             else:
+                self.stats["batch_frames"] += 1
+                self.stats["batched_specs"] += len(specs)
                 lease.client.call_async("direct_call_batch",
                                         {"specs": specs}, cb)
         except ConnectionLost:
@@ -285,6 +419,7 @@ class DirectTaskTransport:
         with self._lock:
             self._inflight_reqs[req_id] = key
             self._req_spec[req_id] = pseudo
+            self.stats["lease_requests"] += 1
 
         def cb(env, payload, req_id=req_id):
             if env.get("_lost") or env.get("e"):
@@ -345,7 +480,7 @@ class DirectTaskTransport:
         if pump and key is not None:
             # Pending work may still need capacity: re-pump (which may
             # re-request) unless leases already cover it.
-            self._pump(key)
+            self._schedule_pump(key)
 
     def _fail_pending(self, key, reason: str):
         from ray_tpu.exceptions import RaySystemError
@@ -399,7 +534,8 @@ class DirectTaskTransport:
             self._return_lease_rpc(data["raylet_address"], data["lease_id"])
             return
         lease = _Lease(data["lease_id"], key, data["address"],
-                       data["raylet_address"], data.get("worker_id"))
+                       data["raylet_address"], data.get("worker_id"),
+                       resources=dict(key[0]), env_sig=key[1])
         try:
             lease.client = RpcClient(
                 data["address"], name=f"lease-{data['lease_id'].hex()[:8]}",
@@ -427,17 +563,39 @@ class DirectTaskTransport:
                 lease.inflight.discard(tid)
                 self._task_lease.pop(tid, None)
                 lease.last_used = time.monotonic()
+            self._rt._on_raylet_push(method, data)
+            self._schedule_pump(lease.key)
+            return
+        if method == "task_result_batch":
+            # Coalesced completions: the worker buffered results while
+            # more of our tasks sat queued behind them — one frame, one
+            # wakeup, one pump for the whole batch.
+            batch = data["batch"]
+            with self._lock:
+                for item in batch:
+                    tid = item["task_id"].binary()
+                    lease.inflight.discard(tid)
+                    self._task_lease.pop(tid, None)
+                lease.last_used = time.monotonic()
+            for item in batch:
+                self._rt._on_raylet_push("task_result", item)
+            self._schedule_pump(lease.key)
+            return
         self._rt._on_raylet_push(method, data)
-        if method == "task_result":
-            self._pump(lease.key)
 
-    def _on_worker_lost(self, lease: _Lease):
-        """Leased worker connection dropped (crash or kill): re-route its
-        in-flight tasks through the classic path, honoring retry budgets."""
+    def _on_worker_lost(self, lease: _Lease, swept: bool = False):
+        """Leased worker connection dropped (crash or kill): invalidate
+        the cached lease and re-route its in-flight tasks through the
+        classic path, honoring retry budgets. This is the lease-cache
+        invalidation death hook (raylint RL012): every structure caching
+        this worker's address is purged here. `swept` marks a death the
+        anti-entropy sweep caught rather than the on-close hook — the
+        two stats stay disjoint so their sum counts invalidations."""
         with self._lock:
             if lease.closed:
                 return
             lease.closed = True
+            self.stats["leases_swept" if swept else "leases_lost"] += 1
             leases = self._leases.get(lease.key)
             if leases and lease in leases:
                 leases.remove(lease)
@@ -452,7 +610,7 @@ class DirectTaskTransport:
                     specs.append(rec.spec)
         if specs:
             self._rt._bg_submit(self._retry_classic, specs)
-        self._pump(lease.key)
+        self._schedule_pump(lease.key)
 
     def _retry_classic(self, specs: List[TaskSpec]):
         """Failover: resubmit via the raylet, counting the attempt against
@@ -545,22 +703,53 @@ class DirectTaskTransport:
 
     def _reaper_loop(self):
         """Return leases that sat idle past the timeout (reference:
-        worker lease released on idle, direct_task_transport.h:151)."""
+        worker lease released on idle, direct_task_transport.h:151) —
+        after offering each to a backlogged compatible key (rebalance
+        beats a return-then-re-request round trip). Also the anti-entropy
+        liveness sweep for the lease cache: a cached lease whose worker
+        connection is dead gets the full invalidation path even if the
+        on_close hook was somehow missed (raylint RL012 sweep evidence)."""
         idle_s = GLOBAL_CONFIG.direct_lease_idle_s
         while not self._closed:
             time.sleep(min(0.5, idle_s / 2))
             now = time.monotonic()
             to_return: List[_Lease] = []
+            dead: List[_Lease] = []
+            rebalanced: set = set()
             with self._lock:
                 for key, leases in list(self._leases.items()):
+                    for lease in list(leases):
+                        if not lease.closed and lease.client is not None \
+                                and lease.client.is_closed:
+                            dead.append(lease)
                     if self._pending.get(key):
                         continue
                     for lease in list(leases):
-                        if not lease.inflight and not lease.closed \
-                                and now - lease.last_used > idle_s:
-                            lease.closed = True
-                            leases.remove(lease)
-                            to_return.append(lease)
+                        if lease.inflight or lease.closed \
+                                or now - lease.last_used <= idle_s:
+                            continue
+                        if GLOBAL_CONFIG.direct_lease_steal:
+                            # Idle-return vs steal: a starving key takes
+                            # the lease instead of the raylet.
+                            target = next(
+                                (k for k, pend in self._pending.items()
+                                 if pend and k != key
+                                 and lease.covers(dict(k[0]), k[1])), None)
+                            if target is not None:
+                                leases.remove(lease)
+                                lease.key = target
+                                lease.last_used = now
+                                self._leases[target].append(lease)
+                                self.stats["lease_steals"] += 1
+                                rebalanced.add(target)
+                                continue
+                        lease.closed = True
+                        leases.remove(lease)
+                        to_return.append(lease)
+            for lease in dead:
+                self._on_worker_lost(lease, swept=True)
+            for key in rebalanced:
+                self._schedule_pump(key)
             for lease in to_return:
                 if lease.client is not None:
                     lease.client.close()
@@ -572,6 +761,8 @@ class DirectTaskTransport:
             leases = [l for ls in self._leases.values() for l in ls]
             self._leases.clear()
             self._pending.clear()
+            self._dirty.clear()
+        self._flush_event.set()  # unpark the flusher so it observes closed
         for lease in leases:
             lease.closed = True
             if lease.client is not None:

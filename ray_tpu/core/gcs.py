@@ -62,11 +62,34 @@ class Pubsub:
     Subscribers register (channel, key) on their GCS connection; publishes are
     pushed down those connections as `pubsub` messages. key=b"*" subscribes to
     the whole channel.
+
+    Delta-batching (`pubsub_delta_flush_ms` > 0): OBJECT and RESOURCES
+    publishes — the high-rate, snapshot-semantics channels — accumulate
+    per subscriber instead of pushing one frame (and paying one pickle)
+    per event per connection. A flusher drains the buffers every tick as
+    `pubsub_batch` frames carrying a strictly-increasing `seq` (monotonic
+    per connection; batches are never reordered or replayed). Coalescing
+    is delta-correct, not just latest-wins: OBJECT entries are full
+    snapshots so the newest replaces; RESOURCES deltas MERGE per node and
+    a full view supersedes everything queued before it. The buffer is
+    therefore bounded by (live objects-with-subscribers + 1 resource
+    slot) per connection, not by the event rate. Latency-sensitive
+    channels (ACTOR, NODE, LOG, PG) keep per-event pushes.
     """
+
+    BATCHED_CHANNELS = (CH_OBJECT, CH_RESOURCES)
 
     def __init__(self):
         self._lock = threading.Lock()
         self._subs: Dict[Tuple[str, bytes], Set[Connection]] = defaultdict(set)
+        # conn -> OrderedDict[(channel, key)] -> slot. A slot is
+        # [message, private] where `private` marks a per-conn merged copy
+        # (shared publish objects are never mutated). Entries vanish on
+        # every flush and on drop_connection.
+        self._pending: Dict[Connection, Dict[Tuple[str, bytes], list]] = {}
+        self._batch_seq = 0
+        self.stats = {"batch_frames": 0, "batched_events": 0,
+                      "coalesced_events": 0, "immediate_pushes": 0}
 
     def subscribe(self, conn: Connection, channel: str, key: bytes):
         with self._lock:
@@ -80,18 +103,106 @@ class Pubsub:
         with self._lock:
             for subs in self._subs.values():
                 subs.discard(conn)
+            self._pending.pop(conn, None)
 
     def publish(self, channel: str, key: bytes, message: Any):
+        batch = (channel in self.BATCHED_CHANNELS
+                 and GLOBAL_CONFIG.pubsub_delta_flush_ms > 0)
         with self._lock:
-            targets = list(self._subs.get((channel, key), ())) + list(
-                self._subs.get((channel, b"*"), ())
-            )
+            exact = self._subs.get((channel, key), ())
+            targets = list(exact)
+            if key != b"*":
+                targets += [c for c in self._subs.get((channel, b"*"), ())
+                            if c not in exact]
+            if batch:
+                for conn in targets:
+                    self._enqueue_locked(conn, channel, key, message)
+                return
         dead = []
         for conn in targets:
             try:
                 conn.push("pubsub", {"channel": channel, "key": key, "message": message})
+                self.stats["immediate_pushes"] += 1
             except (ConnectionLost, OSError):
                 dead.append(conn)
+        for conn in dead:
+            self.drop_connection(conn)
+
+    # ------------------------------------------------------ delta batching
+
+    def _enqueue_locked(self, conn: Connection, channel: str, key: bytes,
+                        message: Any):
+        pend = self._pending.setdefault(conn, {})
+        slot = pend.get((channel, key))
+        if slot is None:
+            pend[(channel, key)] = [message, False]
+            return
+        self.stats["coalesced_events"] += 1
+        if channel == CH_RESOURCES and isinstance(message, dict) \
+                and "delta" in message and isinstance(slot[0], dict):
+            # Merge the per-node delta into whatever is queued: into a
+            # queued full view's entries, or into a queued delta's map.
+            # Never in place on a shared publish object — copy on first
+            # merge.
+            cur = slot[0]
+            if "delta" in cur:
+                merged = dict(cur["delta"]) if not slot[1] else cur["delta"]
+                merged.update(message["delta"])
+                pend[(channel, key)] = [{"delta": merged}, True]
+            else:
+                view = dict(cur) if not slot[1] else cur
+                view.update(message["delta"])
+                pend[(channel, key)] = [view, True]
+            return
+        # Snapshot semantics (OBJECT entries, RESOURCES full views): the
+        # newest message supersedes everything queued under the key.
+        pend[(channel, key)] = [message, False]
+
+    def flush_batches(self):
+        """Drain every connection's pending buffer as pubsub_batch frames
+        (called by the owner's flusher thread each tick, and once at
+        shutdown). Identical frame content is serialized once and pushed
+        raw to every subscriber that accumulated it."""
+        with self._lock:
+            if not self._pending:
+                return
+            drained = self._pending
+            self._pending = {}
+        cap = max(1, GLOBAL_CONFIG.pubsub_batch_max_events)
+        # (content -> (seq, payload)): identical frames (the common case —
+        # every raylet subscribed b"*" accumulates the same snapshot
+        # objects) serialize once. A cached frame is only reused for a
+        # connection whose last delivered seq is below the cached seq, so
+        # per-connection seqs stay strictly increasing.
+        payload_cache: Dict[tuple, Tuple[int, bytes]] = {}
+        sent_last: Dict[Connection, int] = {}
+        dead = []
+        for conn, pend in drained.items():
+            events = [{"channel": ch, "key": k, "message": slot[0]}
+                      for (ch, k), slot in pend.items()]
+            for start in range(0, len(events), cap):
+                frame = events[start:start + cap]
+                content_key = tuple((e["channel"], e["key"],
+                                     id(e["message"])) for e in frame)
+                cached = payload_cache.get(content_key)
+                last = sent_last.get(conn, 0)
+                if cached is not None and cached[0] > last:
+                    seq, payload = cached
+                else:
+                    with self._lock:
+                        self._batch_seq += 1
+                        seq = self._batch_seq
+                    payload = serialization.dumps_ctrl(
+                        {"seq": seq, "events": frame})
+                    payload_cache[content_key] = (seq, payload)
+                try:
+                    conn.push_raw("pubsub_batch", payload)
+                    sent_last[conn] = seq
+                    self.stats["batch_frames"] += 1
+                    self.stats["batched_events"] += len(frame)
+                except (ConnectionLost, OSError):
+                    dead.append(conn)
+                    break
         for conn in dead:
             self.drop_connection(conn)
 
@@ -161,6 +272,11 @@ class GcsServer:
         self._job_counter = 1
         self._stopped = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # Rate-limited full resource-view broadcast (see
+        # _broadcast_resource_view) + the delta-batch flusher.
+        self._last_view_broadcast = 0.0
+        self._view_broadcast_dirty = False
+        self._pubsub_flush_thread: Optional[threading.Thread] = None
         # Table persistence (reference GCS fault tolerance keeps its tables
         # in an external store, `redis_store_client.h:28`; here: periodic
         # atomic snapshots to disk, reloaded by a restarted GCS at the same
@@ -185,6 +301,10 @@ class GcsServer:
             target=self._health_check_loop, name="gcs-health", daemon=True
         )
         self._health_thread.start()
+        self._pubsub_flush_thread = threading.Thread(
+            target=self._pubsub_flush_loop, name="gcs-pubsub-flush",
+            daemon=True)
+        self._pubsub_flush_thread.start()
         if self._storage_path:
             self._persist_thread = threading.Thread(
                 target=self._persist_loop, name="gcs-persist", daemon=True)
@@ -223,6 +343,12 @@ class GcsServer:
                 self._persist_tables()
             except Exception:
                 logger.exception("final GCS table persist failed")
+        try:
+            # Final drain so subscribers see everything published before
+            # the stop (a shutdown must not eat the last delta batch).
+            self.pubsub.flush_batches()
+        except Exception:  # noqa: BLE001 — conns may already be gone
+            logger.debug("final pubsub flush failed", exc_info=True)
         self.server.stop()
         for c in self._raylet_clients.values():
             c.close()
@@ -351,7 +477,7 @@ class GcsServer:
         if data.get("reconcile_actors"):
             self._exec.submit(self._reconcile_node_actors, info.node_id)
         self.pubsub.publish(CH_NODE, b"*", {"event": "alive", "node": info.to_public()})
-        self._broadcast_resource_view()
+        self._broadcast_resource_view(force=True)
         return {"node_count": len(self.nodes)}
 
     def _reconcile_node_actors(self, node_id: NodeID):
@@ -462,8 +588,53 @@ class GcsServer:
             return {n.node_id.hex(): self._view_entry_locked(n.node_id, n)
                     for n in self.nodes.values()}
 
-    def _broadcast_resource_view(self):
+    def _broadcast_resource_view(self, force: bool = False):
+        """Publish the full resource view, rate-limited: every heartbeat
+        of every node requests one, and at 100 nodes the unthrottled
+        fanout (heartbeats/s x subscribers) is pure control-plane burn.
+        Suppressed requests set a dirty flag; the pubsub flusher emits
+        the trailing broadcast once the interval has passed, so views
+        still converge to the latest state. `force` bypasses the limit:
+        topology changes (node registered / node died) must reach
+        schedulers NOW — a submit racing a stale empty view would queue
+        on an infeasible node and drag its dependencies there with it."""
+        min_s = GLOBAL_CONFIG.resource_broadcast_min_interval_ms / 1000.0
+        if min_s > 0:
+            now = time.monotonic()
+            with self._lock:
+                if not force and now - self._last_view_broadcast < min_s:
+                    self._view_broadcast_dirty = True
+                    return
+                self._last_view_broadcast = now
+                self._view_broadcast_dirty = False
         self.pubsub.publish(CH_RESOURCES, b"*", self._resource_view())
+        if force:
+            # Bypassing the rate limit alone isn't enough: CH_RESOURCES
+            # is a batched channel, so without this the "NOW" view would
+            # still sit in the delta buffer for a full flush tick.
+            self.pubsub.flush_batches()
+
+    def _pubsub_flush_loop(self):
+        """Drains the pubsub delta batches every `pubsub_delta_flush_ms`
+        and emits the trailing rate-limited resource-view broadcast. Runs
+        even when batching is disabled (tick 0) at a coarse poll so the
+        trailing broadcast path still exists."""
+        while not self._stopped.is_set():
+            tick = GLOBAL_CONFIG.pubsub_delta_flush_ms / 1000.0
+            if self._stopped.wait(tick if tick > 0 else 0.05):
+                return
+            min_s = GLOBAL_CONFIG.resource_broadcast_min_interval_ms / 1e3
+            if self._view_broadcast_dirty and (
+                    time.monotonic() - self._last_view_broadcast >= min_s):
+                try:
+                    self._broadcast_resource_view()
+                except Exception:  # noqa: BLE001 — retry next tick
+                    logger.debug("trailing view broadcast failed",
+                                 exc_info=True)
+            try:
+                self.pubsub.flush_batches()
+            except Exception:  # noqa: BLE001 — a bad conn must not stop
+                logger.exception("pubsub flush failed")
 
     def _health_check_loop(self):
         period = GLOBAL_CONFIG.health_check_period_ms / 1000.0
@@ -526,7 +697,7 @@ class GcsServer:
         for name, epoch, rank in hits:
             self._collective_mark_dead(
                 name, epoch, rank, f"node {node_id.hex()[:12]} died: {reason}")
-        self._broadcast_resource_view()
+        self._broadcast_resource_view(force=True)
 
     # -------------------------------------------------------- job management
 

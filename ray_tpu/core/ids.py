@@ -29,25 +29,26 @@ NODE_ID_SIZE = 28
 WORKER_ID_SIZE = 28
 PLACEMENT_GROUP_ID_SIZE = 18
 
-_rand_lock = threading.Lock()
-_rng: random.Random | None = None
-_rng_pid = 0
+_rand_local = threading.local()
 
 
 def _random_bytes(n: int) -> bytes:
-    """Process-local PRNG seeded once from os.urandom. Framework ids need
+    """Thread-local PRNG seeded once from os.urandom. Framework ids need
     uniqueness, not cryptographic strength, and urandom is a syscall that
     releases the GIL — in the thread-heavy control plane each id then
     pays a multi-ms GIL reacquire under load (profiled at 8.5ms/id during
-    actor-create storms). Keyed to the pid so forked workers (worker
-    forge) reseed instead of sharing the template's stream."""
-    global _rng, _rng_pid
+    actor-create storms). Thread-local rather than lock-guarded: the
+    task fast path mints several ids per submit, and a shared lock makes
+    every submitter contend with every RPC reader minting ids. Keyed to
+    the pid so forked workers (worker forge) reseed instead of sharing
+    the template's stream."""
     pid = os.getpid()
-    with _rand_lock:
-        if _rng is None or _rng_pid != pid:
-            _rng = random.Random(os.urandom(32))
-            _rng_pid = pid
-        return _rng.randbytes(n)
+    rng = getattr(_rand_local, "rng", None)
+    if rng is None or _rand_local.pid != pid:
+        rng = random.Random(os.urandom(32))
+        _rand_local.rng = rng
+        _rand_local.pid = pid
+    return rng.randbytes(n)
 
 
 class BaseID:

@@ -1070,6 +1070,13 @@ class Raylet:
     # ------------------------------------------------------- GCS push events
 
     def _on_gcs_push(self, method: str, data: Any):
+        if method == "pubsub_batch":
+            # Delta-batched frame: the GCS coalesced this subscriber's
+            # OBJECT/RESOURCES events behind one push (order per key
+            # preserved; `seq` strictly increases per connection).
+            for ev in data.get("events", ()):
+                self._on_gcs_push("pubsub", ev)
+            return
         if method != "pubsub":
             return
         channel = data["channel"]
@@ -1086,6 +1093,13 @@ class Raylet:
                             node_hex, 0):
                         continue
                     if ver:
+                        # Pruned by the full-view anti-entropy below:
+                        # each heartbeat view rebuild drops versions for
+                        # nodes outside the live set, so dead peers
+                        # cannot accumulate (and node ids are never
+                        # reused — a stale guard cannot reject a
+                        # replacement node's gossip).
+                        # raylint: disable=RL012 — swept by full view
                         self._peer_resource_versions[node_hex] = ver
                     view[node_hex] = entry
                 self._cluster_view = view

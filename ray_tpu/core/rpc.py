@@ -212,6 +212,17 @@ class Connection:
             self.alive = False
             raise ConnectionLost(str(e))
 
+    def push_raw(self, method: str, payload: bytes):
+        """Push a PRE-SERIALIZED payload: fan-out paths (pubsub delta
+        batches) serialize one frame once and send it to N subscribers,
+        instead of paying N pickles of identical content."""
+        try:
+            _send_msg(self.sock, {"i": 0, "k": "push", "m": method},
+                      payload, self.send_lock)
+        except OSError as e:
+            self.alive = False
+            raise ConnectionLost(str(e))
+
     def close(self):
         self.alive = False
         try:

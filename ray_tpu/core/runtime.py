@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import struct
 import threading
 import time
 from collections import defaultdict
@@ -161,6 +162,12 @@ class CoreRuntime:
         # wait_for_actor: suppresses the per-poll directory query).
         self._created_pending: set = set()
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
+        # By-value argument dedupe cache (see serialize_args): LRU of
+        # (type, value) -> serialized blob, hard-capped by
+        # arg_dedupe_cache_entries (evicted oldest-first on insert).
+        from collections import OrderedDict
+
+        self._arg_blob_cache: "OrderedDict" = OrderedDict()
         self._free_buffer: List[ObjectID] = []
         self._free_timer: Optional[threading.Timer] = None
         self._bg_executor = None  # lazy ThreadPoolExecutor for resubmits
@@ -237,6 +244,13 @@ class CoreRuntime:
     # ----------------------------------------------------------- push events
 
     def _on_raylet_push(self, method: str, data: Any):
+        if method == "task_result_batch":
+            # Coalesced lease-worker completions (normally unrolled by the
+            # direct transport's push handler; kept here so ANY connection
+            # delivering a batch resolves correctly).
+            for item in data["batch"]:
+                self._on_raylet_push("task_result", item)
+            return
         if method == "task_result":
             task_id: TaskID = data["task_id"]
             with self._lock:
@@ -353,6 +367,13 @@ class CoreRuntime:
             client.call("subscribe", {"channel": "ACTOR", "key": key}, timeout=5)
 
     def _on_gcs_push(self, method: str, data: Any):
+        if method == "pubsub_batch":
+            # Delta-batched pubsub frame (GCS coalesces per subscriber):
+            # unroll in arrival order — within a batch the GCS preserved
+            # publish order per key.
+            for ev in data.get("events", ()):
+                self._on_gcs_push("pubsub", ev)
+            return
         if method != "pubsub":
             return
         if data["channel"] == "LOG":
@@ -558,30 +579,69 @@ class CoreRuntime:
             self._exported_functions.add(fn_id)
         return fn_id
 
+    # Immutable leaf types whose serialized form may be deduped across
+    # submissions (they cannot embed ObjectRefs, so skipping the
+    # nested-ref capture for them is sound). bool before int matters not:
+    # the cache key carries the exact type.
+    _ARG_CACHE_TYPES = (str, bytes, int, float, bool, type(None))
+
     def serialize_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
                        ) -> Tuple[List[Tuple[str, Any]], List[str],
                                   List[ObjectID]]:
         """Inline small args; promote large ones to the store; pass refs
         through. Refs nested inside argument values are captured during
         pickling: the spec carries them (`nested_refs`) so the owner pins
-        them until the executing worker has registered its borrow."""
+        them until the executing worker has registered its borrow.
+
+        Shared by-value args serialize ONCE per owner: small immutable
+        leaves hit an LRU blob cache keyed by (type, value), so a loop
+        submitting the same literals 10k times pays 10k dict hits, not
+        10k pickles (the per-spec arg re-serialization that made
+        many-arg tasks lag plain ones)."""
         from ray_tpu.object_ref import ObjectRef, _NestedRefCapture
 
         out: List[Tuple[str, Any]] = []
         nested: List[ObjectID] = []
         flat = list(args) + list(kwargs.values())
+        cache = self._arg_blob_cache
+        cache_cap = GLOBAL_CONFIG.arg_dedupe_cache_entries
         for a in flat:
             if isinstance(a, ObjectRef):
                 self._ensure_dep_visible(a.object_id)
                 out.append(("r", a.object_id))
+                continue
+            cache_key = None
+            if cache_cap > 0 and type(a) in self._ARG_CACHE_TYPES:
+                if type(a) is float:
+                    # Floats key by bit pattern: -0.0 == 0.0 (a sign-of-
+                    # zero task would get the wrong cached value) and
+                    # NaN != NaN (every NaN would miss and pile up).
+                    cache_key = (float, struct.pack("<d", a))
+                else:
+                    cache_key = (type(a), a)
+                blob = cache.get(cache_key)
+                if blob is not None:
+                    cache.move_to_end(cache_key)
+                    # "c": dedupe-eligible immutable leaf — the worker may
+                    # share ONE deserialized value across tasks.
+                    out.append(("c", blob))
+                    continue
+                # Primitive leaves cannot carry refs: serialize without
+                # the capture scope.
+                blob = serialization.serialize_to_bytes(a)
             else:
                 with _NestedRefCapture() as captured:
                     blob = serialization.serialize_to_bytes(a)
                 nested.extend(captured)
-                if len(blob) > GLOBAL_CONFIG.object_inline_max_bytes:
-                    out.append(("r", self.put(a)))
-                else:
-                    out.append(("v", blob))
+            if len(blob) > GLOBAL_CONFIG.object_inline_max_bytes:
+                out.append(("r", self.put(a)))
+            elif cache_key is not None:
+                out.append(("c", blob))
+                cache[cache_key] = blob
+                while len(cache) > cache_cap:
+                    cache.popitem(last=False)
+            else:
+                out.append(("v", blob))
         for oid in nested:
             self._ensure_dep_visible(oid)
         return out, list(kwargs.keys()), nested
@@ -647,16 +707,17 @@ class CoreRuntime:
             spec.trace_ctx = self.child_trace_ctx()
         spec.runtime_env = self._prepare_runtime_env(spec.runtime_env)
         rec = _TaskRecord(spec=spec)
+        return_ids = spec.return_ids()  # minted once: hot-path ids hash
         with self._lock:
             self._tasks[spec.task_id.binary()] = rec
-            for oid in spec.return_ids():
+            for oid in return_ids:
                 self._object_to_task[oid.binary()] = spec.task_id.binary()
         self._pin_deps(spec)
         if GLOBAL_CONFIG.direct_task_enabled and self._direct.eligible(spec):
             self._direct.submit(spec)
         else:
             self._submit_spec_async(spec)
-        return spec.return_ids()
+        return return_ids
 
     def _submit_spec_async(self, spec: TaskSpec):
         """Pipelined submission: send the spec and return immediately; the
@@ -742,7 +803,13 @@ class CoreRuntime:
             spilled = False  # first spillback hop must accept, not bounce
         else:
             target_addr = start_addr
-            target = self._raylet_for(start_addr)
+            try:
+                target = self._raylet_for(start_addr)
+            except ConnectionLost:
+                # Spill target already dead (stale view): start locally.
+                target = self.raylet
+                target_addr = self.raylet.address
+                spilled = False
         for _hop in range(8):
             try:
                 resp = target.call("submit_task",
@@ -766,8 +833,16 @@ class CoreRuntime:
                 return
             if resp["status"] == "spillback":
                 target_addr = resp["address"]
-                target = self._raylet_for(target_addr)
-                spilled = True
+                try:
+                    target = self._raylet_for(target_addr)
+                except ConnectionLost:
+                    # The node the router chose died between its view
+                    # refresh and our dial (a kill can land at any
+                    # instant): one transparent re-route via the local
+                    # raylet, never a raised submit.
+                    target = self.raylet
+                    target_addr = self.raylet.address
+                spilled = target is not self.raylet
                 continue
             raise RaySystemError(f"unexpected submit status {resp}")
         raise RaySystemError("task spillback loop exceeded 8 hops")
@@ -802,6 +877,14 @@ class CoreRuntime:
         owner's lease tracking resubmits on node failure."""
         if self._closed:
             return
+        # Purge the dead client from the address cache (raylint RL012):
+        # the entry would otherwise pin a closed RpcClient forever for an
+        # address that may never be dialed again — and under 100-node
+        # churn those dead entries are one per killed node.
+        with self._lock:
+            client = self._raylet_clients.get(address)
+            if client is not None and client.is_closed:
+                self._raylet_clients.pop(address, None)
         # Lease requests queued at the dead raylet die with it: re-route
         # them too (tasks below; leases here).
         self._direct.on_raylet_lost(address)

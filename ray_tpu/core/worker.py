@@ -28,6 +28,7 @@ import queue
 import signal
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -68,6 +69,16 @@ class WorkerRuntime(CoreRuntime):
         self.direct_server.register("ping", lambda conn, data: {"ok": True})
         self.direct_server.start()
         self._cancelled_direct: set = set()
+        # Direct-result coalescing: completed lease-task results buffered
+        # per owner connection and flushed as ONE task_result_batch frame
+        # by a tick-bounded flusher thread (started on first use) — burst
+        # completions share a frame, while any single result is delayed
+        # by at most the flush tick, never by the NEXT task's runtime.
+        # Entries are popped on every flush and on connection failure.
+        self._direct_reply_buf: Dict[Connection, list] = {}
+        self._direct_reply_lock = threading.Lock()
+        self._direct_reply_event = threading.Event()
+        self._direct_reply_flusher: Optional[threading.Thread] = None
         # task_id -> (future, caller conn, spec) for in-flight actor calls,
         # so cancel_actor_task can cancel queued (and async running) work.
         self._actor_calls: Dict[bytes, tuple] = {}
@@ -91,6 +102,11 @@ class WorkerRuntime(CoreRuntime):
         )
         self.current_task_id = TaskID.for_task(JobID.nil())
         self._fn_cache: Dict[str, Any] = {}
+        # Deserialized-value cache for owner-deduped immutable args
+        # (kind "c"): blob -> value, LRU-capped in _resolve_args.
+        from collections import OrderedDict as _OD
+
+        self._arg_value_cache: "_OD" = _OD()
         # Actor state
         self.actor_instance: Any = None
         self.actor_spec: Optional[TaskSpec] = None
@@ -230,11 +246,28 @@ class WorkerRuntime(CoreRuntime):
         # Batch-fetch every ref arg in ONE get: a reduce-style task taking
         # n refs (push shuffle fan-in) must not pay n sequential fetch
         # round trips.
-        ref_ids = [payload for kind, payload in spec.args if kind != "v"]
+        ref_ids = [payload for kind, payload in spec.args
+                   if kind not in ("v", "c")]
         fetched = iter(self.get(ref_ids)) if ref_ids else iter(())
         values = []
+        arg_cache = self._arg_value_cache
         for kind, payload in spec.args:
-            if kind == "v":
+            if kind == "c":
+                # Owner-deduped immutable leaf (str/bytes/int/float/bool/
+                # None — see CoreRuntime.serialize_args): safe to share
+                # the deserialized value across tasks, so repeat args cost
+                # a dict hit instead of a pickle parse.
+                val = arg_cache.get(payload)
+                if val is None and payload not in arg_cache:
+                    val = serialization.deserialize(payload)
+                    arg_cache[payload] = val
+                    cap = max(1, GLOBAL_CONFIG.arg_dedupe_cache_entries)
+                    while len(arg_cache) > cap:
+                        arg_cache.popitem(last=False)
+                else:
+                    arg_cache.move_to_end(payload)
+                values.append(val)
+            elif kind == "v":
                 values.append(serialization.deserialize(payload))
             else:
                 values.append(next(fetched))
@@ -334,7 +367,7 @@ class WorkerRuntime(CoreRuntime):
         started = _time.time()
         if spec.task_id.binary() in self._cancelled_direct:
             self._cancelled_direct.discard(spec.task_id.binary())
-            self._reply_actor_result(
+            self._reply_direct_result(
                 conn, spec, [],
                 serialization.serialize_exception(
                     TaskCancelledError(spec.task_id), spec.name))
@@ -343,7 +376,7 @@ class WorkerRuntime(CoreRuntime):
             results, error_blob = self._run_task_body(spec)
         finally:
             self._cancelled_direct.discard(spec.task_id.binary())
-        self._reply_actor_result(conn, spec, results, error_blob)
+        self._reply_direct_result(conn, spec, results, error_blob)
         base = {
             "task_id": spec.task_id.hex(), "name": spec.name,
             "node_id": os.environ.get("RAY_TPU_NODE_ID", "")[:12],
@@ -677,6 +710,79 @@ class WorkerRuntime(CoreRuntime):
                 self._actor_calls.pop(spec.task_id.binary(), None)
         self._reply_actor_result_once(conn, spec, results, error_blob)
 
+    def _reply_direct_result(self, conn: Connection, spec: TaskSpec,
+                             results, error_blob):
+        """Reply for a lease-pushed direct task, coalescing with its
+        neighbours: results buffer per owner connection and a tick-bounded
+        flusher sends each run as one task_result_batch frame — per-result
+        framing + a syscall + an owner-side wakeup each would otherwise
+        dominate small-task throughput. A full buffer flushes inline; the
+        flush tick bounds how long any result can sit, so a fast result is
+        never held hostage by a slow successor task."""
+        # Store-path results must be raylet-registered BEFORE the owner
+        # learns of them (same ordering as _reply_actor_result).
+        for r in results:
+            if r["kind"] == "store":
+                try:
+                    self.raylet.call(
+                        "object_sealed",
+                        {"object_id": r["object_id"], "size": r["size"],
+                         "owner": self.worker_id.hex()}, timeout=30)
+                except Exception:
+                    logger.exception("failed to register direct result")
+        item = {"task_id": spec.task_id, "results": results,
+                "error": error_blob}
+        batch_max = GLOBAL_CONFIG.direct_result_batch_max
+        if batch_max <= 1 or GLOBAL_CONFIG.direct_flush_tick_ms <= 0:
+            # Coalescing off: per-result push (the A-B-A inert baseline).
+            try:
+                conn.push("task_result", item)
+            except Exception:
+                logger.warning("direct result push failed (caller gone?)")
+            return
+        flush_now = None
+        with self._direct_reply_lock:
+            buf = self._direct_reply_buf.setdefault(conn, [])
+            buf.append(item)
+            if len(buf) >= batch_max:
+                flush_now = self._direct_reply_buf.pop(conn)
+            elif self._direct_reply_flusher is None:
+                self._direct_reply_flusher = threading.Thread(
+                    target=self._direct_reply_flush_loop,
+                    name="direct-reply-flush", daemon=True)
+                self._direct_reply_flusher.start()
+        if flush_now is not None:
+            self._push_direct_replies(conn, flush_now)
+        else:
+            self._direct_reply_event.set()
+
+    def _push_direct_replies(self, conn: Connection, batch: list):
+        try:
+            if len(batch) == 1:
+                conn.push("task_result", batch[0])
+            else:
+                conn.push("task_result_batch", {"batch": batch})
+        except Exception:
+            logger.warning("direct result push failed (caller gone?)")
+
+    def _direct_reply_flush_loop(self):
+        while not self._stopping.is_set():
+            if not self._direct_reply_event.wait(timeout=0.5):
+                continue
+            self._direct_reply_event.clear()
+            tick = GLOBAL_CONFIG.direct_flush_tick_ms / 1000.0
+            if tick > 0:
+                time.sleep(tick)  # coalesce the completion burst
+            self._flush_direct_replies()
+        self._flush_direct_replies()  # final drain on graceful stop
+
+    def _flush_direct_replies(self):
+        with self._direct_reply_lock:
+            drained = self._direct_reply_buf
+            self._direct_reply_buf = {}
+        for conn, batch in drained.items():
+            self._push_direct_replies(conn, batch)
+
     def _reply_actor_result(self, conn: Connection, spec: TaskSpec,
                             results, error_blob):
         # Register large results with the raylet so other nodes can pull them.
@@ -697,8 +803,9 @@ class WorkerRuntime(CoreRuntime):
     def _graceful_exit(self, conn: Connection, spec: TaskSpec):
         self._reply_actor_result(conn, spec, [], None)
         self._stopping.set()
-        # os._exit kills the daemon flusher before its final drain runs —
-        # flush the last tasks' events synchronously first.
+        # os._exit kills the daemon flushers before their final drains —
+        # flush the last tasks' events and buffered results synchronously.
+        self._flush_direct_replies()
         self._flush_task_events()
         threading.Thread(target=lambda: (os._exit(0)), daemon=True).start()
 

@@ -60,6 +60,15 @@ class RemoteFunction:
         if bad:
             raise ValueError(f"Invalid @remote options: {bad}")
         self._function_blob: Optional[bytes] = None
+        # Per-(runtime, function) submit-path caches: the exported
+        # function id (sha1 of the blob — constant per function) and the
+        # normalized resource shape (constant per options dict). Keyed
+        # by the exporting runtime's worker_id (NOT a weakref — a
+        # RemoteFunction captured in a task closure must stay
+        # picklable), so a fresh session re-exports to its GCS.
+        self._function_id: Optional[str] = None
+        self._cached_resources: Optional[Dict[str, float]] = None
+        self._cached_rt_key = None  # worker_id of the exporting runtime
         self._name = getattr(function, "__qualname__", getattr(function, "__name__", "fn"))
         functools.update_wrapper(self, function)
 
@@ -88,18 +97,26 @@ class RemoteFunction:
         import ray_tpu
 
         runtime = ray_tpu._require_runtime()
-        if self._function_blob is None:
-            self._function_blob = serialization.dumps(self._function)
-        function_id = runtime.export_function(self._function_blob)
         opts = self._options
-        resources = normalize_resources(
-            num_cpus=opts.get("num_cpus"),
-            num_gpus=opts.get("num_gpus"),
-            num_tpus=opts.get("num_tpus"),
-            memory=opts.get("memory"),
-            resources=opts.get("resources"),
-            default_cpus=1.0,
-        )
+        if self._cached_rt_key != runtime.worker_id:
+            # New session (or first call): (re-)export to this runtime's
+            # GCS and rebuild the per-runtime caches.
+            if self._function_blob is None:
+                self._function_blob = serialization.dumps(self._function)
+            self._function_id = runtime.export_function(self._function_blob)
+            self._cached_resources = normalize_resources(
+                num_cpus=opts.get("num_cpus"),
+                num_gpus=opts.get("num_gpus"),
+                num_tpus=opts.get("num_tpus"),
+                memory=opts.get("memory"),
+                resources=opts.get("resources"),
+                default_cpus=1.0,
+            )
+            self._cached_rt_key = runtime.worker_id
+        function_id = self._function_id
+        # Fresh copy per spec: downstream (PG renaming, lease keying)
+        # treats spec.resources as its own.
+        resources = dict(self._cached_resources)
         resources, strategy, pg_id, bundle_idx = _resolve_pg_strategy(opts, resources)
         ser_args, kwargs_keys, nested_refs = runtime.serialize_args(args, kwargs)
         spec = TaskSpec(

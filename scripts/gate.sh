@@ -28,6 +28,11 @@ step "pytest tests/" python -m pytest tests/ -q
 # whole schedule) stays a bench-only run.
 step "chaos smoke (seeded, 1 node kill)" \
   env JAX_PLATFORMS=cpu python bench.py --chaos-smoke
+# 100-node envelope smoke: placement at width + one seeded node kill with
+# AUTOSCALER-driven replacement, bounded — zero hangs, zero lost tasks,
+# lease-cache invalidation asserted (no stale-lease double execution).
+step "envelope100 smoke (100 nodes, autoscaled kill)" \
+  env JAX_PLATFORMS=cpu python bench.py --envelope100-smoke
 step "multichip dryrun (8 virtual devices)" \
   env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py 8

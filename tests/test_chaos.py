@@ -461,3 +461,182 @@ def test_multiplexed_replica_kill_reloads_adapters_no_leaks():
         except Exception:  # noqa: BLE001
             pass
         ray_tpu.shutdown()
+
+
+# ---------------------------------------- task fast path in the victim set
+
+
+@pytest.mark.slow
+def test_node_kill_invalidates_lease_cache():
+    """Node death mid-push: every lease cached against the dead node's
+    workers is invalidated (the RL012 death hook), in-flight tasks
+    re-route to fresh leases within their retry budget, and the
+    side-channel execution marks prove no task was lost and no stale
+    lease double-pushed one (dup executions <= owner-recorded retries)."""
+    import os
+    import tempfile
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    mark_file = os.path.join(tempfile.mkdtemp(), "lease_marks")
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2, resources={"churn": 2})
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        @ray_tpu.remote
+        def marked(path, idx):
+            time.sleep(0.05)
+            with open(path, "a") as f:
+                f.write(f"{idx}\n")
+            return idx
+
+        opts = {"resources": {"churn": 1}, "max_retries": 8}
+        # Warm leases on the churn nodes, then keep the pipeline deep so
+        # the kill lands while pushes are in flight.
+        ray_tpu.get([marked.options(**opts).remote(mark_file, -1 - i)
+                     for i in range(4)], timeout=60)
+        d = ray_tpu._require_runtime()._direct
+        lost_before = d.stats["leases_lost"] + d.stats["leases_swept"]
+
+        refs = [marked.options(**opts).remote(mark_file, i)
+                for i in range(60)]
+        time.sleep(0.4)  # mid-stream...
+        victim = next(r for r in cluster.raylets if not r.is_head)
+        cluster.crash_node(victim)
+        cluster.add_node(num_cpus=2, resources={"churn": 2})
+
+        with HangWatchdog(limit_s=120) as wd:
+            results = ray_tpu.get(refs, timeout=120)
+        wd.assert_no_hangs()
+        assert results == list(range(60)), "task lost under node kill"
+        # The death hook fired for the victim's leases.
+        assert d.stats["leases_lost"] + d.stats["leases_swept"] \
+            > lost_before, "no cached lease was invalidated by the kill"
+        with d._lock:
+            for leases in d._leases.values():
+                for lease in leases:
+                    assert not lease.closed
+        # Duplicate executions are owner-accounted retries, never a
+        # stale-lease double push.
+        counts: dict = {}
+        with open(mark_file) as f:
+            for line in f:
+                if line.strip():
+                    idx = int(line)
+                    counts[idx] = counts.get(idx, 0) + 1
+        rt = ray_tpu._require_runtime()
+        retries = sum(rec.attempts for rec in rt._tasks.values()
+                      if rec.spec is not None
+                      and rec.spec.name.endswith("marked"))
+        dup = sum(c - 1 for c in counts.values()
+                  if c > 1)
+        assert dup <= retries, (
+            f"{dup} duplicate executions but only {retries} owner "
+            "retries: a stale lease double-pushed")
+    finally:
+        cluster.shutdown()
+
+
+def test_pubsub_delta_batch_monotonic_across_gcs_failover():
+    """Delta-batched pubsub frames carry a strictly-increasing seq per
+    connection; resource churn before, during, and after a GCS failover
+    never reorders or replays a batch, and the subscriber's merged view
+    converges to the restarted GCS's live resource view."""
+    import os
+    import tempfile
+
+    ray_tpu.shutdown()
+    path = os.path.join(tempfile.mkdtemp(), "gcs_tables.bin")
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1},
+                      gcs_storage_path=path)
+    subs = []
+    try:
+        cluster.wait_for_nodes()
+
+        frames: list = []   # (client_epoch, seq, events)
+
+        def make_subscriber(epoch):
+            def on_push(method, data):
+                if method == "pubsub_batch":
+                    frames.append((epoch, data["seq"], data["events"]))
+                elif method == "pubsub":
+                    frames.append((epoch, None, [data]))
+            cli = RpcClient(cluster.gcs.address,
+                            name=f"delta-sub-{epoch}",
+                            push_handler=on_push)
+            cli.call("subscribe", {"channel": "RESOURCES", "key": b"*"},
+                     timeout=10)
+            subs.append(cli)
+            return cli
+
+        make_subscriber(0)
+        # Resource churn: node joins force full-view broadcasts; task
+        # load drives per-node deltas.
+        added = [cluster.add_node(num_cpus=1, resources={"c": 1})
+                 for _ in range(3)]
+        cluster.wait_for_nodes()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not any(
+                e for _, s, e in frames if s is not None):
+            time.sleep(0.1)
+
+        cluster.kill_gcs()
+        cluster.restart_gcs()
+        # The old connection died with the GCS; a reconnected subscriber
+        # is a NEW connection epoch with its own seq stream.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                make_subscriber(1)
+                break
+            except Exception:  # noqa: BLE001 — GCS still restarting
+                time.sleep(0.2)
+        cluster.add_node(num_cpus=1, resources={"c": 1})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not any(
+                ep == 1 and s is not None for ep, s, _ in frames):
+            time.sleep(0.1)
+
+        # Monotonicity: per (epoch), batch seqs strictly increase —
+        # never reordered, never replayed, across the failover.
+        by_epoch: dict = {}
+        for ep, seq, _events in frames:
+            if seq is None:
+                continue
+            assert seq > by_epoch.get(ep, 0), (
+                f"batch seq regressed in epoch {ep}: {seq} after "
+                f"{by_epoch.get(ep)}")
+            by_epoch[ep] = seq
+        assert by_epoch.get(1), "no delta batch arrived after failover"
+
+        # Convergence: fold every RESOURCES event in arrival order; the
+        # merged view must match the restarted GCS's live view.
+        view: dict = {}
+        for _ep, _seq, events in frames:
+            for ev in events:
+                msg = ev["message"]
+                if "delta" in msg:
+                    view.update(msg["delta"])
+                else:
+                    view = dict(msg)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            live = cluster.gcs.handle_get_resource_view(None) \
+                if hasattr(cluster.gcs, "handle_get_resource_view") \
+                else cluster.gcs._resource_view()
+            if set(view) >= {k for k, e in live.items() if e.get("alive")}:
+                break
+            time.sleep(0.2)
+        alive = {k for k, e in live.items() if e.get("alive")}
+        assert set(view) >= alive, (
+            f"subscriber view missing alive nodes: {alive - set(view)}")
+    finally:
+        for cli in subs:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()

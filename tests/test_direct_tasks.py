@@ -184,3 +184,154 @@ def test_direct_timeline_events_recorded(ray_direct):
             break
         time.sleep(0.3)
     assert finished >= 3
+
+
+# ----------------------------------------------------- lease churn (PR 12)
+
+
+def test_lease_steal_across_keys(ray_direct):
+    """A backlogged scheduling key adopts another key's idle cached lease
+    when the grant covers its demand — no raylet round trip."""
+
+    @ray_tpu.remote
+    def full():
+        return "full"
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def half():
+        return "half"
+
+    # Warm leases under the CPU:1 key.
+    assert ray_tpu.get([full.remote() for _ in range(8)],
+                       timeout=30) == ["full"] * 8
+    d = _transport()
+    before = dict(d.stats)
+    # CPU:0.5 demand is covered by an idle CPU:1 grant: the transport may
+    # steal it instead of asking the raylet for a new lease.
+    assert ray_tpu.get([half.remote() for _ in range(8)],
+                       timeout=30) == ["half"] * 8
+    assert d.stats["lease_steals"] > before["lease_steals"], \
+        "covered cross-key submission did not reuse the warm lease"
+
+
+def test_lease_steal_disabled_flag(ray_direct):
+    """direct_lease_steal=False: keys never share leases (the off-path
+    inertness contract for the steal optimization)."""
+    old = GLOBAL_CONFIG.direct_lease_steal
+    GLOBAL_CONFIG.direct_lease_steal = False
+    try:
+        @ray_tpu.remote
+        def full():
+            return 1
+
+        @ray_tpu.remote(num_cpus=0.5)
+        def half():
+            return 2
+
+        ray_tpu.get([full.remote() for _ in range(4)], timeout=30)
+        d = _transport()
+        before = d.stats["lease_steals"]
+        ray_tpu.get([half.remote() for _ in range(4)], timeout=30)
+        assert d.stats["lease_steals"] == before
+    finally:
+        GLOBAL_CONFIG.direct_lease_steal = old
+
+
+def test_lease_steal_vs_idle_return_race(ray_direct):
+    """Leases sitting at the idle boundary while a compatible key goes
+    hungry: whichever side wins (reaper return vs steal/rebalance), every
+    task completes and the lease table stays consistent."""
+    old_idle = GLOBAL_CONFIG.direct_lease_idle_s
+    GLOBAL_CONFIG.direct_lease_idle_s = 0.3
+    try:
+        @ray_tpu.remote
+        def warm():
+            return "w"
+
+        @ray_tpu.remote(num_cpus=0.5)
+        def hungry():
+            return "h"
+
+        d = _transport()
+        for round_ in range(6):
+            assert ray_tpu.get([warm.remote() for _ in range(4)],
+                               timeout=30) == ["w"] * 4
+            # Land the cross-key burst right at the idle deadline: some
+            # rounds the reaper returns first, some rounds the steal wins.
+            time.sleep(0.3 if round_ % 2 else 0.25)
+            assert ray_tpu.get([hungry.remote() for _ in range(4)],
+                               timeout=30) == ["h"] * 4
+        with d._lock:
+            for key, leases in d._leases.items():
+                for lease in leases:
+                    assert not lease.closed, \
+                        "closed lease left in the cache (steal/return race)"
+                    assert lease.key == key, "lease filed under wrong key"
+    finally:
+        GLOBAL_CONFIG.direct_lease_idle_s = old_idle
+
+
+def test_arg_dedupe_serializes_shared_args_once(ray_direct):
+    """Small immutable args hit the owner-side blob cache: repeat
+    submissions reuse one serialization, and the values stay correct."""
+
+    @ray_tpu.remote
+    def check(a, b, c, d, e):
+        return (a, b, c, d, e)
+
+    rt = ray_tpu._require_runtime()
+    rt._arg_blob_cache.clear()
+    out = ray_tpu.get([check.remote(7, 2.5, "shared", b"blob", None)
+                       for _ in range(20)], timeout=30)
+    assert out == [(7, 2.5, "shared", b"blob", None)] * 20
+    # One cache entry per distinct (type, value) leaf — not per spec.
+    assert 0 < len(rt._arg_blob_cache) <= 8
+    # Mutable args must NOT be deduped (each spec needs its own copy).
+    @ray_tpu.remote
+    def mutate(lst):
+        lst.append(1)
+        return len(lst)
+
+    assert ray_tpu.get([mutate.remote([0]) for _ in range(4)],
+                       timeout=30) == [2] * 4
+
+
+def test_flush_tick_zero_is_inert():
+    """direct_flush_tick_ms=0: submits pump inline on the caller thread
+    and the flusher machinery never engages (the A-B-A off-path
+    contract). Multi-spec frames from backlog pumping are PRE-existing
+    behavior (PR-7 coalescing) and allowed either way."""
+    ray_tpu.shutdown()
+    old = GLOBAL_CONFIG.direct_flush_tick_ms
+    GLOBAL_CONFIG.direct_flush_tick_ms = 0.0
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def f(i):
+            return i * 3
+
+        d = _transport()
+        assert ray_tpu.get([f.remote(i) for i in range(16)],
+                           timeout=30) == [i * 3 for i in range(16)]
+        assert d._flusher is None, \
+            "flush tick disabled but the flusher thread engaged"
+    finally:
+        GLOBAL_CONFIG.direct_flush_tick_ms = old
+        ray_tpu.shutdown()
+
+
+def test_batched_submission_coalesces_frames(ray_direct):
+    """With the flush tick on, a burst rides multi-spec frames (the
+    whole point of the pipeline) and still resolves correctly."""
+    @ray_tpu.remote
+    def f(i):
+        return i + 100
+
+    d = _transport()
+    # One .remote() burst wide enough that the flusher sees a backlog.
+    refs = [f.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs, timeout=60) == [i + 100 for i in range(200)]
+    assert d.stats["batch_frames"] > 0, \
+        "200-task burst never coalesced into a multi-spec frame"
+    assert d.stats["batched_specs"] >= 2 * d.stats["batch_frames"]

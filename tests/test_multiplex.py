@@ -209,11 +209,16 @@ def test_multiplexed_llmserver_http_and_affinity(serve_cluster):
 
     router = _process_router()
     router._ensure_started()
-    deadline = time.time() + 10
+    deadline = time.time() + 20
     entry = None
     while time.time() < deadline:
         entry = router.entry_snapshot("zoo_llm")
-        if entry and entry.get("adapters"):
+        resident = next(iter((entry or {}).get("adapters", {}).values()),
+                        [])
+        # BOTH adapters, not just the first push: m-b's residency rides
+        # a later health tick than m-a's, and breaking on the first
+        # adapters entry raced it (flaky pre-PR-12).
+        if "m-a" in resident and "m-b" in resident:
             break
         time.sleep(0.25)
     assert entry and entry.get("mux"), entry

@@ -1268,3 +1268,123 @@ def test_rl011_suppression_with_reason(tmp_path):
         "self._buckets[tenant] = 1  "
         "# raylint: disable=RL011 — bounded by the fixed tenant set")
     assert lint_src(tmp_path, src, rules=["RL011"]) == []
+
+# ------------------------------------------------------------------ RL012
+
+RL012_BAD_NO_INVALIDATION = """
+    class Transport:
+        def __init__(self):
+            self._leases = {}
+
+        def on_grant(self, key, lease):
+            self._leases[key] = lease
+
+        def pick(self, key):
+            return self._leases.get(key)
+"""
+
+RL012_BAD_SHUTDOWN_ONLY = """
+    class Transport:
+        def __init__(self):
+            self._peer_clients = {}
+
+        def dial(self, addr, client):
+            self._peer_clients[addr] = client
+
+        def close(self):
+            self._peer_clients.clear()
+"""
+
+RL012_GOOD_DEATH_HOOK = """
+    class Transport:
+        def __init__(self):
+            self._leases = {}
+
+        def on_grant(self, key, lease):
+            self._leases[key] = lease
+
+        def _on_worker_lost(self, key):
+            self._leases.pop(key, None)
+"""
+
+RL012_GOOD_LIVENESS_SWEEP = """
+    class Transport:
+        def __init__(self):
+            self._peer_clients = {}
+
+        def dial(self, addr, client):
+            self._peer_clients[addr] = client
+
+        def _sweep_clients(self):
+            for addr in list(self._peer_clients):
+                if self._peer_clients[addr].is_closed:
+                    self._peer_clients.pop(addr)
+"""
+
+RL012_GOOD_ALIAS_REMOVAL = """
+    class Transport:
+        def __init__(self):
+            self._leases = {}
+
+        def on_grant(self, key, lease):
+            self._leases[key] = lease
+
+        def _on_worker_lost(self, key, lease):
+            leases = self._leases.get(key)
+            if leases is not None:
+                leases.remove(lease)
+"""
+
+RL012_GOOD_NON_ADDRESS_NAME = """
+    class Counter:
+        def __init__(self):
+            self._totals = {}
+
+        def bump(self, key):
+            self._totals[key] = self._totals.get(key, 0) + 1
+"""
+
+
+def test_rl012_flags_cache_without_invalidation(tmp_path):
+    findings = lint_src(tmp_path, RL012_BAD_NO_INVALIDATION,
+                        rules=["RL012"])
+    assert rule_ids(findings) == ["RL012"]
+    assert "_leases" in findings[0].message
+    assert "stale" in findings[0].message
+
+
+def test_rl012_flags_shutdown_only_cleanup(tmp_path):
+    findings = lint_src(tmp_path, RL012_BAD_SHUTDOWN_ONLY,
+                        rules=["RL012"])
+    assert rule_ids(findings) == ["RL012"]
+    assert "shutdown" in findings[0].message
+
+
+def test_rl012_quiet_with_death_hook(tmp_path):
+    assert lint_src(tmp_path, RL012_GOOD_DEATH_HOOK,
+                    rules=["RL012"]) == []
+
+
+def test_rl012_quiet_with_liveness_sweep(tmp_path):
+    assert lint_src(tmp_path, RL012_GOOD_LIVENESS_SWEEP,
+                    rules=["RL012"]) == []
+
+
+def test_rl012_quiet_on_alias_removal_in_death_hook(tmp_path):
+    assert lint_src(tmp_path, RL012_GOOD_ALIAS_REMOVAL,
+                    rules=["RL012"]) == []
+
+
+def test_rl012_ignores_non_address_caches(tmp_path):
+    # RL012 is scoped to worker/lease identity caches by name; a plain
+    # counter dict is RL011's business, not RL012's.
+    assert lint_src(tmp_path, RL012_GOOD_NON_ADDRESS_NAME,
+                    rules=["RL012"]) == []
+
+
+def test_rl012_suppression_with_reason(tmp_path):
+    src = RL012_BAD_NO_INVALIDATION.replace(
+        "self._leases[key] = lease",
+        "self._leases[key] = lease  "
+        "# raylint: disable=RL012 — entries rebuilt on every read")
+    assert lint_src(tmp_path, src, rules=["RL012"]) == []
