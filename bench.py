@@ -2579,6 +2579,227 @@ def bench_chaos(quick: bool, smoke: bool = False,
     return out
 
 
+def bench_ingest(quick: bool, smoke: bool = False,
+                 seed: int = 20260804) -> dict:
+    """Streaming ingest plane acceptance bench (ISSUE 14 / ROADMAP 5):
+    a shuffle-then-train pipeline at sustained load.
+
+    Reported: `ingest_gb_s` for a full windowed-shuffle epoch, per-step
+    `step_stall_ms` A/B (double-buffered prefetch on vs off — stall must
+    be <10% of step time with prefetch on), window/backpressure
+    accounting, and HARD asserts: `num_unsealed == 0` and zero leaked
+    store objects after the epoch, and a seeded chaos node kill
+    MID-SHUFFLE that recovers with recomputed blocks bounded by the dead
+    node's resident block count (never a pipeline restart), watchdog-
+    clean.
+
+    `smoke=True` is the gate's bounded variant: only the seeded
+    node-kill recovery phase, <60s."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.chaos import HangWatchdog, NodeKillInjector
+    from ray_tpu.chaos.schedule import single_event_schedule
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data.streaming.ingest import ShardIterator
+    from ray_tpu.data.streaming.lineage import core_reconstructions
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 3})
+    # Chaos-phase pipeline tasks pin to the KILLABLE nodes via the churn
+    # resource (the head is never a victim): the node kill must actually
+    # hit blocks the pipeline still needs for the recompute bound to
+    # mean something.
+    node_args = {"num_cpus": 2, "resources": {"churn": 2}}
+    for _ in range(2):
+        cluster.add_node(**node_args)
+    cluster.wait_for_nodes()
+    cluster.connect()
+    out: dict = {"ingest_seed": seed}
+
+    def _store_stats():
+        return [r.store.stats() for r in cluster.raylets]
+
+    def _assert_store_clean(tag: str):
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            stats = _store_stats()
+            if all(s["num_unsealed"] == 0 for s in stats):
+                break
+            time.sleep(0.2)
+        stats = _store_stats()
+        assert all(s["num_unsealed"] == 0 for s in stats), \
+            f"{tag}: unsealed buffers leaked: {stats}"
+        return stats
+
+    try:
+        if not smoke:
+            # --- Phase A: full shuffle epoch throughput + zero leaks ---
+            rows, shape = (40_000, (32,)) if quick else (120_000, (64,))
+            parallelism = 8
+            baseline_objs = [s["num_objects"] for s in _store_stats()]
+            ds = rd.range_tensor(rows, shape=shape,
+                                 parallelism=parallelism) \
+                .random_shuffle(seed=seed)
+            t0 = time.perf_counter()
+            nbytes = 0
+            for batch in ds.iter_batches(batch_size=2048):
+                nbytes += batch["data"].nbytes
+            wall = time.perf_counter() - t0
+            out["ingest_gb_s"] = round(nbytes / 1e9 / wall, 4)
+            out["ingest_epoch_bytes"] = nbytes
+            out["ingest_windows"] = ds.last_shuffle_stats.get("windows")
+            st = ds.stats()
+            bp = (st.backpressure or {}) if st else {}
+            out["ingest_bound_op"] = bp.get("bound_op")
+            _assert_store_clean("epoch")
+            # Zero store leaks: dropping the pipeline returns every node
+            # to (at most) its pre-epoch object count. Frees are batched
+            # on a 1s timer — poll with a deadline.
+            del ds
+            import gc as _gc
+
+            _gc.collect()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                now_objs = [s["num_objects"] for s in _store_stats()]
+                if all(n <= b for n, b in zip(now_objs, baseline_objs)):
+                    break
+                time.sleep(0.2)
+            now_objs = [s["num_objects"] for s in _store_stats()]
+            assert all(n <= b for n, b in zip(now_objs, baseline_objs)), \
+                f"store leak after epoch: {baseline_objs} -> {now_objs}"
+
+            # --- Phase B: train-shard step-stall A/B (prefetch on/off) ---
+            # The epoch is shuffled once and MATERIALIZED (epoch N trains
+            # while epoch N+1 shuffles — the pipeline overlap shape), so
+            # the A/B isolates what prefetch exists to hide: the per-host
+            # pull latency of each shard block, not shuffle compute.
+            ab_rows = 8_000 if quick else 24_000
+            step_s = 0.02
+            ds_ab = rd.range_tensor(ab_rows, shape=(32,), parallelism=8) \
+                .random_shuffle(seed=seed + 1).materialize()
+
+            def consume_shards(prefetch):
+                shards = [ShardIterator(s, prefetch) for s in
+                          ds_ab.streaming_split(2)]
+                stats = [None, None]
+
+                def run(i):
+                    for _ in shards[i].iter_batches(batch_size=256):
+                        time.sleep(step_s)  # the simulated train step
+                    stats[i] = shards[i].ingest_stats()
+
+                threads = [threading.Thread(target=run, args=(i,),
+                                            daemon=True) for i in (0, 1)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                    assert not t.is_alive(), "ingest consumer wedged"
+                steps = sum(s["steps"] for s in stats)
+                stall = sum(s["stall_ms_total"] for s in stats)
+                step_ms = sum(s["step_ms_total"] for s in stats)
+                return {"steps": steps,
+                        "step_stall_ms": round(stall / max(1, steps), 3),
+                        "stall_frac": round(stall / max(1e-9,
+                                                        stall + step_ms), 4)}
+
+            off = consume_shards(prefetch=0)
+            on = consume_shards(prefetch=2)
+            out["step_stall_ms_prefetch_off"] = off["step_stall_ms"]
+            out["step_stall_ms_prefetch_on"] = on["step_stall_ms"]
+            out["step_stall_frac_prefetch_off"] = off["stall_frac"]
+            out["step_stall_frac_prefetch_on"] = on["stall_frac"]
+            assert on["stall_frac"] < 0.10, \
+                f"prefetch-on stall {on['stall_frac']} >= 10% of step time"
+            assert on["step_stall_ms"] <= off["step_stall_ms"], (on, off)
+
+        # --- Phase C: seeded node kill MID-SHUFFLE, bounded recompute ---
+        # Few fat partitions: every block (inputs ~1 MiB, buckets ~T/p²,
+        # reduce outputs ~T/p) must clear the 100 KiB inline threshold or
+        # the intermediates live in the GCS instead of node stores and a
+        # node death loses nothing. Reduce in-flight is capped at 2 so
+        # the kill lands while most partitions still NEED their buckets —
+        # otherwise the fast exchange finishes before the fault bites and
+        # the "recovery" proves nothing.
+        from ray_tpu.data.context import DataContext
+
+        c_rows, n_parts = (16_000, 8) if (smoke or quick) else (32_000, 8)
+        ctx = DataContext.get_current()
+        old_in_flight = ctx.max_tasks_in_flight_per_op
+        ctx.max_tasks_in_flight_per_op = 2
+        try:
+            ds_chaos = rd.range_tensor(c_rows, shape=(64,),
+                                       parallelism=n_parts) \
+                .with_resources(resources={"churn": 0.25}) \
+                .random_shuffle(seed=seed + 2)
+            sched = single_event_schedule(seed, "node_kill")
+            injector = NodeKillInjector(cluster, replace=True,
+                                        node_args=node_args)
+            base_recon = core_reconstructions()
+            killed: dict = {}
+            rows_seen = 0
+            with HangWatchdog(limit_s=90.0) as wd:
+                for i, batch in enumerate(
+                        ds_chaos.iter_batches(batch_size=512)):
+                    rows_seen += len(batch["data"])
+                    if not killed:
+                        # Kill the node holding the MOST pipeline blocks
+                        # (steer the seeded event's draw onto it): a
+                        # victim the scheduler happened to leave idle
+                        # would prove nothing. Its resident count BEFORE
+                        # the kill bounds the permissible recompute work.
+                        import dataclasses as _dc
+
+                        victims = sorted(
+                            (r for r in cluster.raylets if not r.is_head),
+                            key=lambda r: r.node_id.hex())
+                        resident = [r.store.stats()["num_objects"]
+                                    for r in victims]
+                        idx = max(range(len(victims)),
+                                  key=lambda k: resident[k])
+                        event = _dc.replace(sched.events[0], draw=idx)
+                        killed["resident"] = resident[idx]
+                        detail = injector.inject(event)
+                        killed["node"] = detail.get("node")
+            wd.assert_no_hangs()
+        finally:
+            ctx.max_tasks_in_flight_per_op = old_in_flight
+        assert rows_seen == c_rows, \
+            f"epoch lost rows after node kill: {rows_seen}/{c_rows}"
+        assert killed, "node kill never fired"
+        recomputed = core_reconstructions() - base_recon
+        lineage = getattr(ds_chaos, "_lineage", None)
+        dataplane_recomputed = lineage.recomputed_blocks \
+            if lineage is not None else 0
+        recomputed += dataplane_recomputed
+        out["ingest_chaos_victim_resident_blocks"] = killed["resident"]
+        out["ingest_chaos_recomputed_blocks"] = recomputed
+        out["ingest_chaos_dataplane_recomputed"] = dataplane_recomputed
+        # Recovery actually ran (the kill destroyed blocks the pipeline
+        # still needed) AND stayed bounded: no more re-executions than
+        # the dead node held blocks (its map buckets + reduce outputs)
+        # plus one resubmission per output partition — never a restart
+        # of the whole pipeline.
+        assert recomputed >= 1, \
+            "node kill destroyed nothing the pipeline needed — the " \
+            "recovery path was not exercised"
+        bound = max(killed["resident"], 1) + n_parts
+        assert recomputed <= bound, \
+            f"recompute unbounded: {recomputed} > {bound} ({killed})"
+        out["ingest_chaos_recovery_bounded"] = True
+        out["ingest_zero_hangs"] = wd.hang_count == 0
+        _assert_store_clean("chaos")
+    finally:
+        try:
+            cluster.shutdown()
+        except Exception:  # noqa: BLE001 — nodes already churned away
+            pass
+    return out
+
+
 def main(out=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -2606,6 +2827,15 @@ def main(out=None):
                     help="run ONLY the seeded chaos smoke (gate step: one "
                          "node kill under light serve load, <60s) and "
                          "exit nonzero on any hang/recovery failure")
+    ap.add_argument("--skip-ingest", action="store_true",
+                    help="skip the streaming ingest bench (windowed "
+                         "shuffle epoch + train-shard stall A/B + "
+                         "mid-shuffle node kill)")
+    ap.add_argument("--ingest-smoke", action="store_true",
+                    help="run ONLY the bounded ingest smoke (gate step: "
+                         "one seeded node kill mid-shuffle, hard asserts "
+                         "on bounded recompute, <60s) and exit nonzero "
+                         "on any hang/unbounded-recovery failure")
     args = ap.parse_args()
 
     import ray_tpu
@@ -2619,6 +2849,18 @@ def main(out=None):
                               f"{type(e).__name__}: {e}"}), file=stream)
             sys.exit(1)
         print(json.dumps({"envelope100_smoke": smoke}), file=stream)
+        stream.flush()
+        sys.exit(0)
+
+    if args.ingest_smoke:
+        stream = out or sys.stdout
+        try:
+            smoke = bench_ingest(quick=True, smoke=True)
+        except Exception as e:  # noqa: BLE001 — the gate needs the reason
+            print(json.dumps({"ingest_smoke_error":
+                              f"{type(e).__name__}: {e}"}), file=stream)
+            sys.exit(1)
+        print(json.dumps({"ingest_smoke": smoke}), file=stream)
         stream.flush()
         sys.exit(0)
 
@@ -2745,6 +2987,11 @@ def main(out=None):
             extra.update(bench_chaos(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["chaos_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_ingest:
+        try:
+            extra.update(bench_ingest(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["ingest_error"] = f"{type(e).__name__}: {e}"
     try:
         ray_tpu.shutdown()
     except Exception:

@@ -24,6 +24,10 @@ for the catalog with real before/after examples):
                                   addresses show a death-hook or a
                                   sweep-against-liveness removal path
                                   (the stale-lease double-push shape)
+- RL013 unbounded-block-buffer  — data-plane operators accumulating
+                                  blocks into list/dict attributes show
+                                  a budget/bound check or a drain path
+                                  (the sustained-ingest OOM shape)
 """
 
 from __future__ import annotations
@@ -1487,3 +1491,177 @@ def rl012_lease_cache_invalidation(ctx: FileContext) -> Iterable[Finding]:
                 "it from the death hook or sweep it against liveness "
                 "(is_closed/alive), or annotate why stale entries are "
                 "harmless")
+
+
+# =====================================================================
+# RL013 unbounded-block-buffer
+# =====================================================================
+#
+# The sustained-ingest OOM shape (docs/DATA_STREAMING.md): a data-plane
+# operator accumulates BLOCKS — multi-MB units, not per-key bookkeeping
+# — into a list/dict attribute with nothing bounding the accumulation.
+# Burst-shaped tests never see it: the buffer drains at the end and
+# peak residency stays under the arena. Under sustained many-GB
+# dataflow the same buffer IS the working set, and an unbudgeted one
+# converts backpressure into an OOM kill. Statically checkable shape:
+#
+#   class WindowBuffer:               # data-plane module
+#       def __init__(self):
+#           self._blocks = []         # container born unbounded
+#       def on_block(self, b):
+#           self._blocks.append(b)    # steady-state accumulation
+#
+# with, anywhere in the class, NEITHER:
+#  (a) a DRAIN path — .pop()/.popleft()/.popitem()/.clear()/.remove(),
+#      `del d[k]`, whole reassignment outside __init__, or a bare
+#      handoff of the container (ownership lives with the callee,
+#      mirroring RL003/RL011); NOR
+#  (b) a BUDGET check in the accumulating method — an acquire/admission
+#      call or bound comparison (budget/acquire/admit/limit/max_*/
+#      capacity/window/bound/drop, incl. keyword arguments), e.g.
+#      `self._budget.acquire(op, nbytes)` before the append, or
+#      `if len(self._blocks) >= self._max_buffered: ...`.
+#
+# Containers bounded by construction (`deque(maxlen=...)`) are exempt.
+# Buffers whose bound genuinely lives with the producer annotate with
+# `# raylint: disable=RL013 — <where the budget is enforced>`.
+
+_RL013_PACKAGES = {"data"}
+_RL013_CTORS = {"dict", "defaultdict", "OrderedDict", "list", "deque"}
+_RL013_GROWERS = {"append", "extend", "appendleft", "setdefault", "insert"}
+_RL013_BOUND = re.compile(
+    r"budget|acquire|admit|limit|max_|capacity|window|bound|drop|maxsize"
+    r"|maxlen|full", re.I)
+
+
+def _in_scope_rl013(path: str) -> bool:
+    # Fixtures and out-of-tree files are always checked; in-tree files
+    # only in the data-plane package (same real-location scoping as
+    # RL004/RL011).
+    parts = os.path.abspath(path).replace("\\", "/").split("/")
+    for idx in range(len(parts) - 1, -1, -1):
+        if parts[idx] != "ray_tpu":
+            continue
+        root = "/".join(parts[:idx + 1])
+        if os.path.isfile(os.path.join(root, "__init__.py")):
+            return (len(parts) > idx + 2
+                    and parts[idx + 1] in _RL013_PACKAGES)
+    return True
+
+
+def _rl013_buffer_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """Attr -> lineno for unbounded list/dict/deque attrs born in
+    __init__ (`deque(maxlen=...)` is bounded by construction)."""
+    out: Dict[str, int] = {}
+    for fn in cls.body:
+        if not (isinstance(fn, _FUNC_NODES) and fn.name == "__init__"):
+            continue
+        for stmt in statements(fn.body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt, val = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                tgt, val = stmt.target, stmt.value
+            else:
+                continue
+            attr = _rl011_self_attr(tgt)
+            if attr is None:
+                continue
+            if isinstance(val, (ast.List, ast.Dict)) and not (
+                    isinstance(val, ast.Dict) and val.keys):
+                out[attr] = stmt.lineno
+            elif isinstance(val, ast.Call) and \
+                    last_segment(dotted(val.func)) in _RL013_CTORS:
+                if any(kw.arg == "maxlen" for kw in val.keywords):
+                    continue  # bounded by construction
+                out[attr] = stmt.lineno
+    return out
+
+
+def _rl013_grown(cls: ast.ClassDef) -> Dict[str, Tuple[ast.AST, ast.AST]]:
+    """Attr -> (first steady-state accumulating write, enclosing method)
+    — the method node feeds the budget-evidence scan."""
+    out: Dict[str, Tuple[ast.AST, ast.AST]] = {}
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES) or fn.name == "__init__":
+            continue
+        for node in ast.walk(fn):
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        a = _rl011_self_attr(tgt.value)
+                        if a:
+                            attr = a
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _RL013_GROWERS:
+                attr = _rl011_self_attr(node.func.value)
+            if attr is None:
+                continue
+            if attr not in out or node.lineno < out[attr][0].lineno:
+                out[attr] = (node, fn)
+    return out
+
+
+def _rl013_budget_evidence(fn: ast.AST) -> bool:
+    """Does the accumulating method consult a budget/bound? Same
+    name-evidence scan as RL010: any name, attribute, or keyword
+    argument matching the budget vocabulary counts."""
+    for sub in walk_excluding_nested_functions(fn):
+        names = []
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.append(sub.attr)
+        elif isinstance(sub, ast.Call):
+            names.extend(kw.arg for kw in sub.keywords if kw.arg)
+        if any(_RL013_BOUND.search(n) for n in names):
+            return True
+    return False
+
+
+def _rl013_drained(cls: ast.ClassDef) -> Set[str]:
+    """Attrs with drain/handoff evidence anywhere in the class (the
+    RL011 eviction scan plus deque/list removers)."""
+    out = set(_rl011_cleaned_attrs(cls))
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("popleft", "remove", "discard"):
+                attr = _rl011_self_attr(node.func.value)
+                if attr:
+                    out.add(attr)
+    return out
+
+
+@rule("RL013", "unbounded-block-buffer: data-plane operator accumulates "
+               "blocks with no budget check or drain path")
+def rl013_unbounded_block_buffer(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl013(ctx.path):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        buffers = _rl013_buffer_attrs(cls)
+        if not buffers:
+            continue
+        drained = _rl013_drained(cls)
+        grown = _rl013_grown(cls)
+        for attr, (node, fn) in sorted(grown.items(),
+                                       key=lambda kv: kv[1][0].lineno):
+            if attr not in buffers or attr in drained:
+                continue
+            if _rl013_budget_evidence(fn):
+                continue
+            yield ctx.finding(
+                node, "RL013",
+                f"`self.{attr}` accumulates blocks and {cls.name} neither "
+                "drains it nor checks a budget before growing it — under "
+                "sustained ingest this buffer IS the working set and OOMs "
+                "the node; acquire from the pipeline ByteBudget, bound "
+                "it, or drain it (or annotate where the bound lives)")

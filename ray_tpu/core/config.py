@@ -158,6 +158,18 @@ _flag("chaos_recovery_deadline_s", float, 120.0,
       "(serve replica STARTING, train gang restart) stuck longer than this "
       "fails loudly with the stuck state attributed instead of hanging; "
       "0 disables enforcement")
+_flag("data_inflight_budget_bytes", int, 0,
+      "Streaming data plane: global in-flight byte budget shared by every "
+      "operator of a pipeline execution (replaces per-op block-count "
+      "caps). 0 = negotiate against the local object store at execution "
+      "start (25% of store capacity, floor 64 MiB) so a shuffle whose "
+      "working set exceeds memory degrades into windows that spill "
+      "through the store's disk tier instead of OOMing")
+_flag("data_prefetch_shards", int, 2,
+      "Blocks a train-ingest shard iterator keeps pulled ahead of the "
+      "consuming step (per-host double buffering over the transfer "
+      "plane); 0 disables prefetch (every batch pays its pull latency "
+      "in step-stall time)")
 _flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
 _flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creating task after object loss")
 _flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")

@@ -2081,10 +2081,91 @@ class Raylet:
                 # Every advertised location failed (or there were none):
                 # wake blocked owners so they can reconstruct, not hang.
                 self._notify_object_waiters(oid, "object_unavailable")
+                # Tasks parked on this dependency would wait forever (no
+                # object_ready will ever fire): run the lost-dep ladder —
+                # tell owners to reconstruct, re-pull with bounded
+                # backoff while they do, and only then fail the parked
+                # tasks with a loss-shaped error (the PR-10 watchdog
+                # class: bounded recovery, never a hang).
+                self._handle_lost_dep(oid)
         except Exception:
             with self._lock:
                 self._pulls_inflight.discard(oid)
             logger.exception("pull worker failed for %s", oid)
+
+    # Lost-dep ladder bound: ~5s of re-pull attempts while the owner's
+    # reconstruction runs, then parked tasks fail loss-shaped.
+    _LOST_DEP_RETRIES = 10
+    _LOST_DEP_BACKOFF_S = 0.5
+
+    def _handle_lost_dep(self, oid: ObjectID, attempt: int = 0):
+        """A dependency pull found no live locations. Ladder:
+
+        1. notify each parked task's submitter (`task_dep_lost`) — the
+           OWNER holds the creating task's spec and re-executes it;
+        2. re-check the directory with bounded backoff, restarting the
+           pull the moment the re-executed object registers;
+        3. after the bound, complete the still-parked tasks with an
+           ObjectLostError result (loss-shaped, so data-plane lineage
+           can recompute) — a fault becomes a bounded error, not a hang.
+        """
+        from ray_tpu.exceptions import ObjectLostError
+
+        with self._lock:
+            if self._stopped.is_set() or not self._waiting_deps.get(oid):
+                return
+        try:
+            entry = self.gcs.call("object_locations_get",
+                                  {"object_id": oid}, timeout=5)
+        except Exception:  # noqa: BLE001 — directory unreachable: retry arm
+            entry = {}
+        if entry.get("known") and (entry.get("inline") is not None
+                                   or entry.get("nodes")):
+            # Advertised copies exist: re-pull (recovered, or the holder
+            # is dying and the directory hasn't heard). This arm resets
+            # the ladder, but it is bounded by the GCS death sweep —
+            # once the health checker marks the holder DEAD its
+            # locations are pruned and the next failed pull's ladder
+            # advances past this check.
+            self._start_pull(oid)
+            return
+        if attempt == 0:
+            with self._lock:
+                submitters = {
+                    self._task_submitters.get(qt.spec.task_id.binary())
+                    for qt in self._waiting_deps.get(oid, [])}
+            for conn in submitters:
+                if conn is not None and conn.alive:
+                    try:
+                        conn.push("task_dep_lost", {"object_id": oid})
+                    except Exception:  # noqa: BLE001 — submitter gone
+                        pass
+        if attempt < self._LOST_DEP_RETRIES:
+            t = threading.Timer(self._LOST_DEP_BACKOFF_S,
+                                self._handle_lost_dep, args=(oid, attempt + 1))
+            t.daemon = True
+            t.start()
+            return
+        with self._lock:
+            waiters = self._waiting_deps.pop(oid, [])
+            for qt in waiters:
+                try:
+                    self._queue.remove(qt)
+                except ValueError:
+                    pass
+        for qt in waiters:
+            tkey = qt.spec.task_id.binary()
+            with self._lock:
+                submitter = self._task_submitters.pop(tkey, None)
+            err = serialization.serialize_exception(
+                ObjectLostError(oid), qt.spec.name)
+            if submitter is not None and submitter.alive:
+                try:
+                    submitter.push("task_result",
+                                   {"task_id": qt.spec.task_id,
+                                    "results": [], "error": err})
+                except Exception:  # noqa: BLE001 — submitter gone
+                    pass
 
     def _pull_object_pipelined(self, oid: ObjectID, entry: Dict[str, Any]) -> bool:
         """Windowed, multi-source chunk fetch into a pre-created buffer.

@@ -16,7 +16,7 @@ import os
 import struct
 import threading
 import time
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import serialization
@@ -148,9 +148,18 @@ class CoreRuntime:
         # The "driver task" context: puts and submissions hang off this id.
         self.current_task_id = TaskID.for_task(job_id)
         self._put_counter = 0
+        # Process-wide count of lineage re-executions (_try_reconstruct
+        # resubmits): the data plane's recomputed-block accounting reads
+        # deltas of this to prove recovery after a node death is bounded.
+        self.reconstructions_total = 0
         self._lock = threading.RLock()
         self._tasks: Dict[bytes, _TaskRecord] = {}          # task_id -> record
         self._object_to_task: Dict[bytes, bytes] = {}        # return oid -> task_id
+        # Retained lineage of freed objects (task_key -> retained bytes):
+        # specs stay re-executable after their outputs are freed, bounded
+        # by lineage_max_bytes (oldest evicted first; see _retire_lineage).
+        self._retired_lineage: "OrderedDict[bytes, int]" = OrderedDict()
+        self._retired_lineage_bytes = 0
         self._object_cache: Dict[bytes, Any] = {}            # oid -> deserialized value
         self._exported_functions: set = set()
         self._actor_clients: Dict[bytes, ActorClient] = {}
@@ -165,8 +174,6 @@ class CoreRuntime:
         # By-value argument dedupe cache (see serialize_args): LRU of
         # (type, value) -> serialized blob, hard-capped by
         # arg_dedupe_cache_entries (evicted oldest-first on insert).
-        from collections import OrderedDict
-
         self._arg_blob_cache: "OrderedDict" = OrderedDict()
         self._free_buffer: List[ObjectID] = []
         self._free_timer: Optional[threading.Timer] = None
@@ -244,6 +251,17 @@ class CoreRuntime:
     # ----------------------------------------------------------- push events
 
     def _on_raylet_push(self, method: str, data: Any):
+        if method == "task_dep_lost":
+            # A raylet found every copy of a dependency gone while one of
+            # our tasks was parked on it. We own the creating task, so
+            # re-execute it (idempotent: an in-flight reconstruction is
+            # reused); the raylet's lost-dep ladder re-pulls as soon as
+            # the re-executed object registers. Off the push thread: the
+            # reconstruction may recursively rebuild deps.
+            oid: ObjectID = data["object_id"]
+            threading.Thread(target=self._try_reconstruct, args=(oid,),
+                             name="dep-reconstruct", daemon=True).start()
+            return
         if method == "task_result_batch":
             # Coalesced lease-worker completions (normally unrolled by the
             # direct transport's push handler; kept here so ANY connection
@@ -1319,6 +1337,32 @@ class CoreRuntime:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def local_result_size(self, oid: ObjectID) -> Optional[int]:
+        """Sealed byte size of a task-output object we own, read from the
+        completion record the worker already pushed — no directory round
+        trip. None when unknown (inline result, put, not ours)."""
+        key = oid.binary()
+        with self._lock:
+            task_key = self._object_to_task.get(key)
+            rec = self._tasks.get(task_key) if task_key is not None else None
+            if rec is None or not rec.results:
+                return None
+            for r in rec.results:
+                roid = r.get("object_id")
+                if roid is not None and roid.binary() == key:
+                    size = r.get("size")
+                    return int(size) if size else None
+        return None
+
+    def reexecute_task_for(self, oid: ObjectID) -> bool:
+        """Re-run the task that created `oid` (owner-side), even when the
+        task 'completed' — with a loss-shaped ERROR result because a
+        dependency died under it (the raylet fails parked tasks on lost
+        deps instead of hanging them). Callers must have seen loss-shaped
+        evidence for the object; bounded by the same per-task budget as
+        reconstruction. Returns True when a re-execution is in flight."""
+        return self._try_reconstruct(oid)
+
     def _try_reconstruct(self, oid: ObjectID, depth: int = 0) -> bool:
         """Owner-side lineage reconstruction: re-execute the creating task
         when every copy of one of its returns is gone (reference
@@ -1345,6 +1389,7 @@ class CoreRuntime:
             if rec.reconstructions >= GLOBAL_CONFIG.max_object_reconstructions:
                 return False
             rec.reconstructions += 1
+            self.reconstructions_total += 1
             rec.event.clear()
             rec.results = None
             rec.error = None
@@ -1622,6 +1667,56 @@ class CoreRuntime:
         except Exception:  # noqa: BLE001 — GCS hiccup: refs still usable,
             pass           # at worst the objects outlive this borrower
 
+    @staticmethod
+    def _lineage_bytes(spec: TaskSpec) -> int:
+        """Rough retained-lineage cost of one spec: inline arg payloads
+        plus a per-record overhead charge (spec + record objects are a
+        few KiB of real memory even with pure-ref args — the base keeps
+        the retained-record COUNT honest, not just the blob bytes)."""
+        try:
+            return 4096 + sum(
+                len(p) for _k, p in spec.args
+                if isinstance(p, (bytes, bytearray, memoryview)))
+        except Exception:  # noqa: BLE001 — cost estimate only
+            return 8192
+
+    def _retire_lineage(self, task_key: bytes, rec: _TaskRecord):
+        """Last reference to a completed task's outputs dropped: keep the
+        record re-executable (lineage) in a byte-bounded retirement
+        queue instead of dropping it. Eviction (oldest first, skipping
+        records that went back in flight or in scope) drops the record
+        AND its object->task mappings — past the bound, a lost object is
+        unrecoverable, exactly the `lineage_max_bytes` contract. Caller
+        holds self._lock."""
+        if task_key in self._retired_lineage:
+            return
+        cost = self._lineage_bytes(rec.spec)
+        self._retired_lineage[task_key] = cost
+        self._retired_lineage_bytes += cost
+        cap = max(0, GLOBAL_CONFIG.lineage_max_bytes)
+        for _ in range(len(self._retired_lineage)):
+            if self._retired_lineage_bytes <= cap:
+                break
+            old_key, old_cost = self._retired_lineage.popitem(last=False)
+            old_rec = self._tasks.get(old_key)
+            busy = old_rec is not None and (
+                not old_rec.event.is_set()
+                or any(self._ref_counts.get(r.binary(), 0) > 0
+                       for r in (old_rec.spec.return_ids()
+                                 if old_rec.spec is not None else [])))
+            if busy:  # re-executing or back in scope: keep, re-queue
+                self._retired_lineage[old_key] = old_cost
+                continue
+            self._retired_lineage_bytes -= old_cost
+            self._drop_lineage(old_key, old_rec)
+
+    def _drop_lineage(self, task_key: bytes, rec: Optional[_TaskRecord]):
+        self._tasks.pop(task_key, None)
+        if rec is not None and rec.spec is not None:
+            for r in rec.spec.return_ids():
+                if self._object_to_task.get(r.binary()) == task_key:
+                    self._object_to_task.pop(r.binary(), None)
+
     def deregister_ref(self, oid: ObjectID):
         if self._closed:
             return
@@ -1645,13 +1740,35 @@ class CoreRuntime:
                 borrow = False
                 owned = key in self._owned_puts or key in self._object_to_task
                 self._owned_puts.discard(key)
-                task_key = self._object_to_task.pop(key, None)
+                # LINEAGE RETENTION: keep the record (and the
+                # object->task mapping) so the creating task stays
+                # re-executable after the object is freed — a downstream
+                # task may still need this block rebuilt when a node
+                # dies (Exoshuffle's contract: shuffle intermediates are
+                # recomputable from retained lineage, not re-read from a
+                # bespoke service). The retirement queue bounds retained
+                # lineage by `lineage_max_bytes`.
+                task_key = self._object_to_task.get(key)
                 if task_key is not None:
                     rec = self._tasks.get(task_key)
-                    if rec is not None and rec.event.is_set():
-                        returns = rec.spec.return_ids() if rec.spec is not None else []
-                        if not any(r.binary() in self._object_to_task for r in returns):
-                            self._tasks.pop(task_key, None)
+                    replayable = (rec is not None and rec.spec is not None
+                                  and rec.spec.actor_id is None
+                                  and not rec.spec.actor_creation)
+                    if not replayable:
+                        # Pre-retention behavior for records lineage can
+                        # never replay (actor results, dangling maps).
+                        self._object_to_task.pop(key, None)
+                        if rec is not None and rec.event.is_set():
+                            returns = rec.spec.return_ids() \
+                                if rec.spec is not None else []
+                            if not any(r.binary() in self._object_to_task
+                                       for r in returns):
+                                self._tasks.pop(task_key, None)
+                    elif rec.event.is_set():
+                        returns = rec.spec.return_ids()
+                        if not any(self._ref_counts.get(r.binary(), 0) > 0
+                                   for r in returns):
+                            self._retire_lineage(task_key, rec)
                 if not owned:
                     # Not ours and not registered as a borrow (e.g. created
                     # before tracking): never free somebody else's object.

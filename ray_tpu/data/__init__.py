@@ -17,6 +17,8 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
 from ray_tpu.data.iterator import DataIterator, StreamSplitDataIterator
+from ray_tpu.data.streaming import (BlockLineage, ByteBudget,
+                                    ShardIterator)
 from ray_tpu.data import datasource as _ds
 
 
@@ -214,6 +216,7 @@ def read_mongo(uri: str, database: str, collection: str, *,
 __all__ = [
     "ActorPoolStrategy", "Dataset", "DataIterator",
     "StreamSplitDataIterator", "DataContext",
+    "BlockLineage", "ByteBudget", "ShardIterator",
     "Block", "BlockAccessor", "BlockMetadata",
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",

@@ -116,7 +116,24 @@ def _stable_key_hash(k) -> int:
 
 def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
     """Map side of the push shuffle: scatter one block's rows into n_out
-    bucket blocks (returned as separate objects via num_returns)."""
+    bucket blocks (returned as separate objects via num_returns).
+
+    Columnar fast path: a random scatter of a dict-of-arrays block
+    slices arrays by the assignment mask instead of materializing one
+    Python dict per row — the row->partition assignment draws the SAME
+    rng as the row path, so bucket membership is representation-
+    independent and seeded-deterministic either way."""
+    from ray_tpu.data.block import _is_batch_dict
+
+    if mode == "random" and _is_batch_dict(block) and block:
+        n = BlockAccessor(block).num_rows()
+        rng = np.random.default_rng(
+            None if seed is None else seed * 100003 + salt)
+        assignment = rng.integers(0, n_out, size=n)
+        if n_out == 1:
+            return block
+        return tuple({k: v[assignment == b] for k, v in block.items()}
+                     for b in range(n_out))
     rows = list(BlockAccessor(block).rows())
     buckets: List[list] = [[] for _ in range(n_out)]
     if mode == "hash":
@@ -134,10 +151,27 @@ def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
 
 def _shuffle_reduce_blocks(mode, seed, part_idx, *buckets):
     """Reduce side: concat this partition's buckets (+ local shuffle for
-    random mode, so within-partition order is random too)."""
+    random mode, so within-partition order is random too). Columnar
+    buckets concat as arrays and shuffle via one permutation."""
+    from ray_tpu.data.block import _is_batch_dict
+
+    if buckets and all(_is_batch_dict(b) for b in buckets):
+        merged = BlockAccessor.concat(list(buckets))
+        if mode == "random":
+            rng = np.random.default_rng(
+                None if seed is None else seed * 7919 + part_idx)
+            perm = rng.permutation(BlockAccessor(merged).num_rows())
+            merged = {k: v[perm] for k, v in merged.items()}
+        return merged
     rows: List[Any] = []
     for b in buckets:
-        rows.extend(b)
+        if _is_batch_dict(b):
+            # Mixed representations (e.g. a union of columnar and row
+            # parents): expand dict buckets to rows — extending the raw
+            # dict would splice column NAMES into the data.
+            rows.extend(BlockAccessor(b).rows())
+        else:
+            rows.extend(b)
     if mode == "random":
         rng = np.random.default_rng(
             None if seed is None else seed * 7919 + part_idx)
@@ -307,29 +341,7 @@ class Dataset:
     def _push_shuffle(self, *, mode: str, seed: Optional[int] = None,
                       key_fn: Optional[Callable[[Any], Any]] = None,
                       num_blocks: Optional[int] = None) -> "Dataset":
-        parent = self
-
-        def work() -> List[WorkItem]:
-            import ray_tpu
-
-            refs = list(parent.materialize()._iter_block_refs())
-            if not refs:
-                return []
-            n_out = num_blocks or len(refs)
-            smap = ray_tpu.remote(_shuffle_map_block)
-            sred = ray_tpu.remote(_shuffle_reduce_blocks)
-            bucket_refs = []
-            for salt, ref in enumerate(refs):
-                out = smap.options(num_returns=n_out).remote(
-                    ref, n_out, mode, seed, salt, key_fn)
-                bucket_refs.append([out] if n_out == 1 else out)
-            reduced = [
-                sred.remote(mode, seed, j,
-                            *[b[j] for b in bucket_refs])
-                for j in range(n_out)]
-            return [(None, (r,)) for r in reduced]
-
-        return _DeferredDataset(work)
+        return _WindowedShuffleDataset(self, mode, seed, key_fn, num_blocks)
 
     def union(self, *others: "Dataset") -> "Dataset":
         sets = [self, *others]
@@ -350,48 +362,79 @@ class Dataset:
         if self._materialized_refs is not None:
             yield from self._materialized_refs
             return
+        yield from self._execute_work(iter(self._work))
+
+    def _ensure_collector(self):
         from ray_tpu.data.context import DataContext
+
+        if not DataContext.get_current().enable_stats:
+            return None
+        from ray_tpu.data import stats as stats_mod
+
+        # One collector per Dataset, reused across executions and
+        # reaped with the Dataset object (a per-execution actor
+        # would leak one worker process per epoch).
+        collector = getattr(self, "_stats_collector", None)
+        if collector is None:
+            collector = stats_mod.make_collector()
+            self._stats_collector = collector
+        return collector
+
+    def _execute_work(self, work_iter, lineage=None) -> Iterator[Any]:
+        """Run one streaming execution over `work_iter` (shared by the
+        plan path and the windowed-shuffle path): byte-budgeted executor,
+        per-dataset stats collector, per-execution block lineage (shared
+        with an upstream shuffle stage when passed in)."""
         from ray_tpu.data.executor import StreamingExecutor
+        from ray_tpu.data.streaming.lineage import BlockLineage
 
-        collector = None
-        if DataContext.get_current().enable_stats:
-            from ray_tpu.data import stats as stats_mod
-
-            # One collector per Dataset, reused across executions and
-            # reaped with the Dataset object (a per-execution actor
-            # would leak one worker process per epoch).
-            collector = getattr(self, "_stats_collector", None)
-            if collector is None:
-                collector = stats_mod.make_collector()
-                self._stats_collector = collector
+        collector = self._ensure_collector()
+        if lineage is None:
+            lineage = BlockLineage()
+        self._lineage = lineage
         executor = StreamingExecutor(self._transforms,
                                      resources=self._resources,
-                                     stats_collector=collector)
+                                     stats_collector=collector,
+                                     lineage=lineage)
         # Cumulative across executions: the collector aggregates every
         # run of this Dataset, so the stats() flush barrier must expect
         # the total, not just the latest run's blocks.
         if getattr(self, "_executed_blocks", None) is None:
             self._executed_blocks = 0
-        for ref in executor.execute(iter(self._work)):
-            self._executed_blocks += 1
-            yield ref
+        try:
+            for ref in executor.execute(work_iter):
+                self._executed_blocks += 1
+                yield ref
+        finally:
+            self._last_budget_stats = executor.last_budget_stats
+            lineage.clear()  # recipes drain with the execution
 
     def stats(self):
         """Per-operator wall/rows/blocks summary, aggregated over every
         execution of this Dataset so far (re-iterating a lazy dataset
         adds to the totals — reference `Dataset.stats()`,
-        `data/_internal/stats.py`). None before any execution."""
+        `data/_internal/stats.py`), plus the LAST execution's per-op
+        byte-budget backpressure (`.backpressure` — where the pipeline
+        is bound). None before any execution."""
         from ray_tpu.data import stats as stats_mod
 
         return stats_mod.fetch(getattr(self, "_stats_collector", None),
                                expected_blocks=getattr(
-                                   self, "_executed_blocks", None))
+                                   self, "_executed_blocks", None),
+                               backpressure=getattr(
+                                   self, "_last_budget_stats", None))
 
     def _iter_block_values(self) -> Iterator[Block]:
         import ray_tpu
 
         for ref in self._iter_block_refs():
-            yield ray_tpu.get(ref)
+            # Data-tier lineage fallback: a block the core could not
+            # recover re-runs from its recorded recipe, bounded.
+            lineage = getattr(self, "_lineage", None)
+            if lineage is not None:
+                yield lineage.resolve(ref)
+            else:
+                yield ray_tpu.get(ref)
 
     def materialize(self) -> "Dataset":
         refs = list(self._iter_block_refs())
@@ -738,6 +781,86 @@ class _DeferredDataset(Dataset):
     def num_blocks(self) -> int:
         self._resolve()
         return len(self._work)
+
+
+class _WindowedShuffleDataset(Dataset):
+    """All-to-all exchange executed as the WINDOWED streaming plan
+    (ray_tpu/data/streaming/shuffle.py): parent blocks stream through
+    budget-bounded scatter windows whose sealed buckets spill through the
+    store's disk tier when the working set exceeds memory, then reduce
+    with bounded admission. Row-level output is identical to the seed-era
+    exchange for a given (mode, seed).
+
+    Re-iterating RE-WINDOWS: every epoch re-runs the exchange (and the
+    parent pipeline feeding it) instead of re-materializing the shuffled
+    dataset — multi-epoch train ingest holds one window of intermediates,
+    not the whole dataset. `materialize()` still pins an epoch's outputs
+    when a caller wants them resident."""
+
+    def __init__(self, parent: Dataset, mode: str, seed: Optional[int],
+                 key_fn: Optional[Callable[[Any], Any]],
+                 num_blocks: Optional[int],
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        super().__init__([], transforms, resources or parent._resources)
+        self._parent = parent
+        self._shuffle_plan = (mode, seed, key_fn, num_blocks)
+        # Filled per execution: windows / input_bytes / window_bytes.
+        self.last_shuffle_stats: Dict[str, Any] = {}
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return _WindowedShuffleDataset(self._parent, *self._shuffle_plan,
+                                       self._transforms + [transform],
+                                       self._resources)
+
+    def _copy(self) -> "Dataset":
+        return _WindowedShuffleDataset(self._parent, *self._shuffle_plan,
+                                       list(self._transforms),
+                                       self._resources)
+
+    def num_blocks(self) -> int:
+        n_out = self._shuffle_plan[3]
+        return n_out if n_out else self._parent.num_blocks()
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        if self._materialized_refs is not None:
+            yield from self._materialized_refs
+            return
+        from ray_tpu.data.streaming.budget import pipeline_budget
+        from ray_tpu.data.streaming.shuffle import iter_shuffled_refs
+
+        mode, seed, key_fn, _ = self._shuffle_plan
+        n_out = self.num_blocks()
+        if n_out <= 0:
+            return
+        from ray_tpu.data.streaming.lineage import BlockLineage
+
+        collector = self._ensure_collector()
+        lineage = BlockLineage()
+        stats: Dict[str, Any] = {}
+        with pipeline_budget() as budget:
+            reduce_refs = iter_shuffled_refs(
+                self._parent._iter_block_refs(), n_out, mode=mode,
+                seed=seed, key_fn=key_fn, budget=budget,
+                stage_stats=collector, stats=stats,
+                resources=self._resources, lineage=lineage)
+            try:
+                if not self._transforms:
+                    # No downstream transforms: reduce outputs ARE the
+                    # dataset's blocks — yield them directly instead of
+                    # paying an identity fused task per block (and keep
+                    # the lineage chain one level deep for recovery).
+                    self._lineage = lineage
+                    yield from reduce_refs
+                else:
+                    yield from self._execute_work(
+                        ((None, (r,)) for r in reduce_refs),
+                        lineage=lineage)
+            finally:
+                self.last_shuffle_stats = stats
+                if not self._transforms:
+                    self._last_budget_stats = budget.stats()
+                    lineage.clear()
 
 
 class GroupedData:

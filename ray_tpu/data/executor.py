@@ -1,4 +1,4 @@
-"""Streaming executor: pull-based block pipeline with backpressure.
+"""Streaming executor: pull-based block pipeline with byte-budget backpressure.
 
 Equivalent of the reference's `StreamingExecutor`
 (`python/ray/data/_internal/execution/streaming_executor.py:48` and the
@@ -8,12 +8,20 @@ this framework's one-hop task dispatch:
 - consecutive 1:1 block transforms are FUSED into one remote call per block
   (the reference's operator fusion rule), so a read->map->filter pipeline
   costs one task per block;
-- at most `max_tasks_in_flight_per_op` tasks run concurrently and at most
-  `max_buffered_blocks_per_op` finished blocks sit unconsumed — the pump
-  stops submitting until the consumer drains them (backpressure);
+- at most `max_tasks_in_flight_per_op` tasks run concurrently, and the
+  pipeline's in-flight OUTPUT is bounded in BYTES, not blocks: every
+  submission charges the execution's ByteBudget with the op's moving size
+  estimate (corrected to the sealed size once the directory knows it) and
+  the pump stalls while the pipeline is over budget — see
+  ray_tpu/data/streaming/budget.py for the budget model and the per-op
+  backpressure accounting surfaced by `ds.stats()`;
 - blocks are yielded as ObjectRefs in SUBMISSION order (streaming, like
   the reference's ordered bundles): consumers start before the read
-  finishes and iteration order is deterministic.
+  finishes and iteration order is deterministic;
+- each submitted block records its lineage recipe (producer, args, fused
+  transforms), so a lost block recomputes instead of failing the pipeline
+  (streaming/lineage.py; ref-valued args stay pinned until delivery —
+  that is the recovery window).
 """
 
 from __future__ import annotations
@@ -35,7 +43,8 @@ def _fused_apply(fns, producer, *args):
 
 def _fused_apply_stats(fns, collector, producer, *args):
     """Stats-collecting remote body: same as _fused_apply, plus one
-    fire-and-forget per-op timing record to the collector actor."""
+    fire-and-forget per-op timing record to the collector actor (whose
+    keyed state is bounded — see data/stats.py)."""
     from ray_tpu.data.stats import timed_apply
 
     block, records = timed_apply(fns, producer, args)
@@ -53,7 +62,9 @@ class StreamingExecutor:
                  max_in_flight: Optional[int] = None,
                  max_buffered: Optional[int] = None,
                  resources: Optional[dict] = None,
-                 stats_collector: Optional[Any] = None):
+                 stats_collector: Optional[Any] = None,
+                 lineage: Optional[Any] = None,
+                 op_name: Optional[str] = None):
         from ray_tpu.data.context import DataContext
 
         ctx = DataContext.get_current()
@@ -62,12 +73,34 @@ class StreamingExecutor:
         self._max_buffered = max_buffered or ctx.max_buffered_blocks_per_op
         self._resources = resources
         self._stats = stats_collector
+        self._lineage = lineage
+        from ray_tpu.data.streaming.budget import unique_op
+
+        self._op = unique_op(op_name or (
+            "+".join(getattr(fn, "_op_name", None)
+                     or getattr(fn, "__name__", "fn")
+                     for fn in transforms) if transforms else "Read"))
+        self._est_bytes = float(ctx.target_min_block_size)
+        self.last_budget_stats: Optional[dict] = None
+
+    def _observe_size(self, budget, charged: int, ref) -> int:
+        """Correct the in-flight charge to the sealed size and feed the
+        op's size estimate (EMA) for future admissions."""
+        from ray_tpu.data.streaming.shuffle import _block_size
+
+        actual = _block_size(ref)
+        if actual is None:
+            return charged
+        self._est_bytes = 0.8 * self._est_bytes + 0.2 * actual
+        budget.adjust(self._op, actual - charged)
+        return actual
 
     def execute(self, work: Iterator[Tuple[Optional[Callable], tuple]]
                 ) -> Iterator[Any]:
         """work: iterator of (producer, args). Yields block ObjectRefs in
         submission order (streaming)."""
         import ray_tpu
+        from ray_tpu.data.streaming.budget import pipeline_budget
 
         if self._stats is not None:
             base = ray_tpu.remote(_fused_apply_stats)
@@ -78,30 +111,67 @@ class StreamingExecutor:
         remote_fn = base.options(**self._resources) if self._resources \
             else base
 
-        work_iter = iter(work)
-        in_flight: dict = {}          # ref -> submission index
-        buffered: dict = {}           # submission index -> ready ref
+        with pipeline_budget() as budget:
+            try:
+                yield from self._pump(budget, remote_fn, extra, iter(work))
+            finally:
+                budget.release_op(self._op)
+                self.last_budget_stats = budget.stats()
+
+    def _pump(self, budget, remote_fn, extra, work_iter) -> Iterator[Any]:
+        import time as _time
+
+        import ray_tpu
+
+        in_flight: dict = {}          # ref -> (submission index, charge)
+        buffered: dict = {}           # submission index -> (ref, charge)
         submitted = 0
         emit = 0                      # next index to yield (ordered)
         exhausted = False
+        blocked_since: Optional[float] = None
+        pending: Optional[tuple] = None  # work item awaiting admission
         while True:
-            # Submit while under the in-flight cap and backpressure allows.
+            # Submit while under the task cap; the byte budget is the
+            # primary backpressure. try_acquire + drain-on-refusal: a
+            # blocking acquire here would deadlock the single-threaded
+            # pump (its own yield path is what releases charges).
             while (not exhausted and len(in_flight) < self._max_in_flight
                    and len(buffered) + len(in_flight) < self._max_buffered):
-                try:
-                    producer, args = next(work_iter)
-                except StopIteration:
-                    exhausted = True
-                    break
+                if pending is None:
+                    try:
+                        pending = next(work_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                charge = int(self._est_bytes)
+                if not budget.try_acquire(self._op, charge):
+                    if blocked_since is None:
+                        blocked_since = _time.perf_counter()
+                    break  # over budget: drain/yield below, retry after
+                if blocked_since is not None:
+                    budget.note_blocked(
+                        self._op, _time.perf_counter() - blocked_since)
+                    blocked_since = None
+                producer, args = pending
+                pending = None
                 ref = remote_fn.remote(self._transforms, *extra,
                                        producer, *args)
-                in_flight[ref] = submitted
+                if self._lineage is not None:
+                    # Ref-valued args stay pinned by the recipe until the
+                    # block is delivered (resolve() forgets on success) —
+                    # the recovery window for a dependency dying under a
+                    # "completed" task.
+                    self._lineage.record(ref, producer, args,
+                                         self._transforms)
+                in_flight[ref] = (submitted, charge)
                 submitted += 1
             # Yield strictly in submission order (the reference's streaming
             # executor preserves block order): later-finished blocks buffer
             # until their predecessors emit — iteration is deterministic.
             if emit in buffered:
-                yield buffered.pop(emit)
+                ref, charge = buffered.pop(emit)
+                budget.release(self._op, charge)
+                yield ref
                 emit += 1
                 continue
             if not in_flight:
@@ -112,7 +182,8 @@ class StreamingExecutor:
             ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
                                     timeout=10.0)
             for r in ready:
-                buffered[in_flight.pop(r)] = r
+                idx, charge = in_flight.pop(r)
+                buffered[idx] = (r, self._observe_size(budget, charge, r))
 
 
 def apply_transforms_local(transforms: List[Callable], block: Any) -> Any:

@@ -55,6 +55,17 @@ class DataIterator:
     def iter_rows(self) -> Iterator[Any]:
         yield from self._dataset.iter_rows()
 
+    def iter_shards(self, n: int, *, prefetch: Optional[int] = None,
+                    equal: bool = False) -> List["Any"]:
+        """n coordinated per-host shards over ONE shared streaming
+        execution, each double-buffer-prefetching `prefetch` blocks
+        (default `data_prefetch_shards`) ahead of its consumer with
+        step-stall accounting — the train ingest path (see
+        ray_tpu/data/streaming/ingest.py)."""
+        from ray_tpu.data.streaming.ingest import iter_shards
+
+        return iter_shards(self._dataset, n, prefetch=prefetch, equal=equal)
+
     def materialize(self):
         return self._dataset.materialize()
 
@@ -80,11 +91,15 @@ class _SplitCoordinator:
         with self._lock:
             if epoch > self._epoch:
                 self._epoch = epoch
-                self._iter = self._ds._iter_block_values()
+                # Hand out REFS, not values: the consumer pulls the block
+                # to ITS host over the transfer plane's location-aware
+                # pipelined pull (locality routing), instead of every
+                # block transiting this actor's response path by value.
+                self._iter = self._ds._iter_block_refs()
             if epoch < self._epoch or self._iter is None:
                 return {"end": True}
             try:
-                return {"block": next(self._iter)}
+                return {"ref": next(self._iter)}
             except StopIteration:
                 return {"end": True}
 
@@ -120,7 +135,12 @@ class StreamSplitDataIterator:
                 self._coordinator.next_block.remote(self._split_id, epoch))
             if resp.get("end"):
                 return
-            yield resp["block"]
+            if "ref" in resp:
+                # Locality pull: materialize on THIS host via the
+                # transfer plane (chunked, striped across holders).
+                yield ray_tpu.get(resp["ref"])
+            else:
+                yield resp["block"]
 
     def __reduce__(self):
         return (StreamSplitDataIterator,

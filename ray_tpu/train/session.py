@@ -76,9 +76,19 @@ class _TrainSession:
         ds = self.datasets.get(name)
         if ds is None:
             return None
-        # ray_tpu.data DataIterator shards are pre-split by the trainer;
-        # plain iterables pass through.
+        # ray_tpu.data shards are pre-split by the trainer (prefetching
+        # ShardIterators); plain iterables pass through.
         return ds
+
+    def ingest_stats(self) -> Dict[str, Any]:
+        """Per-dataset step-stall accounting from every shard that keeps
+        it (ShardIterator): did input ever stall the step?"""
+        out: Dict[str, Any] = {}
+        for name, ds in self.datasets.items():
+            stats_fn = getattr(ds, "ingest_stats", None)
+            if stats_fn is not None:
+                out[name] = stats_fn()
+        return out
 
 
 _session: Optional[_TrainSession] = None
@@ -118,6 +128,14 @@ def get_checkpoint() -> Optional[Checkpoint]:
 
 def get_dataset_shard(name: str = "train"):
     return get_session().get_dataset_shard(name)
+
+
+def get_ingest_stats() -> Dict[str, Any]:
+    """Step-stall accounting of this worker's dataset shards (per
+    dataset: steps, stall_ms_total, stall_frac — see
+    ray_tpu/data/streaming/ingest.py). Empty when shards don't track
+    ingest (plain iterables)."""
+    return get_session().ingest_stats()
 
 
 def get_context() -> TrainContext:

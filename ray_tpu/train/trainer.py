@@ -100,8 +100,11 @@ class DataParallelTrainer(BaseTrainer):
 
     def _split_datasets(self) -> Optional[List[Dict[str, Any]]]:
         """Per-worker dataset shards: ray_tpu.data Datasets are
-        streaming_split; plain lists are round-robin sharded; other values
-        are passed through whole."""
+        streaming_split and wrapped in prefetching ShardIterators (the
+        worker's prefetch thread double-buffers blocks onto its host over
+        the transfer plane, with step-stall accounting — see
+        ray_tpu/data/streaming/ingest.py); plain lists are round-robin
+        sharded; other values are passed through whole."""
         if not self.datasets:
             return None
         n = self.scaling_config.num_workers
@@ -109,7 +112,9 @@ class DataParallelTrainer(BaseTrainer):
         for name, ds in self.datasets.items():
             splits = None
             if hasattr(ds, "streaming_split"):
-                splits = ds.streaming_split(n)
+                from ray_tpu.data.streaming.ingest import ShardIterator
+
+                splits = [ShardIterator(s) for s in ds.streaming_split(n)]
             elif isinstance(ds, (list, tuple)):
                 splits = [list(ds[i::n]) for i in range(n)]
             if splits is None:

@@ -28,6 +28,13 @@ step "pytest tests/" python -m pytest tests/ -q
 # whole schedule) stays a bench-only run.
 step "chaos smoke (seeded, 1 node kill)" \
   env JAX_PLATFORMS=cpu python bench.py --chaos-smoke
+# Ingest smoke: one seeded node kill MID-SHUFFLE (the node holding the
+# most blocks), <60s — the epoch must complete with recomputed blocks
+# >= 1 (the fault destroyed state the pipeline needed) and bounded by
+# the victim's resident count, HangWatchdog-clean, zero unsealed
+# buffers (exit nonzero on any hang/unbounded-recompute/leak).
+step "ingest smoke (seeded node kill mid-shuffle)" \
+  env JAX_PLATFORMS=cpu python bench.py --ingest-smoke
 # 100-node envelope smoke: placement at width + one seeded node kill with
 # AUTOSCALER-driven replacement, bounded — zero hangs, zero lost tasks,
 # lease-cache invalidation asserted (no stale-lease double execution).

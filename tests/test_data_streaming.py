@@ -1,0 +1,423 @@
+"""Streaming ingest plane: byte budget, windowed shuffle, spill, lineage
+recovery, prefetching train shards (ray_tpu/data/streaming/)."""
+
+import gc
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.streaming import (BlockLineage, ByteBudget,
+                                    ShardIterator)
+
+
+# --------------------------------------------------------------------------- #
+# ByteBudget
+# --------------------------------------------------------------------------- #
+
+
+def test_budget_admission_and_release():
+    b = ByteBudget(100)
+    assert b.try_acquire("map", 60)
+    assert b.try_acquire("map", 30)
+    # Over budget with bytes in flight: refused.
+    assert not b.try_acquire("map", 30)
+    b.release("map", 60)
+    assert b.try_acquire("map", 30)
+    stats = b.stats()
+    assert stats["ops"]["map"]["blocks"] == 3
+    assert stats["ops"]["map"]["bytes_hwm"] == 90
+    assert stats["used_bytes"] == 60
+
+
+def test_budget_progress_guarantee_admits_oversized_block():
+    """A block larger than the whole budget must admit when the op has
+    nothing in flight — degrade to window-at-a-time, never deadlock."""
+    b = ByteBudget(10)
+    assert b.try_acquire("map", 1000)
+    assert not b.try_acquire("map", 1)  # now it has to wait
+    b.release("map", 1000)
+    assert b.try_acquire("map", 1)
+
+
+def test_budget_cross_op_progress():
+    """One op hogging the budget must not permanently starve another:
+    the starved op (nothing in flight) is admitted over budget."""
+    b = ByteBudget(100)
+    assert b.try_acquire("map", 100)
+    assert b.try_acquire("reduce", 50)  # progress guarantee
+    assert not b.try_acquire("reduce", 10)
+
+
+def test_budget_adjust_corrects_estimate():
+    b = ByteBudget(100)
+    b.try_acquire("map", 10)
+    b.adjust("map", 40)  # sealed size turned out to be 50
+    assert b.used == 50
+    b.release("map", 50)
+    assert b.used == 0
+
+
+def test_budget_release_op_drains_charges_and_reset_drains_ledger():
+    b = ByteBudget(100)
+    b.try_acquire("map", 70)
+    b.release_op("map")
+    assert b.used == 0
+    # The account survives for stats(); reset() is the full drain.
+    assert b.stats()["ops"]["map"]["bytes_in_flight"] == 0
+    b.reset()
+    assert b.stats()["ops"] == {}
+
+
+def test_budget_blocking_acquire_wakes_on_release():
+    b = ByteBudget(100)
+    assert b.acquire("map", 100)
+    done = []
+
+    def blocked():
+        done.append(b.acquire("map", 50, timeout=5.0))
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    b.release("map", 100)
+    t.join(timeout=5.0)
+    assert done == [True]
+    assert b.stats()["ops"]["map"]["blocked_s"] > 0
+
+
+def test_budget_negotiated_respects_config(ray_start_shared):
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    ctx = DataContext.get_current()
+    old = ctx.inflight_budget_bytes
+    try:
+        ctx.inflight_budget_bytes = 12345
+        assert ByteBudget.negotiated().total == 12345
+        # None = fall through to the GLOBAL_CONFIG flag (refresh()-aware
+        # memoized read); explicit set wins over the default.
+        ctx.inflight_budget_bytes = None
+        GLOBAL_CONFIG.data_inflight_budget_bytes = 54321
+        try:
+            assert ByteBudget.negotiated().total == 54321
+        finally:
+            GLOBAL_CONFIG._overrides.pop("data_inflight_budget_bytes", None)
+        # Flag default (0) = negotiate against the store: nonzero, and no
+        # bigger than the store itself.
+        negotiated = ByteBudget.negotiated().total
+        assert negotiated >= 64 * 1024 * 1024
+    finally:
+        ctx.inflight_budget_bytes = old
+
+
+# --------------------------------------------------------------------------- #
+# Windowed shuffle
+# --------------------------------------------------------------------------- #
+
+
+def test_windowed_shuffle_matches_seeded_rows(ray_start_shared):
+    """A tiny budget forces multiple windows; the row-level output must
+    be IDENTICAL to the same seed under a huge budget (windowing is
+    invisible to determinism)."""
+    ctx = DataContext.get_current()
+    old = ctx.inflight_budget_bytes
+    try:
+        ctx.inflight_budget_bytes = 1 << 30
+        wide = rd.range(300, parallelism=6).random_shuffle(seed=11)
+        rows_wide = [r["id"] for r in wide.take_all()]
+
+        ctx.inflight_budget_bytes = 4096  # a few KB: forces windows
+        narrow = rd.range(300, parallelism=6).random_shuffle(seed=11)
+        rows_narrow = [r["id"] for r in narrow.take_all()]
+        assert rows_narrow == rows_wide
+        assert sorted(rows_narrow) == list(range(300))
+        assert narrow.last_shuffle_stats["windows"] > 1
+        assert wide.last_shuffle_stats["windows"] == 1
+    finally:
+        ctx.inflight_budget_bytes = old
+
+
+def test_windowed_shuffle_reexecutes_per_epoch(ray_start_shared):
+    """Re-iterating a shuffled dataset RE-WINDOWS (re-runs the exchange)
+    instead of reusing materialized refs — multi-epoch ingest must not
+    pin the whole dataset."""
+    ds = rd.range(120, parallelism=4).random_shuffle(seed=3)
+    first = [r["id"] for r in ds.iter_rows()]
+    stats_first = dict(ds.last_shuffle_stats)
+    second = [r["id"] for r in ds.iter_rows()]
+    assert sorted(first) == sorted(second) == list(range(120))
+    assert first == second  # seeded: epochs agree
+    assert ds._materialized_refs is None
+    assert ds.last_shuffle_stats["input_blocks"] == \
+        stats_first["input_blocks"]
+    # materialize() still pins an epoch when asked.
+    mat = ds.materialize()
+    assert mat._materialized_refs is not None
+
+
+def test_shuffle_backpressure_accounting(ray_start_shared):
+    ctx = DataContext.get_current()
+    old = ctx.inflight_budget_bytes
+    try:
+        ctx.inflight_budget_bytes = 4096
+        ds = rd.range(200, parallelism=4).random_shuffle(seed=5)
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(200))
+        stats = ds.stats()
+        bp = stats.backpressure
+        assert bp is not None
+        # Stage ledger keys are instance-unique ("ShuffleMap#<n>") so
+        # sibling executions sharing a budget can't cross-release.
+        shuffle_ops = {op: acct for op, acct in bp["ops"].items()
+                       if op.startswith("Shuffle")}
+        assert shuffle_ops, bp["ops"]
+        assert all(acct["bytes_in_flight"] == 0
+                   for acct in shuffle_ops.values())
+        assert any(acct["blocks"] > 0 for acct in bp["ops"].values())
+        assert "backpressure" in repr(stats)
+    finally:
+        ctx.inflight_budget_bytes = old
+
+
+def test_map_pipeline_budget_accounting(ray_start_shared):
+    ds = rd.range(100, parallelism=4).map(lambda r: {"id": r["id"] + 1})
+    assert ds.count() == 100
+    bp = ds.stats().backpressure
+    assert bp is not None and bp["total_bytes"] > 0
+    (op_name, acct), = [kv for kv in bp["ops"].items()]
+    assert acct["blocks"] == 4
+    assert acct["bytes_in_flight"] == 0  # everything released
+
+
+def test_shuffle_mixed_block_representations(ray_start_shared):
+    """A union of columnar and row parents shuffles correctly: the
+    columnar fast path's dict buckets must expand to ROWS in a mixed
+    reduce partition (regression: extending the raw dict spliced column
+    names into the data)."""
+    cols = rd.from_numpy(np.arange(40, dtype=np.int64), column="id")
+    rows = rd.from_items([{"id": int(i)} for i in range(40, 60)])
+    out = cols.union(rows).random_shuffle(seed=6)
+    got = sorted(int(r["id"]) for r in out.iter_rows())
+    assert got == list(range(60))
+
+
+# --------------------------------------------------------------------------- #
+# Lineage
+# --------------------------------------------------------------------------- #
+
+
+def test_lineage_recompute_is_bounded(ray_start_shared):
+    import ray_tpu
+
+    def make_block(lo, hi):
+        return [{"id": i} for i in range(lo, hi)]
+
+    lineage = BlockLineage(max_recomputes_per_block=2)
+    ref = ray_tpu.remote(make_block).remote(0, 5)
+    lineage.record(ref, make_block, (0, 5), [])
+    assert len(lineage) == 1
+    new_ref = lineage.recompute(ref)
+    assert ray_tpu.get(new_ref) == make_block(0, 5)
+    acct = lineage.accounting()
+    assert acct["dataplane_recomputed_blocks"] == 1
+    # Attempt budget: the same recipe re-runs at most max_recomputes.
+    newer = lineage.recompute(new_ref)
+    from ray_tpu.exceptions import ObjectLostError
+
+    with pytest.raises(ObjectLostError):
+        lineage.recompute(newer)
+    lineage.clear()
+    assert len(lineage) == 0
+
+
+def test_lineage_registry_is_bounded():
+    """Recipes pin their ref args, so the registry is a bounded FIFO —
+    a ref-taking consumer can't pin a whole epoch of intermediates."""
+    class _FakeRef:
+        def __init__(self, i):
+            self.object_id = type("_O", (), {
+                "binary": staticmethod(lambda i=i: b"%08d" % i)})()
+
+    lineage = BlockLineage(max_recomputes_per_block=1)
+    for i in range(BlockLineage.MAX_RECORDS + 40):
+        lineage.record(_FakeRef(i), None, (i,), [])
+    assert len(lineage) == BlockLineage.MAX_RECORDS
+
+
+def test_executor_records_replayable_lineage_only(ray_start_shared):
+    """Recipes with ObjectRef args are the core tier's business (data-tier
+    records would pin upstream blocks); ref-free recipes are recorded
+    while the execution runs and drain when it finishes."""
+    ds = rd.range(60, parallelism=3).map(lambda r: {"id": r["id"]})
+    seen = []
+    for _ in ds._iter_block_refs():
+        seen.append(len(ds._lineage))
+    assert max(seen) > 0  # read recipes (range args) were recorded
+    assert len(ds._lineage) == 0  # cleared with the execution
+
+
+# --------------------------------------------------------------------------- #
+# Train ingest: prefetching shards + stall accounting
+# --------------------------------------------------------------------------- #
+
+
+def _slow_blocks(n, delay_s, rows_per_block=8):
+    for b in range(n):
+        time.sleep(delay_s)
+        yield {"id": np.arange(b * rows_per_block,
+                               (b + 1) * rows_per_block)}
+
+
+class _SlowSource:
+    """Iterable block source with a per-block production delay."""
+
+    def __init__(self, n, delay_s):
+        self.n = n
+        self.delay_s = delay_s
+
+    def __iter__(self):
+        return _slow_blocks(self.n, self.delay_s)
+
+
+def test_shard_iterator_accounts_stall_and_steps():
+    it = ShardIterator(_SlowSource(6, 0.01), prefetch=2)
+    batches = list(it.iter_batches(batch_size=8))
+    assert len(batches) == 6
+    stats = it.ingest_stats()
+    assert stats["steps"] == 6
+    assert stats["epochs"] == 1
+    assert stats["prefetch_depth"] == 2
+    assert 0.0 <= stats["stall_frac"] <= 1.0
+    assert stats["stall_ms_total"] >= 0.0
+
+
+def test_shard_iterator_prefetch_hides_producer_latency():
+    """Double-buffered prefetch overlaps block production with the
+    consuming step: stall with prefetch on must undercut prefetch off
+    (the A/B the ingest bench gates on)."""
+    def consume(prefetch):
+        it = ShardIterator(_SlowSource(10, 0.02), prefetch=prefetch)
+        for _ in it.iter_batches(batch_size=8):
+            time.sleep(0.02)  # the "step"
+        return it.ingest_stats()
+
+    stalled = consume(prefetch=0)
+    overlapped = consume(prefetch=2)
+    assert overlapped["stall_ms_total"] < stalled["stall_ms_total"], \
+        (overlapped, stalled)
+
+
+def test_shard_iterator_abandoned_consumer_reaps_pump():
+    """Breaking out of iter_batches early (max_steps) must not leak the
+    prefetch thread: even the terminal sentinel put yields to stop()."""
+    before = {t.name for t in threading.enumerate()}
+    it = ShardIterator(_SlowSource(4, 0.0), prefetch=1)
+    for _ in it.iter_batches(batch_size=8):
+        break  # abandon with the producer parked on a full queue
+    deadline = time.monotonic() + 6
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.name == "ingest-prefetch" and t.name not in before]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not [t for t in threading.enumerate()
+                if t.name == "ingest-prefetch"], "prefetch thread leaked"
+
+
+def test_shard_iterator_multi_epoch_and_pickle(ray_start_shared):
+    ds = rd.range(64, parallelism=4)
+    shard_a, shard_b = rd.DataIterator(ds).iter_shards(2, prefetch=2)
+    import cloudpickle
+
+    shard_b = cloudpickle.loads(cloudpickle.dumps(shard_b))  # ships to a worker
+    rows_a = [r["id"] for r in shard_a.iter_rows()]
+    rows_b = [r["id"] for r in shard_b.iter_rows()]
+    assert sorted(rows_a + rows_b) == list(range(64))
+    # Second epoch re-drives the shared execution.
+    rows_a2 = [r["id"] for r in shard_a.iter_rows()]
+    rows_b2 = [r["id"] for r in shard_b.iter_rows()]
+    assert sorted(rows_a2 + rows_b2) == list(range(64))
+    assert shard_a.ingest_stats()["epochs"] == 2
+
+
+def test_trainer_shards_report_ingest_stats(ray_start_shared, tmp_path):
+    """The trainer hands workers prefetching ShardIterators and
+    session.get_ingest_stats() surfaces the stall accounting."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.backend import JaxConfig
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        shard = session.get_dataset_shard("train")
+        seen = 0
+        for batch in shard.iter_batches(batch_size=8):
+            seen += len(batch["id"])
+        stats = session.get_ingest_stats()["train"]
+        session.report({"rows": seen, "steps": stats["steps"],
+                        "stall_ms": stats["stall_ms_total"],
+                        "stall_frac": stats["stall_frac"]})
+
+    result = JaxTrainer(
+        loop,
+        jax_config=JaxConfig(distributed=False),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ingest_stats", storage_path=str(tmp_path)),
+        datasets={"train": rd.range(64, parallelism=4)},
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["steps"] > 0
+    assert result.metrics["stall_frac"] <= 1.0
+
+
+# --------------------------------------------------------------------------- #
+# Stats collector boundedness
+# --------------------------------------------------------------------------- #
+
+
+def test_stats_collector_mailbox_bounded_and_prunes():
+    from ray_tpu.data.stats import _StatsCollector
+
+    c = _StatsCollector()
+    # Keyed state is capped: a sender inventing unbounded op names
+    # degrades to a drop counter, not unbounded actor memory.
+    for i in range(c.MAX_OP_ENTRIES + 50):
+        c.record([(0, f"op{i}", 0.001, 1)])
+    summary = c.summary()
+    assert len(summary["ops"]) == c.MAX_OP_ENTRIES
+    assert summary["dropped_records"] == 50
+    # Finished-op prune: per-window stage records fold into one rollup.
+    c2 = _StatsCollector()
+    for w in range(5):
+        c2.record_stage([(-2, f"ShuffleMap[window {w}]", 0.1, 10)])
+    assert len(c2.summary()["ops"]) == 5
+    c2.fold(-2, "ShuffleMap")
+    ops = c2.summary()["ops"]
+    assert len(ops) == 1
+    assert ops[0]["name"] == "ShuffleMap"
+    assert ops[0]["blocks"] == 5 and ops[0]["rows"] == 50
+    # record_stage never inflates the blocks_recorded flush barrier.
+    assert c2.summary()["blocks_recorded"] == 0
+
+
+def test_shuffle_stage_records_fold_into_rollup(ray_start_shared):
+    ctx = DataContext.get_current()
+    old = ctx.inflight_budget_bytes
+    try:
+        ctx.inflight_budget_bytes = 4096  # multiple windows
+        ds = rd.range(300, parallelism=6).random_shuffle(seed=4)
+        assert ds.count() == 300
+        stats = ds.stats()
+        assert stats is not None
+        names = [op["name"] for op in stats.ops]
+        assert "ShuffleMap" in names
+        assert "ShuffleReduce" in names
+        # Per-window records were pruned after the fold.
+        assert not any("window" in n for n in names), names
+    finally:
+        ctx.inflight_budget_bytes = old

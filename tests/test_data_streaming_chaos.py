@@ -1,0 +1,121 @@
+"""Streaming ingest plane under its own clusters: spill through a tiny
+arena, chaos node-kill recovery. Separate module: these tests build (and
+tear down) dedicated clusters and must not share a module-scoped one."""
+
+import gc
+import glob
+import os
+import time
+
+import pytest
+
+from ray_tpu import data as rd
+
+
+# --------------------------------------------------------------------------- #
+# Spill tier under a tiny arena
+# --------------------------------------------------------------------------- #
+
+
+def _shm_segments(session_suffix: str):
+    """Live (non-pool, non-staging) store segments of this session."""
+    return [p for p in glob.glob(f"/dev/shm/rtpu_{session_suffix}_*")
+            if "_pool" not in os.path.basename(p)]
+
+
+def test_full_shuffle_epoch_spills_not_oom():
+    """A shuffle whose working set exceeds a tiny store arena completes
+    via the spill tier: full epoch, rows exact, `num_unsealed == 0`, and
+    zero leaked segments after the refs drop."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, object_store_memory=3 * 1024 * 1024)
+    try:
+        node = ray_tpu._global_node
+        store = node.raylet.store
+        # Blocks must clear the inline threshold (100 KiB) or they never
+        # touch the store: 4 blocks x ~1 MiB of tensor rows, working set
+        # (inputs + buckets + outputs) ~3x the 3 MiB arena.
+        ds = rd.range_tensor(8000, shape=(16,), parallelism=4) \
+            .random_shuffle(seed=2)
+        total = 0
+        for batch in ds.iter_batches(batch_size=500):
+            total += len(batch["data"])
+        assert total == 8000
+        stats = store.stats()
+        assert stats["num_unsealed"] == 0, stats
+        # The arena could not have held the epoch: spill carried it.
+        assert stats["used_bytes"] <= store.capacity
+        # Drop the pipeline; every segment must drain (frees are batched
+        # on a 1s timer, so poll with a deadline).
+        del ds
+        gc.collect()
+        deadline = time.monotonic() + 15
+        session = node.session_suffix
+        while time.monotonic() < deadline:
+            if not _shm_segments(session) and \
+                    store.stats()["num_unsealed"] == 0:
+                break
+            time.sleep(0.2)
+        leaked = _shm_segments(session)
+        assert not leaked, f"leaked segments: {leaked}"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow  # multi-node cluster + recovery: >10s under load; the
+# gate's `bench.py --ingest-smoke` hard-gates the same scenario
+def test_node_death_mid_shuffle_recomputes_bounded():
+    """Chaos: kill a node mid-shuffle. The epoch completes, recomputed
+    blocks are bounded by the dead node's resident blocks (never a
+    whole-pipeline restart), and nothing hangs."""
+    import ray_tpu
+    from ray_tpu.chaos import HangWatchdog
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data.streaming.lineage import core_reconstructions
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for _ in range(2):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    cluster.connect()
+    try:
+        n_parts = 8
+        ds = rd.range_tensor(4000, shape=(40,), parallelism=n_parts) \
+            .random_shuffle(seed=9)
+        base = core_reconstructions()
+        rows = 0
+        killed = {}
+        with HangWatchdog(limit_s=60.0) as wd:
+            for i, batch in enumerate(ds.iter_batches(batch_size=250)):
+                rows += len(batch["data"])
+                if i == 1 and not killed:
+                    # Kill the worker node holding the most blocks so the
+                    # fault actually destroys state the pipeline needs.
+                    victim = max(
+                        (r for r in cluster.raylets if not r.is_head),
+                        key=lambda r: r.store.stats()["num_objects"])
+                    killed["resident"] = \
+                        victim.store.stats()["num_objects"]
+                    cluster.crash_node(victim)
+        wd.assert_no_hangs()
+        assert rows == 4000
+        recomputed = (core_reconstructions() - base) \
+            + ds._lineage.recomputed_blocks if ds._lineage else 0
+        total_blocks = killed["resident"] if killed else 0
+        # Bounded: no more re-executions than the victim held blocks
+        # (map buckets + reduce outputs), and certainly not a restart of
+        # every task in the pipeline.
+        assert recomputed <= max(total_blocks, 1) + n_parts, \
+            (recomputed, killed)
+        for raylet in cluster.raylets:
+            assert raylet.store.stats()["num_unsealed"] == 0
+    finally:
+        try:
+            cluster.shutdown()
+        except Exception:  # noqa: BLE001 — nodes already churned
+            pass
+
+

@@ -1388,3 +1388,124 @@ def test_rl012_suppression_with_reason(tmp_path):
         "self._leases[key] = lease  "
         "# raylint: disable=RL012 — entries rebuilt on every read")
     assert lint_src(tmp_path, src, rules=["RL012"]) == []
+
+
+# ------------------------------------------------------------------ RL013
+
+RL013_BAD_NO_BOUND = """
+    class WindowBuffer:
+        def __init__(self):
+            self._blocks = []
+
+        def on_block(self, block):
+            self._blocks.append(block)
+"""
+
+RL013_BAD_DICT_BUFFER = """
+    class PartitionAccumulator:
+        def __init__(self):
+            self._by_partition = {}
+
+        def scatter(self, part, block):
+            self._by_partition.setdefault(part, []).append(block)
+"""
+
+RL013_GOOD_BUDGET_ACQUIRE = """
+    class WindowBuffer:
+        def __init__(self, budget):
+            self._budget = budget
+            self._blocks = []
+
+        def on_block(self, block, nbytes):
+            self._budget.acquire("window", nbytes)
+            self._blocks.append(block)
+"""
+
+RL013_GOOD_BOUND_CHECK = """
+    class WindowBuffer:
+        def __init__(self):
+            self._blocks = []
+            self._max_buffered = 16
+
+        def on_block(self, block):
+            if len(self._blocks) >= self._max_buffered:
+                raise BufferError("window full")
+            self._blocks.append(block)
+"""
+
+RL013_GOOD_DRAIN = """
+    class WindowBuffer:
+        def __init__(self):
+            self._blocks = []
+
+        def on_block(self, block):
+            self._blocks.append(block)
+
+        def drain(self):
+            while self._blocks:
+                yield self._blocks.pop()
+"""
+
+RL013_GOOD_DEQUE_MAXLEN = """
+    from collections import deque
+
+    class WindowBuffer:
+        def __init__(self):
+            self._blocks = deque(maxlen=8)
+
+        def on_block(self, block):
+            self._blocks.append(block)
+"""
+
+
+def test_rl013_flags_unbounded_list_buffer(tmp_path):
+    findings = lint_src(tmp_path, RL013_BAD_NO_BOUND, rules=["RL013"])
+    assert rule_ids(findings) == ["RL013"]
+    assert "_blocks" in findings[0].message
+    assert "budget" in findings[0].message.lower()
+
+
+def test_rl013_flags_keyed_dict_buffer(tmp_path):
+    findings = lint_src(tmp_path, RL013_BAD_DICT_BUFFER, rules=["RL013"])
+    assert rule_ids(findings) == ["RL013"]
+    assert "_by_partition" in findings[0].message
+
+
+def test_rl013_quiet_with_budget_acquire(tmp_path):
+    assert lint_src(tmp_path, RL013_GOOD_BUDGET_ACQUIRE,
+                    rules=["RL013"]) == []
+
+
+def test_rl013_quiet_with_bound_check(tmp_path):
+    assert lint_src(tmp_path, RL013_GOOD_BOUND_CHECK,
+                    rules=["RL013"]) == []
+
+
+def test_rl013_quiet_with_drain_path(tmp_path):
+    assert lint_src(tmp_path, RL013_GOOD_DRAIN, rules=["RL013"]) == []
+
+
+def test_rl013_quiet_on_bounded_deque(tmp_path):
+    assert lint_src(tmp_path, RL013_GOOD_DEQUE_MAXLEN,
+                    rules=["RL013"]) == []
+
+
+def test_rl013_suppression_with_reason(tmp_path):
+    src = RL013_BAD_NO_BOUND.replace(
+        "self._blocks.append(block)",
+        "self._blocks.append(block)  "
+        "# raylint: disable=RL013 — producer enforces the window budget")
+    assert lint_src(tmp_path, src, rules=["RL013"]) == []
+
+
+def test_rl013_scoped_to_data_package(tmp_path):
+    # The same shape inside a ray_tpu control-plane package is RL011's
+    # business; RL013 only patrols the data plane (and fixtures).
+    pkg = tmp_path / "ray_tpu"
+    serve = pkg / "serve"
+    serve.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (serve / "__init__.py").write_text("")
+    mod = serve / "router.py"
+    mod.write_text(textwrap.dedent(RL013_BAD_NO_BOUND))
+    assert lint_file(str(mod), rule_ids=["RL013"]) == []
