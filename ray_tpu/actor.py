@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.core.common import TaskSpec, normalize_resources
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, TaskID
 from ray_tpu.object_ref import ObjectRef
 
@@ -193,7 +194,10 @@ class ActorClass:
             actor_id=actor_id,
             actor_creation=True,
             actor_class_blob=self._class_blob,
-            actor_max_restarts=opts.get("max_restarts", 0),
+            # Same contract as task_max_retries in remote_function.py:
+            # the declared knob is the default, options() overrides it.
+            actor_max_restarts=opts.get("max_restarts",
+                                        GLOBAL_CONFIG.actor_max_restarts),
             actor_max_concurrency=opts.get("max_concurrency", 1),
             actor_name=name,
             actor_namespace=namespace,

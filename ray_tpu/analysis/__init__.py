@@ -27,10 +27,15 @@ the rule catalog.
 
 from ray_tpu.analysis.engine import (  # noqa: F401
     Finding,
+    PROJECT_RULES,
     RULES,
     lint_paths,
+    lint_paths_full,
+    project_rule,
     rule,
 )
 from ray_tpu.analysis import rules as _rules  # noqa: F401  (registers rules)
+from ray_tpu.analysis import project as _project  # noqa: F401  (RL014-016)
 
-__all__ = ["Finding", "RULES", "lint_paths", "rule"]
+__all__ = ["Finding", "RULES", "PROJECT_RULES", "lint_paths",
+           "lint_paths_full", "rule", "project_rule"]

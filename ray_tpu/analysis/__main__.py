@@ -1,11 +1,22 @@
-"""CLI: ``python -m ray_tpu.analysis [paths] [--json] [--rules ...]``.
+"""CLI: ``python -m ray_tpu.analysis [paths] [--format ...] [--rules ...]``.
 
-Exit code 0 when no unsuppressed finding remains (the tier-1 contract:
-``python -m ray_tpu.analysis ray_tpu/`` must exit 0), 1 otherwise, 2 on
-usage errors.  ``--sleep-report`` is a side tool for the test-budget
-audit: it sums literal ``time.sleep`` seconds (times constant loop
-bounds) per test function so heavy tests can be found and marked
-``@pytest.mark.slow`` before they drift the tier-1 suite into its
+Exit-code contract (documented in docs/ANALYSIS.md, pinned by tests):
+
+- **0** — no unsuppressed finding (and, with
+  ``--report-unused-suppressions``, no stale suppression comment);
+- **1** — at least one unsuppressed finding (or stale suppression when
+  auditing them);
+- **2** — usage error (unknown rule id, missing path, bad flag combo).
+
+``--format {text,json,sarif}`` selects the findings encoding (``--json``
+stays as an alias for ``--format json``); SARIF 2.1.0 output lets CI
+attach findings as annotations.  ``--incremental`` caches per-file
+results under ``.raylint_cache/`` (content-hash keyed, cold-cache safe);
+``--timings`` prints a per-rule wall-time table to stderr so a slow rule
+is visible before it bloats the gate.  ``--sleep-report`` is a side tool
+for the test-budget audit: it sums literal ``time.sleep`` seconds (times
+constant loop bounds) per test function so heavy tests can be found and
+marked ``@pytest.mark.slow`` before they drift the tier-1 suite into its
 timeout.
 """
 
@@ -19,11 +30,14 @@ import sys
 from typing import List, Tuple
 
 from ray_tpu.analysis.engine import (
+    CACHE_DIR_DEFAULT,
+    PROJECT_RULES,
     RULES,
     FileContext,
+    all_rule_ids,
     dotted,
     iter_python_files,
-    lint_paths,
+    lint_paths_full,
 )
 
 
@@ -105,6 +119,44 @@ def sleep_report(paths: List[str]) -> List[Tuple[str, str, float]]:
 # ----------------------------------------------------------------- main
 
 
+def _sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 document: one run, one result per finding,
+    relative artifact URIs — the shape CI annotation uploaders accept."""
+    descs = dict(RULES)
+    descs.update(PROJECT_RULES)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raylint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": desc}}
+                          for rid, (_fn, desc) in sorted(descs.items())],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                        "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line},
+                }}],
+            } for f in findings],
+        }],
+    }
+
+
+def _print_timings(timings) -> None:
+    total = sum(timings.values())
+    print(f"raylint timings ({total * 1000:.0f}ms total):", file=sys.stderr)
+    for rid, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+        print(f"  {rid:<8} {secs * 1000:8.1f}ms", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.analysis",
@@ -113,20 +165,40 @@ def main(argv=None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the ray_tpu "
                              "package)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text", dest="fmt",
+                        help="findings encoding on stdout")
     parser.add_argument("--json", action="store_true",
-                        help="machine-readable findings on stdout")
+                        help="alias for --format json")
     parser.add_argument("--rules",
-                        help="comma-separated subset, e.g. RL001,RL002")
+                        help="comma-separated subset, e.g. RL001,RL014")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--incremental", action="store_true",
+                        help="cache per-file results keyed by content hash "
+                             "(cold-cache safe); project rules re-run over "
+                             "the cached index every time")
+    parser.add_argument("--cache-dir", default=CACHE_DIR_DEFAULT,
+                        help="incremental cache location "
+                             f"(default: {CACHE_DIR_DEFAULT})")
+    parser.add_argument("--timings", action="store_true",
+                        help="per-rule wall time on stderr")
+    parser.add_argument("--report-unused-suppressions", action="store_true",
+                        help="also report `# raylint: disable=...` comments "
+                             "whose rule no longer fires there (full rule "
+                             "set only: incompatible with --rules)")
     parser.add_argument("--sleep-report", action="store_true",
                         help="per-function aggregate literal sleep seconds "
                              "(test-budget audit), instead of linting")
     parser.add_argument("--sleep-threshold", type=float, default=0.0,
                         help="only report functions above this many seconds")
     args = parser.parse_args(argv)
+    if args.json:
+        args.fmt = "json"
 
     if args.list_rules:
-        for rid, (_fn, desc) in sorted(RULES.items()):
+        descs = dict(RULES)
+        descs.update(PROJECT_RULES)
+        for rid, (_fn, desc) in sorted(descs.items()):
             print(f"{rid}  {desc}")
         return 0
 
@@ -135,7 +207,7 @@ def main(argv=None) -> int:
     if args.sleep_report:
         rows = [r for r in sleep_report(paths)
                 if r[2] >= args.sleep_threshold]
-        if args.json:
+        if args.fmt == "json":
             print(json.dumps([{"path": p, "function": fn, "sleep_s": s}
                               for p, fn, s in rows], indent=2))
         else:
@@ -146,25 +218,48 @@ def main(argv=None) -> int:
     rule_ids = None
     if args.rules:
         rule_ids = [r.strip().upper() for r in args.rules.split(",")]
-        unknown = [r for r in rule_ids if r not in RULES]
+        unknown = [r for r in rule_ids if r not in all_rule_ids()]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
+        if args.report_unused_suppressions:
+            print("--report-unused-suppressions needs the full rule set "
+                  "(a suppression for an unselected rule never gets the "
+                  "chance to match); drop --rules", file=sys.stderr)
+            return 2
 
     try:
-        findings = lint_paths(paths, rule_ids)
+        result = lint_paths_full(paths, rule_ids,
+                                 incremental=args.incremental,
+                                 cache_dir=args.cache_dir)
     except FileNotFoundError as e:
         print(f"no such path: {e}", file=sys.stderr)
         return 2
 
-    if args.json:
+    findings = result.findings
+    if args.fmt == "json":
         print(json.dumps([f.as_dict() for f in findings], indent=2))
+    elif args.fmt == "sarif":
+        print(json.dumps(_sarif(findings), indent=2))
     else:
         for f in findings:
             print(f.render())
         if findings:
             print(f"raylint: {len(findings)} finding(s)", file=sys.stderr)
-    return 1 if findings else 0
+
+    unused = result.unused_suppressions if args.report_unused_suppressions \
+        else []
+    for u in unused:
+        print(f"{u.path}:{u.line}: unused suppression of {u.rule} — the "
+              "rule no longer fires here; drop the comment",
+              file=sys.stderr)
+
+    if args.incremental:
+        print(f"raylint cache: {result.cache_hits} unchanged, "
+              f"{result.cache_misses} analyzed", file=sys.stderr)
+    if args.timings:
+        _print_timings(result.timings)
+    return 1 if (findings or unused) else 0
 
 
 if __name__ == "__main__":

@@ -28,6 +28,16 @@ for the catalog with real before/after examples):
                                   blocks into list/dict attributes show
                                   a budget/bound check or a drain path
                                   (the sustained-ingest OOM shape)
+- RL017 deferred-reply-completeness — the interprocedural upgrade of
+                                  RL001: a DEFERRED handler that hands
+                                  (conn, msg_id) to a helper is traced
+                                  one call hop to prove the helper
+                                  replies, parks, or hands off on every
+                                  path
+
+(RL014 rpc-contract, RL015 config-knob-drift and RL016
+loop-confined-escape are whole-program rules — they live in
+:mod:`ray_tpu.analysis.project` on top of the ProjectGraph.)
 """
 
 from __future__ import annotations
@@ -185,21 +195,34 @@ def _msgid_vars(fn: ast.AST) -> Set[str]:
     return out
 
 
+def _mentions_msgid(node: ast.AST, msgid_vars: Set[str]) -> bool:
+    """A bare msg-id name, or `conn.current_msg_id` used inline (the
+    one-liner park idiom: ``waiters.append((conn, conn.current_msg_id))``
+    never binds a local)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in msgid_vars:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "current_msg_id":
+            return True
+    return False
+
+
 def _registration_line(fn: ast.AST, msgid_vars: Set[str]) -> Optional[int]:
     """Line of the first statement that stores a msg-id var into a waiter
     structure (an .append/.add call or a subscript/attribute store whose
     value mentions the var) — after this the reply is co-owned by the
-    drain path."""
+    drain path.  The park call is matched by its attribute name so
+    subscripted receivers (``slot["waiters"].append(...)``, which have
+    no dotted name) count too."""
     for stmt in fn.body and statements(fn.body):
         if isinstance(stmt, _FUNC_NODES):
             continue
-        mentions = any(isinstance(n, ast.Name) and n.id in msgid_vars
-                       for n in ast.walk(stmt))
-        if not mentions:
+        if not _mentions_msgid(stmt, msgid_vars):
             continue
         if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
-            if last_segment(dotted(stmt.value.func)) in ("append", "add",
-                                                         "put", "setdefault"):
+            func = stmt.value.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in ("append", "add", "put", "setdefault"):
                 return stmt.lineno
         if isinstance(stmt, ast.Assign) and any(
                 isinstance(t, (ast.Subscript, ast.Attribute))
@@ -1665,3 +1688,205 @@ def rl013_unbounded_block_buffer(ctx: FileContext) -> Iterable[Finding]:
                 "sustained ingest this buffer IS the working set and OOMs "
                 "the node; acquire from the pipeline ByteBudget, bound "
                 "it, or drain it (or annotate where the bound lives)")
+
+
+# =====================================================================
+# RL017 deferred-reply-completeness
+# =====================================================================
+#
+# RL001's two checks are intraprocedural: they see completion closures
+# nested INSIDE the DEFERRED handler.  The shape that grew as handlers
+# matured is delegation — the handler parks nothing itself and instead
+# hands (conn, msg_id) to a helper (`self._start_pull(conn, mid, ...)`)
+# that owns the completion.  RL001 never looks inside the helper, so a
+# helper that can raise before replying (or that simply never replies)
+# ships unchecked and the parked caller hangs to its client timeout.
+# This rule traces ONE call hop:
+#
+#  - a DEFERRED handler with no local completion evidence (no nested
+#    reply closure, no waiter-structure park, no direct reply) must
+#    delegate — each resolvable delegate (same-class method or
+#    same-module function receiving the conn/msg-id) is analyzed:
+#      * no reply, no park, no further handoff anywhere -> finding
+#        (the reply obligation evaporated inside the helper);
+#      * completion closures nested in the delegate get RL001's
+#        guardedness check (an unguarded one hangs the caller exactly
+#        like an unguarded closure in the handler itself);
+#  - a DEFERRED handler with NO completion evidence and NO delegation
+#    at all is flagged: nothing visible can ever answer the caller.
+#
+# Delegates that hand the ids onward (a second hop) or park them into a
+# structure are trusted — one hop is the contract; deeper chains carry
+# a `# raylint: disable=RL017 — <who replies>` at the delegation site.
+
+_RL017_PARK_CALLS = {"append", "add", "put", "setdefault", "park",
+                     "register"}
+
+
+def _rl017_conn_params(fn: ast.AST) -> Set[str]:
+    names = [a.arg for a in fn.args.args]
+    return {n for n in names
+            if n in ("conn", "connection") or n.endswith("_conn")}
+
+
+def _rl017_mentions(node: ast.Call, names: Set[str]) -> bool:
+    for a in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(a):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return True
+    return False
+
+
+def _rl017_delegations(fn: ast.AST, tracked: Set[str]) -> List[ast.Call]:
+    out: List[ast.Call] = []
+    for sub in walk_excluding_nested_functions(fn):
+        if not isinstance(sub, ast.Call) or not _rl017_mentions(sub, tracked):
+            continue
+        if _is_reply_call(sub, set()):
+            continue
+        seg = last_segment(dotted(sub.func)) or (
+            sub.func.attr if isinstance(sub.func, ast.Attribute) else "")
+        if seg in _RL017_PARK_CALLS:
+            continue  # parking into a waiter structure: the drain owns it
+        out.append(sub)
+    return out
+
+
+def _rl017_resolve(ctx: FileContext, call: ast.Call,
+                   fn: ast.AST) -> Optional[ast.AST]:
+    """The delegate's def when it lives in this file: `self.x(...)` in
+    the enclosing class, or a bare-name module-level function."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        cls = ctx.enclosing_class(fn)
+        if cls is not None:
+            for n in cls.body:
+                if isinstance(n, _FUNC_NODES) and n.name == f.attr:
+                    return n
+        return None
+    if isinstance(f, ast.Name):
+        for n in ctx.tree.body:
+            if isinstance(n, _FUNC_NODES) and n.name == f.id:
+                return n
+    return None
+
+
+def _rl017_received_params(call: ast.Call, delegate: ast.AST,
+                           conn_vars: Set[str],
+                           msgid_vars: Set[str]) -> Tuple[Set[str],
+                                                          Set[str]]:
+    """Map the delegation call's arguments onto the delegate's parameter
+    names: which params received the connection, which the msg id."""
+    params = [a.arg for a in delegate.args.args]
+    if params and params[0] in ("self", "cls") and \
+            isinstance(call.func, ast.Attribute):
+        params = params[1:]
+    conn_received: Set[str] = set()
+    msgid_received: Set[str] = set()
+
+    def classify(arg: ast.AST, pname: str) -> None:
+        if isinstance(arg, ast.Name) and arg.id in conn_vars:
+            conn_received.add(pname)
+        elif _mentions_msgid(arg, msgid_vars):
+            msgid_received.add(pname)
+
+    for i, arg in enumerate(call.args):
+        if i < len(params):
+            classify(arg, params[i])
+    for kw in call.keywords:
+        if kw.arg:
+            classify(kw.value, kw.arg)
+    return conn_received, msgid_received
+
+
+def _rl017_delegate_evidence(delegate: ast.AST, conn_params: Set[str],
+                             msgid_params: Set[str]
+                             ) -> Tuple[str, Set[str], List[ast.AST]]:
+    """(kind, reply_fn_names, nested) where kind is 'reply' | 'park' |
+    'handoff' | 'none' — the strongest completion evidence found
+    anywhere in the delegate (nested closures included).  A handoff must
+    move the CONNECTION onward: a call that only mentions the msg id
+    (logging, bookkeeping) cannot complete the reply."""
+    nested = _nested_functions(delegate)
+    reply_fns = _reply_fn_fixpoint(nested)
+    received = conn_params | msgid_params
+    kind = "none"
+    for sub in ast.walk(delegate):
+        if isinstance(sub, ast.Call):
+            if _is_reply_call(sub, reply_fns):
+                return "reply", reply_fns, nested
+            seg = last_segment(dotted(sub.func)) or (
+                sub.func.attr if isinstance(sub.func, ast.Attribute)
+                else "")
+            if seg in _RL017_PARK_CALLS and _rl017_mentions(sub, received):
+                kind = "park"
+            elif kind == "none" and _rl017_mentions(sub, conn_params):
+                kind = "handoff"
+        elif isinstance(sub, ast.Assign) and any(
+                isinstance(t, (ast.Subscript, ast.Attribute))
+                for t in sub.targets):
+            if any(isinstance(n, ast.Name) and n.id in received
+                   for n in ast.walk(sub.value)):
+                kind = "park"
+    return kind, reply_fns, nested
+
+
+@rule("RL017", "deferred-reply-completeness: a DEFERRED handler's "
+               "delegated completion helper must reply, park, or hand "
+               "off on every path")
+def rl017_deferred_reply_completeness(ctx: FileContext
+                                      ) -> Iterable[Finding]:
+    for fn in _functions(ctx):
+        if ctx.enclosing_function(fn) is not None:
+            continue
+        if _returns_deferred(fn) is None:
+            continue
+        nested = _nested_functions(fn)
+        local_replies = bool(_reply_fn_fixpoint(nested))
+        if not local_replies:
+            for sub in walk_excluding_nested_functions(fn):
+                if isinstance(sub, ast.Call) and _is_reply_call(sub, set()):
+                    local_replies = True
+                    break
+        tracked = _msgid_vars(fn) | _rl017_conn_params(fn)
+        parked = _registration_line(fn, tracked) is not None
+        delegations = _rl017_delegations(fn, tracked)
+        if local_replies or parked:
+            continue  # RL001's jurisdiction: completion is local
+        if not delegations:
+            yield ctx.finding(
+                fn, "RL017",
+                f"'{fn.name}' returns DEFERRED but nothing visible can "
+                "complete the reply: no reply call, no waiter park, and "
+                "the conn/msg id are never handed to a helper — the "
+                "caller hangs to its client timeout on every request")
+            continue
+        conn_vars = _rl017_conn_params(fn)
+        for call in delegations:
+            delegate = _rl017_resolve(ctx, call, fn)
+            if delegate is None:
+                continue  # unresolvable receiver: treated as a handoff
+            conn_p, msgid_p = _rl017_received_params(
+                call, delegate, conn_vars, _msgid_vars(fn))
+            kind, reply_fns, dnested = _rl017_delegate_evidence(
+                delegate, conn_p, msgid_p)
+            if kind == "none":
+                yield ctx.finding(
+                    call, "RL017",
+                    f"'{fn.name}' returns DEFERRED and delegates "
+                    f"completion to '{delegate.name}', which neither "
+                    "replies, parks the caller, nor hands the ids "
+                    "onward — the parked caller can never be answered")
+            elif kind == "reply":
+                for nf in dnested:
+                    if nf.name in reply_fns and \
+                            not _completion_guarded(nf, reply_fns):
+                        yield ctx.finding(
+                            nf, "RL017",
+                            f"completion path '{nf.name}' in "
+                            f"'{delegate.name}' (delegated from DEFERRED "
+                            f"handler '{fn.name}') can raise before "
+                            "replying — the parked caller would hang; "
+                            "wrap it so every exception path also "
+                            "replies")

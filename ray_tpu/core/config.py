@@ -53,7 +53,6 @@ _flag("health_check_failure_threshold", int, 5, "Missed health checks before a n
 _flag("worker_lease_timeout_ms", int, 60000,
       "Max time waiting for a worker lease (covers a cold worker spawn: "
       "a fresh interpreter importing jax can take >30s on a loaded host)")
-_flag("worker_pool_prestart", int, 0, "Number of workers to prestart per node")
 _flag("worker_forge_enabled", _parse_bool, True,
       "Per-node forkserver template ('worker forge'): a process that "
       "preimports the worker module set once and fork()s fully-imported "
@@ -66,17 +65,13 @@ _flag("worker_forge_preimports", str, "ray_tpu.core.worker,numpy",
       "backend client at import time (the forge refuses to fork "
       "otherwise). Add 'jax' when workers are jax-heavy and its import "
       "is known thread-free in your build")
-_flag("worker_idle_timeout_ms", int, 60000, "Idle worker reap timeout")
-_flag("max_pending_lease_requests", int, 10, "In-flight lease requests per scheduling key")
 _flag("object_inline_max_bytes", int, 100 * 1024, "Objects at or below this size travel inline through the control plane")
 _flag("object_store_memory_bytes", int, 0, "Shared-memory store capacity; 0 = auto (30% of system RAM)")
 _flag("segment_pool_max_bytes", int, 256 * 1024 * 1024,
       "Warm shm segments recycled across puts (0 disables); see SegmentPool")
-_flag("object_spill_threshold", float, 0.8, "Store fullness fraction that triggers spilling")
 _flag("object_spill_dir", str, "", "Directory for spilled objects; empty = <session>/spill")
 _flag("task_max_retries", int, 3, "Default retries for normal tasks")
 _flag("actor_max_restarts", int, 0, "Default actor restarts")
-_flag("scheduler_top_k_fraction", float, 0.2, "Hybrid policy: random choice among top-k fraction of nodes")
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: utilization below which packing is preferred")
 _flag("rpc_connect_timeout_s", float, 10.0, "TCP connect timeout for internal RPC")
 _flag("rpc_call_timeout_s", float, 120.0, "Default RPC call timeout")
@@ -136,8 +131,6 @@ _flag("resource_broadcast_min_interval_ms", int, 100,
       "converge. 0 broadcasts every time (pre-batching behavior). At "
       "100 nodes x 1 heartbeat/s, unthrottled full-view fanout is "
       "10k pickles/s of a 100-entry dict — pure control-plane burn")
-_flag("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for pubsub subscribers")
-_flag("event_stats", bool, False, "Record per-handler event loop stats")
 _flag("task_events_max_buffer", int, 100000, "Max task events retained by the GCS task manager")
 _flag("memory_usage_threshold", float, 0.95,
       "Node memory fraction above which the OOM killer sheds workers")
@@ -240,11 +233,6 @@ _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head nod
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")
 _flag("enable_client_server", bool, True, "Start the ray:// client proxy on the head node")
 
-# --- TPU / JAX specifics ----------------------------------------------------
-_flag("tpu_chips_per_host", int, 4, "Default chips per TPU host when not detected")
-_flag("jax_coordinator_port", int, 0, "Port for jax.distributed coordinator; 0 = auto")
-_flag("mesh_default_axes", str, "dp,fsdp,tp", "Default logical mesh axis order")
-
 
 class RayTpuConfig:
     """Process-wide config instance; values resolved lazily from env.
@@ -302,8 +290,10 @@ class RayTpuConfig:
                 raise ValueError(f"Unknown system config key: {k}")
             flag = _FLAG_TABLE[k]
             # Keys were validated against _FLAG_TABLE above: the key
-            # space is the fixed flag set, it cannot grow.
-            # raylint: disable=RL011 — bounded by _FLAG_TABLE
+            # space is the fixed flag set, it cannot grow. (RL011 cannot
+            # even see _overrides — it is born via object.__setattr__ —
+            # so no suppression is needed; the unused-suppression audit
+            # retired the one that used to sit here.)
             self._overrides[k] = _parse_bool(v) if flag.type is bool else flag.type(v)
 
     def to_env(self) -> Dict[str, str]:

@@ -95,10 +95,6 @@ class Pubsub:
         with self._lock:
             self._subs[(channel, key)].add(conn)
 
-    def unsubscribe(self, conn: Connection, channel: str, key: bytes):
-        with self._lock:
-            self._subs[(channel, key)].discard(conn)
-
     def drop_connection(self, conn: Connection):
         with self._lock:
             for subs in self._subs.values():
@@ -778,10 +774,6 @@ class GcsServer:
 
     def handle_subscribe(self, conn: Connection, data: Dict[str, Any]):
         self.pubsub.subscribe(conn, data["channel"], data.get("key", b"*"))
-        return {}
-
-    def handle_unsubscribe(self, conn: Connection, data: Dict[str, Any]):
-        self.pubsub.unsubscribe(conn, data["channel"], data.get("key", b"*"))
         return {}
 
     def handle_publish(self, conn: Connection, data: Dict[str, Any]):
@@ -1494,7 +1486,9 @@ class GcsServer:
                 return base_utilization(n) + \
                     0.1 * self._inflight_creates.get(n.node_id, 0)
 
-            packable = [n for n in candidates if utilization(n) < 0.5]
+            packable = [n for n in candidates
+                        if utilization(n)
+                        < GLOBAL_CONFIG.scheduler_spread_threshold]
             if packable:
                 # Rank by RESOURCE utilization MINUS an in-flight-create
                 # penalty. Counting inflight positively (as the threshold
@@ -1971,10 +1965,6 @@ class GcsServer:
                 for r, v in n.resources_available.items():
                     avail[r] += v
         return {"total": dict(totals), "available": dict(avail)}
-
-    def handle_ping(self, conn: Connection, data=None):
-        return {"ok": True, "time": time.time()}
-
 
 def main():  # standalone GCS for multi-host deployments
     import argparse

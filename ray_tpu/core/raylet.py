@@ -2693,6 +2693,7 @@ class Raylet:
         finally:
             self.store.unpin(oid)
 
+    # raylint: disable=RL014 — kept for debug tooling / mixed-version peers
     def handle_pull_object(self, conn: Connection, data: Dict[str, Any]):
         """Legacy pickled transfer surface: one chunk (or, without offset,
         the whole object). The pipelined puller speaks the raw
@@ -2860,9 +2861,6 @@ class Raylet:
         for oid in data["object_ids"]:
             self.store.delete(oid, skip_unlink=oid.binary() in skip)
         return {}
-
-    def handle_contains_object(self, conn: Connection, data: Dict[str, Any]):
-        return {"contains": self.store.contains(data["object_id"])}
 
     def handle_set_resource(self, conn: Connection, data: Dict[str, Any]):
         """Dynamic custom resources (reference

@@ -66,7 +66,6 @@ class WorkerRuntime(CoreRuntime):
         self.direct_server.register("cancel_direct", self._handle_cancel_direct)
         self.direct_server.register("cancel_actor_task",
                                     self._handle_cancel_actor_task)
-        self.direct_server.register("ping", lambda conn, data: {"ok": True})
         self.direct_server.start()
         self._cancelled_direct: set = set()
         # Direct-result coalescing: completed lease-task results buffered

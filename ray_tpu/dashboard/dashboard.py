@@ -134,6 +134,10 @@ class DashboardServer:
             text = gcs.call("metrics_prometheus")["text"]
             self._send(req, 200, text.encode(),
                        "text/plain; version=0.0.4")
+        elif path == "/api/metrics":
+            # Same series as /metrics, structured: the programmatic twin
+            # of the Prometheus text surface.
+            self._json(req, gcs.call("metrics_snapshot"))
         elif path == "/api/nodes":
             self._json(req, gcs.call("get_nodes"))
         elif path == "/api/actors":

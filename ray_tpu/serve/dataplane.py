@@ -186,12 +186,15 @@ class FastLane:
     def __init__(self, router, runtime):
         self._router = router
         self._runtime = runtime
-        self._channels: Dict[str, _Channel] = {}
+        # Per-replica send channels mutate without locks: every dispatch,
+        # flush and prune runs on the proxy's event loop (RPC completions
+        # marshal back via call_soon_threadsafe). RL016-checked.
+        self._channels: Dict[str, _Channel] = {}  # raylint: confine=loop
         self._version = -2  # != router's initial -1: prune on first use
         # Scale-to-zero buffer accounting, per deployment: one parked
         # deployment's cold-start backlog must not 503 another's first
         # request.
-        self._park_bytes: Dict[str, int] = {}
+        self._park_bytes: Dict[str, int] = {}  # raylint: confine=loop
         # Multi-tenant QoS (docs/MULTITENANCY.md): per-tenant token
         # buckets + in-flight caps off the table-pushed QoS, and the
         # weighted fair queue that orders waiters under contention.

@@ -34,6 +34,21 @@ def list_jobs() -> List[Dict[str, Any]]:
     return _gcs().call("get_jobs")
 
 
+def list_named_actors(namespace: Optional[str] = None,
+                      all_namespaces: bool = False) -> List[Dict[str, Any]]:
+    """Registered actor names as [{"namespace", "name"}, ...] — the
+    reference's `ray.util.list_named_actors`. With `namespace` omitted
+    it lists the CURRENT runtime namespace, matching get_actor's
+    resolution — not the GCS's literal "default"."""
+    import ray_tpu
+
+    if namespace is None:
+        namespace = ray_tpu._require_runtime().namespace
+    req: Dict[str, Any] = {"all_namespaces": all_namespaces,
+                           "namespace": namespace}
+    return _gcs().call("list_named_actors", req)["names"]
+
+
 def list_placement_groups() -> List[Dict[str, Any]]:
     # PGs are published per-id; enumerate via the GCS table dump.
     import ray_tpu

@@ -205,14 +205,25 @@ def start_gateway(host: str = "127.0.0.1", port: int = 0) -> str:
             return _server.address
         gw = XlangGateway(runtime)
         server = RpcServer(host=host, port=port, name="xlang-gateway")
-        server.register_raw("xlang_ping", gw.ping)
-        server.register_raw("xlang_kv_put", gw.kv_put)
-        server.register_raw("xlang_kv_get", gw.kv_get)
-        server.register_raw("xlang_put", gw.put)
-        server.register_raw("xlang_free", gw.free)
-        server.register_raw("xlang_get", gw.get)
-        server.register_raw("xlang_call", gw.call)
-        server.register_raw("xlang_actor_call", gw.actor_call)
+        # Callers are out-of-tree non-Python clients (cpp/): RL014's
+        # reference scan cannot see them, so each registration carries
+        # the dead-endpoint waiver explicitly.
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_ping", gw.ping)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_kv_put", gw.kv_put)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_kv_get", gw.kv_get)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_put", gw.put)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_free", gw.free)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_get", gw.get)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_call", gw.call)
+        server.register_raw(  # raylint: disable=RL014 — cpp client
+            "xlang_actor_call", gw.actor_call)
         server.start()
         _server = server
     try:

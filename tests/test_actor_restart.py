@@ -39,6 +39,72 @@ def test_actor_restart(ray_start_regular):
     assert pid2 is not None and pid2 != pid1
 
 
+def test_actor_max_restarts_config_default(ray_start_regular):
+    """Regression for the RL015 knob-drift pass: the declared
+    `actor_max_restarts` knob is the default an actor WITHOUT an
+    explicit max_restarts option gets (same contract task_max_retries
+    already had for tasks)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.actor_max_restarts = 1
+    try:
+        @ray_tpu.remote
+        class Phoenix:
+            def pid(self):
+                import os
+
+                return os.getpid()
+
+            def die(self):
+                import os
+
+                os._exit(1)
+
+        p = Phoenix.remote()
+        pid1 = ray_tpu.get(p.pid.remote())
+        try:
+            ray_tpu.get(p.die.remote())
+        except Exception:
+            pass
+        deadline = time.time() + 30
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                pid2 = ray_tpu.get(p.pid.remote())
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert pid2 is not None and pid2 != pid1, \
+            "knob-derived max_restarts did not restart the actor"
+    finally:
+        GLOBAL_CONFIG._overrides.pop("actor_max_restarts", None)
+
+
+def test_list_named_actors_uses_runtime_namespace(ray_start_regular):
+    """state.list_named_actors() with no namespace must list the CURRENT
+    runtime namespace (get_actor's resolution), not the GCS literal
+    "default"."""
+    from ray_tpu import state
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, namespace="ns1")
+    try:
+        @ray_tpu.remote
+        class Holder:
+            def ok(self):
+                return True
+
+        h = Holder.options(name="ns_holder").remote()
+        assert ray_tpu.get(h.ok.remote())
+        assert "ns_holder" in {e["name"] for e in state.list_named_actors()}
+        assert state.list_named_actors(namespace="default") == []
+        every = {(e["namespace"], e["name"])
+                 for e in state.list_named_actors(all_namespaces=True)}
+        assert ("ns1", "ns_holder") in every
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_actor_restart_during_inflight_call(ray_start_regular):
     """Kill the actor's worker process while a call is EXECUTING: the
     caller must see ActorDiedError (or a successful retry) within a

@@ -76,6 +76,18 @@ def test_named_actor(ray_start_shared):
         ray_tpu.get_actor("no_such_actor")
 
 
+def test_list_named_actors(ray_start_shared):
+    # Regression for the RL014 pass: the GCS `list_named_actors`
+    # endpoint now has a real consumer (ray_tpu.state).
+    from ray_tpu import state
+
+    Counter.options(name="counter_lna").remote(0)
+    names = {e["name"] for e in state.list_named_actors()}
+    assert "counter_lna" in names
+    every = state.list_named_actors(all_namespaces=True)
+    assert {"namespace", "name"} <= set(every[0])
+
+
 def test_get_if_exists(ray_start_shared):
     a = Counter.options(name="gie", get_if_exists=True).remote(1)
     b = Counter.options(name="gie", get_if_exists=True).remote(1)

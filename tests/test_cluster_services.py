@@ -387,6 +387,12 @@ def test_dashboard_routes(ray_start_regular):
     with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
         text = r.read().decode()
     assert "dash_test_gauge" in text
+    # Structured twin of /metrics (the GCS metrics_snapshot endpoint's
+    # consumer, wired by the RL014 dead-endpoint pass).
+    with urllib.request.urlopen(url + "/api/metrics", timeout=10) as r:
+        snap = json.loads(r.read())
+    assert any(m["name"] == "dash_test_gauge"
+               for series in snap.values() for m in series)
     with urllib.request.urlopen(url, timeout=10) as r:
         html = r.read().decode()
     assert "ray_tpu cluster" in html

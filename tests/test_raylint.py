@@ -795,10 +795,17 @@ def test_sleep_report_counts_nonliteral_loop_bounds_once(tmp_path):
 
 def test_every_rule_has_fixture_coverage():
     # Engine-level guard: a new rule must come with fixture tests. This
-    # module names every rule id in some RLxxx fixture constant/test.
-    with open(os.path.abspath(__file__), "r", encoding="utf-8") as f:
-        body = f.read()
-    for rid in RULES:
+    # module (or the project-rule suite next door) names every rule id
+    # in some RLxxx fixture constant/test.
+    from ray_tpu.analysis import PROJECT_RULES
+
+    body = ""
+    for fname in (os.path.abspath(__file__),
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "test_raylint_project.py")):
+        with open(fname, "r", encoding="utf-8") as f:
+            body += f.read()
+    for rid in list(RULES) + list(PROJECT_RULES):
         assert rid in body, f"rule {rid} has no fixture test here"
 
 
