@@ -2086,10 +2086,166 @@ def _inference_poisson_run(scheduling: str, quick: bool, model=None,
     }
 
 
-def bench_inference(quick: bool) -> dict:
-    """Continuous batching vs the static request-batch baseline under
-    Poisson arrivals with mixed output lengths (acceptance: continuous
-    wins aggregate tokens/s AND p99 TTFT, zero leaks, zero recompiles)."""
+def _inference_multitenant_run(prefix_cache: bool, quick: bool, model=None,
+                               params=None, seed: int = 0) -> dict:
+    """Shared-prefix multi-tenant Poisson trace: three tenants, each
+    with a 24-token system prefix shared by every one of its requests,
+    mixed interactive/batch SLO classes (one reserved interactive
+    slot). Run twice — prefix cache off, then on — over the SAME seeded
+    trace: the delta is pure radix-cache effect (hit rate, tokens/s,
+    per-class TTFT), with the compile-once and zero-leak invariants
+    checked on both sides."""
+    import random as _random
+    import threading as _threading
+
+    from ray_tpu.inference import EngineConfig, EngineLoop, InferenceEngine
+
+    rng = _random.Random(seed)
+    n = 18 if quick else 48
+    # Arrivals outpace prefill on purpose: a 96-token tenant prefix is
+    # 6 prefill chunks of work per request, so the uncached arm is
+    # prefill-bound and a queue builds — that is where both the cache
+    # (skip 6 chunks on a hit) and the SLO classes (admission order
+    # under backlog) become visible in end-to-end numbers.
+    rate = 300.0
+    prefixes = [[rng.randrange(1, 500) for _ in range(96)]
+                for _ in range(3)]
+    reqspec, t = [], 0.0
+    for i in range(n):
+        t += rng.expovariate(rate)
+        suffix = [rng.randrange(1, 500)
+                  for _ in range(rng.randrange(4, 13))]
+        # Bulk batch-class traffic with an interactive sprinkle (the
+        # first two requests force one of each so the percentiles are
+        # always defined on a quick trace).
+        slo = ("interactive" if i == 0
+               else "batch" if i == 1
+               else "interactive" if rng.random() < 0.3 else "batch")
+        reqspec.append((t, prefixes[rng.randrange(3)] + suffix,
+                        rng.choice([4, 8]), slo))
+
+    cfg = EngineConfig(batch_slots=4, block_size=16, num_blocks=64,
+                       max_blocks_per_seq=8, prefill_chunk=16,
+                       prefix_cache_enabled=prefix_cache,
+                       slo_interactive_reserved_slots=1)
+    engine = InferenceEngine(cfg, model=model, params=params)
+    # Warm both step programs off the clock; both arms start cache-cold.
+    engine.add_request([1, 2, 3], 2, request_id="warmup")
+    engine.run_until_idle()
+    engine.drop_prefix_cache()
+    loop = EngineLoop(engine)
+    done = _threading.Event()
+    remaining = [n]
+    lock = _threading.Lock()
+
+    def on_finish(_req):
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done.set()
+
+    reqs = []
+    t0 = time.monotonic()
+    try:
+        for i, (at, prompt, budget, slo) in enumerate(reqspec):
+            delay = (t0 + at) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(loop.submit(prompt, budget, on_finish=on_finish,
+                                    request_id=f"mt{i}", slo_class=slo))
+        if not done.wait(timeout=600):
+            raise TimeoutError(f"{remaining[0]} multi-tenant requests "
+                               f"unfinished (prefix_cache={prefix_cache})")
+    finally:
+        loop.stop()
+
+    def pct_ms(vals, p):
+        vals = sorted(vals)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(p * len(vals)))] * 1e3
+
+    makespan = max(r.finished_at for r in reqs) - t0
+    ttft = {cls: [r.first_token_at - r.submitted_at for r in reqs
+                  if r.slo_class == cls]
+            for cls in ("interactive", "batch")}
+    stats = engine.stats()
+    engine.check_no_leaks()
+    engine.drop_prefix_cache()
+    pc = stats["prefix_cache"]
+    return {
+        "requests": n,
+        "tokens_per_sec": sum(len(r.generated) for r in reqs) / makespan,
+        "ttft_interactive_p50_ms": pct_ms(ttft["interactive"], 0.50),
+        "ttft_interactive_p99_ms": pct_ms(ttft["interactive"], 0.99),
+        "ttft_batch_p50_ms": pct_ms(ttft["batch"], 0.50),
+        "ttft_batch_p99_ms": pct_ms(ttft["batch"], 0.99),
+        "prefix_hit_rate": round(pc.get("hit_rate", 0.0), 3),
+        "prefix_hit_tokens": pc.get("hit_tokens", 0),
+        "cached_tokens": sum(r.cached_tokens for r in reqs),
+        "preemptions": stats["preemptions"],
+        "leaked_blocks": engine.stats()["kv"]["blocks_in_use"],
+        "decode_recompiles": max(0, stats["decode_compiles"] - 1),
+        "prefill_recompiles": max(0, stats["prefill_compiles"] - 1),
+    }
+
+
+def _inference_spec_run(k: int, quick: bool, model=None, params=None,
+                        target_as_draft: bool = False,
+                        seed: int = 0) -> dict:
+    """Speculative-decoding accounting run: a fixed seeded request set,
+    reporting the accepted-draft-length distribution and verify-round
+    economics. `target_as_draft=True` runs the target as its own draft —
+    the acceptance UPPER BOUND (every proposal accepted, n tokens in
+    ceil(n/(k+1)) target passes); the default is the built-in
+    truncated-target draft, whose acceptance is honest for the current
+    weights (near zero on random init, climbing with trained ones)."""
+    import random as _random
+
+    from ray_tpu.inference import EngineConfig, InferenceEngine
+
+    rng = _random.Random(seed)
+    cfg = EngineConfig(batch_slots=2, block_size=16, num_blocks=32,
+                       max_blocks_per_seq=8, prefill_chunk=16,
+                       spec_decode_draft_len=k)
+    kwargs = ({"draft_model": model, "draft_params": params}
+              if target_as_draft else {})
+    engine = InferenceEngine(cfg, model=model, params=params, **kwargs)
+    n = 4 if quick else 8
+    for i in range(n):
+        prompt = [rng.randrange(1, 500)
+                  for _ in range(rng.randrange(4, 12))]
+        engine.add_request(prompt, 16, request_id=f"sp{i}")
+    engine.run_until_idle()
+    stats = engine.stats()
+    sd = stats["spec_decode"]
+    engine.check_no_leaks()
+    engine.drop_prefix_cache()
+    return {
+        "draft_len": k,
+        "rounds": sd["rounds"],
+        "accept_rate": round(sd["accept_rate"], 3),
+        "mean_accepted": round(sd["mean_accepted"], 3),
+        "accepted_hist": sd["accepted_hist"],
+        "tokens_emitted": stats["tokens_emitted"],
+        "leaked_blocks": engine.stats()["kv"]["blocks_in_use"],
+        "draft_prefill_recompiles": max(
+            0, sd["draft_prefill_compiles"] - 1),
+        "propose_recompiles": max(0, sd["propose_compiles"] - 1),
+        "verify_recompiles": max(0, sd["verify_compiles"] - 1),
+    }
+
+
+def bench_inference(quick: bool, smoke: bool = False) -> dict:
+    """Inference engine bench, round 3. Legs: (1) continuous batching vs
+    the static request-batch baseline under Poisson arrivals; (2) radix
+    prefix cache A/B over the same shared-prefix multi-tenant trace with
+    per-SLO-class TTFT; (3) speculative-decoding accepted-draft-length
+    distributions (honest truncated draft + target-as-draft upper
+    bound); plus a same-run trivial-task throughput anchor so tokens/s
+    is comparable across rounds on this CPU-shares-throttled sandbox.
+    smoke=True runs only legs 2+3 quick and HARD-asserts the invariants
+    (zero recompiles anywhere, zero leaked blocks, a real hit rate)."""
     import jax
     import jax.numpy as jnp
 
@@ -2101,18 +2257,89 @@ def bench_inference(quick: bool) -> dict:
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))()
 
     out = {}
-    cont = _inference_poisson_run("continuous", quick, model=model,
-                                  params=params)
-    stat = _inference_poisson_run("static", quick, model=model,
-                                  params=params)
-    out.update({f"inference_cont_{k}": v for k, v in cont.items()})
-    out.update({f"inference_static_{k}": v for k, v in stat.items()})
-    out["inference_tokens_per_sec_speedup"] = (
-        cont["tokens_per_sec"] / stat["tokens_per_sec"]
-        if stat["tokens_per_sec"] else 0.0)
-    out["inference_ttft_p99_improvement"] = (
-        stat["ttft_p99_ms"] / cont["ttft_p99_ms"]
-        if cont["ttft_p99_ms"] else 0.0)
+    if not smoke:
+        cont = _inference_poisson_run("continuous", quick, model=model,
+                                      params=params)
+        stat = _inference_poisson_run("static", quick, model=model,
+                                      params=params)
+        out.update({f"inference_cont_{k}": v for k, v in cont.items()})
+        out.update({f"inference_static_{k}": v for k, v in stat.items()})
+        out["inference_tokens_per_sec_speedup"] = (
+            cont["tokens_per_sec"] / stat["tokens_per_sec"]
+            if stat["tokens_per_sec"] else 0.0)
+        out["inference_ttft_p99_improvement"] = (
+            stat["ttft_p99_ms"] / cont["ttft_p99_ms"]
+            if cont["ttft_p99_ms"] else 0.0)
+
+    # ---- radix prefix cache A/B on the same shared-prefix trace
+    cold = _inference_multitenant_run(False, quick or smoke, model=model,
+                                      params=params)
+    warm = _inference_multitenant_run(True, quick or smoke, model=model,
+                                      params=params)
+    out.update({f"inference_uncached_{k}": v for k, v in cold.items()})
+    out.update({f"inference_cached_{k}": v for k, v in warm.items()})
+    out["inference_cache_hit_rate"] = warm["prefix_hit_rate"]
+    out["inference_cache_tokens_per_s_speedup"] = round(
+        warm["tokens_per_sec"] / max(cold["tokens_per_sec"], 1e-9), 3)
+    # Acceptance: interactive TTFT holds under batch-class bulk load.
+    out["inference_slo_interactive_p99_holds"] = bool(
+        warm["ttft_interactive_p99_ms"] <= warm["ttft_batch_p99_ms"])
+    # Soft regression flag (mirrors tasks_per_s_regressed): the cached
+    # arm must beat the uncached arm on its own trace — same run, same
+    # seed, so ambient sandbox noise largely cancels.
+    out["inference_tokens_per_s_regressed"] = bool(
+        warm["tokens_per_sec"] <= cold["tokens_per_sec"])
+    if out["inference_tokens_per_s_regressed"]:
+        print("WARNING: cached-path tokens/s "
+              f"{warm['tokens_per_sec']:.1f} <= uncached "
+              f"{cold['tokens_per_sec']:.1f} on the same trace "
+              "(soft flag)", file=sys.stderr)
+
+    # ---- speculative decoding: accepted-draft-length distribution
+    spec = _inference_spec_run(4, quick or smoke, model=model,
+                               params=params)
+    spec_ub = _inference_spec_run(4, quick or smoke, model=model,
+                                  params=params, target_as_draft=True)
+    out.update({f"inference_spec_{k}": v for k, v in spec.items()})
+    out.update({f"inference_spec_ub_{k}": v for k, v in spec_ub.items()})
+
+    # ---- same-run task-throughput anchor (bench normalization)
+    import ray_tpu
+
+    started = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+        started = True
+
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    n_norm = 150 if (quick or smoke) else 400
+    ray_tpu.get([_noop.remote() for _ in range(32)])
+    t0 = time.perf_counter()
+    ray_tpu.get([_noop.remote() for _ in range(n_norm)])
+    out["inference_tasks_per_s_anchor"] = round(
+        n_norm / (time.perf_counter() - t0), 1)
+    out["inference_tokens_per_tasknorm"] = round(
+        warm["tokens_per_sec"]
+        / max(out["inference_tasks_per_s_anchor"], 1e-9), 4)
+    if started and smoke:
+        ray_tpu.shutdown()
+
+    if smoke:
+        for label, run in (("uncached", cold), ("cached", warm)):
+            assert run["decode_recompiles"] == 0, (label, run)
+            assert run["prefill_recompiles"] == 0, (label, run)
+            assert run["leaked_blocks"] == 0, (label, run)
+        assert warm["prefix_hit_rate"] > 0.0, warm
+        for label, run in (("spec", spec), ("spec_ub", spec_ub)):
+            assert run["leaked_blocks"] == 0, (label, run)
+            assert run["draft_prefill_recompiles"] == 0, (label, run)
+            assert run["propose_recompiles"] == 0, (label, run)
+            assert run["verify_recompiles"] == 0, (label, run)
+        assert spec_ub["accept_rate"] == 1.0, spec_ub
+        out["inference_smoke_ok"] = True
     return out
 
 
@@ -2836,6 +3063,12 @@ def main(out=None):
                          "one seeded node kill mid-shuffle, hard asserts "
                          "on bounded recompute, <60s) and exit nonzero "
                          "on any hang/unbounded-recovery failure")
+    ap.add_argument("--inference-smoke", action="store_true",
+                    help="run ONLY the bounded inference smoke (gate "
+                         "step: prefix-cache A/B + spec-decode quick "
+                         "runs, hard asserts on zero recompiles and "
+                         "zero leaked blocks) and exit nonzero on any "
+                         "invariant breach")
     args = ap.parse_args()
 
     import ray_tpu
@@ -2861,6 +3094,18 @@ def main(out=None):
                               f"{type(e).__name__}: {e}"}), file=stream)
             sys.exit(1)
         print(json.dumps({"ingest_smoke": smoke}), file=stream)
+        stream.flush()
+        sys.exit(0)
+
+    if args.inference_smoke:
+        stream = out or sys.stdout
+        try:
+            smoke = bench_inference(quick=True, smoke=True)
+        except Exception as e:  # noqa: BLE001 — the gate needs the reason
+            print(json.dumps({"inference_smoke_error":
+                              f"{type(e).__name__}: {e}"}), file=stream)
+            sys.exit(1)
+        print(json.dumps({"inference_smoke": smoke}), file=stream)
         stream.flush()
         sys.exit(0)
 

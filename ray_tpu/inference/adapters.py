@@ -79,7 +79,7 @@ class AdapterManager:
                loader: Callable[[str], list],
                pinned_rows=()) -> int:
         """Make `model_id` resident and return its bank row. `loader`
-        produces the per-layer (aq, bq, av, bv) rows on a miss (e.g.
+        produces the per-layer (aq, bq, ao, bo) rows on a miss (e.g.
         `make_adapter_weights` from the adapter's registered seed); LRU
         evicts the least-recently-used unpinned adapter when the bank is
         full. Raises AdapterLoadError when nothing can be evicted."""
@@ -158,7 +158,7 @@ class AdapterManager:
     # -------------------------------------------------------------- banks
 
     def device_banks(self):
-        """Per-layer [(aq, bq, av, bv)] device arrays for the step
+        """Per-layer [(aq, bq, ao, bo)] device arrays for the step
         programs, cached until residency changes. Placed with the SAME
         shardings every time (tp: B output dims split with their heads)
         so a reload is invisible to the jit cache."""

@@ -32,7 +32,10 @@ def _parse(payload: Optional[Dict[str, Any]], default_new: int):
     ids = [int(t) for t in payload.get("ids", [])] or [0]
     max_new = max(1, int(payload.get("max_new_tokens", default_new)))
     model_id = payload.get("model_id") or payload.get("model")
-    return ids, max_new, (str(model_id) if model_id is not None else None)
+    slo = payload.get("slo_class") or payload.get("slo")
+    return (ids, max_new,
+            (str(model_id) if model_id is not None else None),
+            (str(slo) if slo is not None else None))
 
 
 @serve.deployment(max_concurrent_queries=64)
@@ -117,7 +120,7 @@ class LLMServer:
         return await self.generate(payload)
 
     async def generate(self, payload=None):
-        ids, max_new, model_id = _parse(payload, self._default_new)
+        ids, max_new, model_id, slo = _parse(payload, self._default_new)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
 
@@ -132,7 +135,7 @@ class LLMServer:
             loop.call_soon_threadsafe(_resolve)
 
         req = self._loop.submit(ids, max_new, on_finish=on_finish,
-                                model_id=model_id)
+                                model_id=model_id, slo_class=slo)
         try:
             await fut
         except asyncio.CancelledError:
@@ -148,7 +151,7 @@ class LLMServer:
         ``{"done": True, "ids": [...]}`` — replica pumps it through the
         stream queue, the proxy relays chunked JSON lines, handles iterate
         it with ``options(stream=True)``."""
-        ids, max_new, model_id = _parse(payload, self._default_new)
+        ids, max_new, model_id, slo = _parse(payload, self._default_new)
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
@@ -159,7 +162,8 @@ class LLMServer:
             loop.call_soon_threadsafe(queue.put_nowait, ("end", req))
 
         req = self._loop.submit(ids, max_new, on_token=on_token,
-                                on_finish=on_finish, model_id=model_id)
+                                on_finish=on_finish, model_id=model_id,
+                                slo_class=slo)
         try:
             while True:
                 kind, item = await queue.get()
@@ -192,7 +196,8 @@ class LLMServer:
         stats = self._engine.stats()
         out = {"queue_depth": stats["queue_depth"],
                "running": stats["running"],
-               "tokens_per_sec": stats["tokens_per_sec"]}
+               "tokens_per_sec": stats["tokens_per_sec"],
+               "prefix_hit_rate": stats["prefix_cache"].get("hit_rate", 0.0)}
         adapters = stats.get("adapters")
         if adapters is not None:
             out["adapters"] = adapters["resident"]

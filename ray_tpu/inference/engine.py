@@ -15,9 +15,22 @@ Two jitted programs serve every request mix, each compiled exactly once:
 - prefill: [1, prefill_chunk] tokens of one sequence (padded chunk),
 - decode:  [batch_slots, 1] — one token for every running slot.
 
+Speculative decoding (spec_decode_draft_len > 0) swaps the decode step
+for three more fixed-shape programs — draft prefill [1, chunk], propose
+(k+1 scanned draft steps), verify [batch_slots, k+1] — still compiled
+exactly once each; greedy verification makes the emitted tokens
+identical to plain decoding, whatever the draft proposes.
+
+A radix prefix cache (prefix_cache_enabled, continuous scheduling)
+keeps finished sequences' full-block KV prefixes refcounted in the
+arena; a new request adopts its longest cached match and prefills only
+the tail. Cached blocks are reclaimed LRU-by-leaf under pressure before
+any live sequence is preempted.
+
 All shapes are static (batch slots, chunk width, block-table width), so
 the engine's per-step work is argument values, never new programs; the
-stats track compile counts to prove it.
+stats track compile counts to prove it — including on the cached path,
+which reuses the same programs with fewer invocations.
 
 The engine core is synchronous and single-threaded (`step()`); tests drive
 it directly. `EngineLoop` runs it on a background thread and is what the
@@ -40,7 +53,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.inference.kv_cache import BlockManager
+from ray_tpu.inference.kv_cache import BlockManager, RadixPrefixCache
 from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
@@ -72,6 +85,13 @@ class EngineConfig:
     # argument), per-replica LRU residency. 0 = classic single model.
     max_adapters: int = 0
     lora_rank: int = 8
+    # Round-3 knobs (docs/INFERENCE.md). None = resolve from the global
+    # flag table at engine construction, so deployments pick them up via
+    # RAY_TPU_* env vars / _system_config without a config plumb-through.
+    prefix_cache_enabled: Optional[bool] = None
+    spec_decode_draft_len: Optional[int] = None
+    slo_default_class: Optional[str] = None
+    slo_interactive_reserved_slots: Optional[int] = None
 
     @property
     def max_context(self) -> int:
@@ -101,11 +121,18 @@ class Request:
     # (None = base model, bank row 0 identity).
     model_id: Optional[str] = None
     adapter_row: int = 0
+    # SLO class ("interactive" | "batch"): admission/prefill priority and
+    # preemption victim order.
+    slo_class: str = "interactive"
+    # Prefix-cache accounting: prompt tokens whose KV was adopted from
+    # the radix cache instead of prefilled (across all admissions).
+    cached_tokens: int = 0
     # Scheduler-internal:
     slot: Optional[int] = None
     processed: int = 0                # tokens written into the KV cache
     cur_token: Optional[int] = None   # next decode input
     _held_emits: List[tuple] = field(default_factory=list)
+    _pinned_node: Any = None          # radix node pinned while scheduled
 
     @property
     def total_to_prefill(self) -> int:
@@ -126,10 +153,11 @@ class InferenceEngine:
     """
 
     def __init__(self, config: EngineConfig, model=None, params=None,
-                 mesh=None):
+                 mesh=None, draft_model=None, draft_params=None):
         import jax
         import jax.numpy as jnp
 
+        from ray_tpu.core.config import GLOBAL_CONFIG
         from ray_tpu.models.llama import (
             Llama,
             LlamaConfig,
@@ -144,6 +172,25 @@ class InferenceEngine:
         if cfg.max_blocks_per_seq * cfg.block_size < cfg.prefill_chunk:
             raise ValueError("prefill_chunk exceeds the per-seq context")
         self.config = cfg
+        # Round-3 knobs: explicit config wins, else the global flag table.
+        self._draft_len = int(
+            cfg.spec_decode_draft_len
+            if cfg.spec_decode_draft_len is not None
+            else GLOBAL_CONFIG.spec_decode_draft_len)
+        self._slo_default = str(
+            cfg.slo_default_class if cfg.slo_default_class is not None
+            else GLOBAL_CONFIG.slo_default_class)
+        if self._slo_default not in ("interactive", "batch"):
+            raise ValueError(
+                f"unknown slo_default_class {self._slo_default!r}")
+        self._slo_reserved = min(
+            cfg.batch_slots - 1,
+            max(0, int(cfg.slo_interactive_reserved_slots
+                       if cfg.slo_interactive_reserved_slots is not None
+                       else GLOBAL_CONFIG.slo_interactive_reserved_slots)))
+        prefix_enabled = (
+            cfg.prefix_cache_enabled if cfg.prefix_cache_enabled is not None
+            else bool(GLOBAL_CONFIG.prefix_cache_enabled))
         if model is None:
             mc = {"tiny": LlamaConfig.tiny(seq=cfg.max_model_len),
                   "small": LlamaConfig.small(),
@@ -172,6 +219,48 @@ class InferenceEngine:
         self._arenas = make_paged_arena(model.config, cfg.num_blocks,
                                         cfg.block_size,
                                         sharding=self._arena_sharding)
+        # Radix prefix cache (continuous scheduling only: static gangs
+        # hold finished members' blocks for the drain, which fights the
+        # donate-to-cache lifecycle and the baseline it emulates never
+        # had prefix reuse anyway).
+        self._prefix: Optional[RadixPrefixCache] = None
+        if prefix_enabled and cfg.scheduling == "continuous":
+            self._prefix = RadixPrefixCache(self._bm)
+        # Speculative decoding: the draft shares the target's BLOCK
+        # TABLES (host bookkeeping) but writes its own arenas — same
+        # geometry, so one table addresses both. Default draft: the
+        # TRUNCATED target (its first n_layer//2 blocks plus its embed/
+        # final-norm/lm-head, parameters shared by reference) — an
+        # early-exit draft that agrees with the target on easy tokens
+        # for free. Greedy verify makes the output independent of draft
+        # quality either way; a better draft just accepts more.
+        self._draft_model = None
+        self._draft_params = None
+        self._draft_arenas = None
+        self._draft_arena_sharding = None
+        if self._draft_len > 0:
+            if draft_model is None:
+                import dataclasses as _dc
+
+                dcfg = _dc.replace(model.config,
+                                   n_layer=max(1, model.config.n_layer // 2))
+                draft_model = Llama(dcfg)
+                inner = params["params"] if "params" in params else params
+                dp = {k: inner[k]
+                      for k in ("embed", "final_norm", "lm_head")}
+                for i in range(dcfg.n_layer):
+                    dp[f"layer_{i}"] = inner[f"layer_{i}"]
+                draft_params = {"params": dp}
+            if mesh is not None:
+                draft_params = shard_params_tp(draft_model, draft_params,
+                                               mesh)
+                self._draft_arena_sharding = arena_sharding(
+                    draft_model.config, mesh)
+            self._draft_model = draft_model
+            self._draft_params = draft_params
+            self._draft_arenas = make_paged_arena(
+                draft_model.config, cfg.num_blocks, cfg.block_size,
+                sharding=self._draft_arena_sharding)
         # Model multiplexing: the adapter bank + residency bookkeeping.
         # `adapter_source(model_id) -> per-layer rows` is registered by
         # the deployment (api.py) so a miss loads on demand.
@@ -196,7 +285,15 @@ class InferenceEngine:
         self._recomputed_tokens = 0
         self._started_at: Optional[float] = None
         self._rate_window: List[tuple] = []   # (t, n) recent emissions
-        self._shapes = {"prefill": set(), "decode": set()}
+        self._shapes = {"prefill": set(), "decode": set(),
+                        "draft_prefill": set(), "propose": set(),
+                        "verify": set()}
+        # Spec-decode accounting: accepted-length histogram [0..k] per
+        # verify round (index a = rounds that accepted exactly a drafts).
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_hist = [0] * (self._draft_len + 1)
         self._build_programs()
         self._last_stats = self._stats_locked()
 
@@ -259,8 +356,75 @@ class InferenceEngine:
             self._prefill_fn = prefill_fn
             self._decode_fn = decode_fn
 
+        # Speculative decoding adds exactly three more fixed-shape
+        # programs, each compiled once: draft prefill [1, chunk] (keeps
+        # the draft's KV in lockstep with the target's), propose (k+1
+        # draft decode steps under lax.scan, [B, 1] per step), verify
+        # (target forward over [B, k+1] = current token + k proposals).
+        self._draft_prefill_fn = None
+        self._propose_fn = None
+        self._verify_fn = None
+        if self._draft_len > 0:
+            draft = self._draft_model
+
+            def draft_prefill_fn(dparams, darenas, ids, bt, pos, wmask):
+                _, darenas = draft.apply(dparams, ids, darenas, bt, pos,
+                                         wmask, method=Llama.decode_paged)
+                return darenas
+
+            def propose_fn(dparams, darenas, toks, bt, pos, wmask_seq):
+                # wmask_seq [k+1, B, 1]: per-step write masks (rows near
+                # their context limit mask the tail — masked writes land
+                # in the trash block, their logits are never used).
+                # Step j writes its INPUT token's KV at pos+j and emits
+                # the argmax proposal for position pos+j+1, so the k+1
+                # steps leave the draft KV complete through pos+k.
+                def body(carry, wm):
+                    tok, p, arenas = carry
+                    logits, arenas = draft.apply(
+                        dparams, tok, arenas, bt, p, wm,
+                        method=Llama.decode_paged)
+                    nxt = jnp.argmax(logits[:, -1],
+                                     axis=-1).astype(jnp.int32)
+                    return (nxt[:, None], p + 1, arenas), nxt
+
+                (_, _, darenas), props = jax.lax.scan(
+                    body, (toks, pos, darenas), wmask_seq)
+                return jnp.transpose(props), darenas     # [B, k+1]
+
+            if self._adapters is not None:
+                def verify_fn(params, arenas, banks, aidx, toks, bt, pos,
+                              wmask):
+                    logits, arenas = model.apply(
+                        params, toks, arenas, bt, pos, wmask, banks, aidx,
+                        method=Llama.decode_paged)
+                    return jnp.argmax(logits,
+                                      axis=-1).astype(jnp.int32), arenas
+            else:
+                def verify_fn(params, arenas, toks, bt, pos, wmask):
+                    logits, arenas = model.apply(
+                        params, toks, arenas, bt, pos, wmask,
+                        method=Llama.decode_paged)
+                    return jnp.argmax(logits,
+                                      axis=-1).astype(jnp.int32), arenas
+
+            if self.config.use_jit:
+                self._draft_prefill_fn = jax.jit(draft_prefill_fn,
+                                                 donate_argnums=(1,))
+                self._propose_fn = jax.jit(propose_fn, donate_argnums=(1,))
+                self._verify_fn = jax.jit(verify_fn, donate_argnums=(1,))
+            else:
+                self._draft_prefill_fn = draft_prefill_fn
+                self._propose_fn = propose_fn
+                self._verify_fn = verify_fn
+
     def _program_compiles(self, name: str) -> int:
-        fn = self._prefill_fn if name == "prefill" else self._decode_fn
+        fn = {"prefill": self._prefill_fn, "decode": self._decode_fn,
+              "draft_prefill": self._draft_prefill_fn,
+              "propose": self._propose_fn,
+              "verify": self._verify_fn}[name]
+        if fn is None:
+            return 0
         size = getattr(fn, "_cache_size", None)
         if callable(size):
             try:
@@ -273,7 +437,7 @@ class InferenceEngine:
 
     def register_adapter_source(self, fn: Callable[[str], list]) -> None:
         """Install the on-demand adapter loader: fn(model_id) returns
-        the per-layer (aq, bq, av, bv) rows (api.py wires the replica's
+        the per-layer (aq, bq, ao, bo) rows (api.py wires the replica's
         registered adapter specs here)."""
         self._adapter_source = fn
 
@@ -304,10 +468,15 @@ class InferenceEngine:
                     on_token: Optional[Callable] = None,
                     on_finish: Optional[Callable] = None,
                     request_id: Optional[str] = None,
-                    model_id: Optional[str] = None) -> Request:
+                    model_id: Optional[str] = None,
+                    slo_class: Optional[str] = None) -> Request:
         cfg = self.config
         prompt = [int(t) for t in prompt] or [0]
         max_new_tokens = max(1, int(max_new_tokens))
+        slo = slo_class if slo_class is not None else self._slo_default
+        if slo not in ("interactive", "batch"):
+            raise ValueError(f"unknown slo_class {slo!r} "
+                             "(expected 'interactive' or 'batch')")
         total = len(prompt) + max_new_tokens
         if total > cfg.max_context or not self._bm.fits(total):
             raise ValueError(
@@ -332,12 +501,13 @@ class InferenceEngine:
                 on_token=on_token, on_finish=on_finish,
                 submitted_at=time.monotonic(),
                 trace_ctx=_tracing.capture(),
-                model_id=model_id, adapter_row=adapter_row)
+                model_id=model_id, adapter_row=adapter_row,
+                slo_class=slo)
             self._live[rid] = req
-            # Arrivals are strictly increasing: append preserves the
-            # sorted-by-arrival invariant (_preempt_one re-sorts for its
-            # out-of-order re-inserts).
+            # Queue order is (class, arrival): interactive ahead of
+            # batch, FIFO within a class.
             self._waiting.append(req)
+            self._waiting.sort(key=self._prio)
             if self._started_at is None:
                 self._started_at = time.monotonic()
         return req
@@ -379,7 +549,10 @@ class InferenceEngine:
             self._release_static_gang(emissions)
             self._admit()
             ran = self._prefill_step(emissions)
-            ran = self._decode_step(emissions) or ran
+            if self._draft_len > 0:
+                ran = self._spec_decode_step(emissions) or ran
+            else:
+                ran = self._decode_step(emissions) or ran
         for fn, args in emissions:
             try:
                 fn(*args)
@@ -403,6 +576,15 @@ class InferenceEngine:
     def _scheduled(self) -> List[Request]:
         return [r for r in self._slots if r is not None]
 
+    @staticmethod
+    def _prio(req: Request):
+        return (0 if req.slo_class == "interactive" else 1, req.arrival)
+
+    def _unpin_req(self, req: Request) -> None:
+        if req._pinned_node is not None and self._prefix is not None:
+            self._prefix.unpin(req._pinned_node)
+        req._pinned_node = None
+
     def _admit(self):
         cfg = self.config
         if cfg.scheduling == "static":
@@ -413,36 +595,76 @@ class InferenceEngine:
             free_slots = [i for i, r in enumerate(self._slots) if r is None]
             if not free_slots:
                 return
-            req = self._waiting[0]
-            first = min(req.total_to_prefill, cfg.prefill_chunk)
-            self._bm.register(req.request_id)
-            if not self._bm.ensure(req.request_id, first):
-                # Pool exhausted: stay queued; running sequences finishing
-                # (or preempting) will free blocks.
-                self._bm.free(req.request_id)
+            req = None
+            for cand in self._waiting:   # sorted by (class, arrival)
+                if (cfg.scheduling == "continuous"
+                        and cand.slo_class != "interactive"
+                        and len(free_slots) <= self._slo_reserved):
+                    # Reserved headroom: batch-class admissions must
+                    # leave this many slots open for interactive
+                    # arrivals (a bulk flood otherwise owns the batch).
+                    continue
+                req = cand
+                break
+            if req is None:
                 return
-            self._waiting.pop(0)
+            rid = req.request_id
+            # Longest cached prefix: adopt matched blocks (refcount++)
+            # and skip their prefill entirely. Capped one token short of
+            # the stream so at least one token still prefills — the
+            # first emitted token needs fresh logits.
+            matched_tokens = 0
+            pin_node = None
+            if self._prefix is not None:
+                stream = req.prompt + req.generated
+                cap = (len(stream) - 1) // cfg.block_size * cfg.block_size
+                blocks, pin_node = self._prefix.match(stream[:cap])
+                if blocks:
+                    matched_tokens = len(blocks) * cfg.block_size
+                    self._bm.register_with_blocks(rid, blocks)
+                    self._prefix.pin(pin_node)
+                    req._pinned_node = pin_node
+            if not self._bm.registered(rid):
+                self._bm.register(rid)
+            first = min(req.total_to_prefill,
+                        matched_tokens + cfg.prefill_chunk)
+            while not self._bm.ensure(rid, first):
+                deficit = (self._bm.blocks_for_tokens(first)
+                           - len(self._bm.block_table(rid))
+                           - self._bm.num_free())
+                if (self._prefix is None
+                        or self._prefix.evict_for(deficit) == 0):
+                    # Pool exhausted: stay queued; running sequences
+                    # finishing (or preempting) will free blocks.
+                    self._unpin_req(req)
+                    self._bm.free(rid)
+                    return
+            self._waiting.remove(req)
             req.slot = free_slots[0]
             req.state = PREFILL
-            req.processed = 0
+            req.processed = matched_tokens
+            req.cached_tokens += matched_tokens
             if req.admitted_at is None:
                 req.admitted_at = time.monotonic()
             if req.generated:
-                self._recomputed_tokens += req.total_to_prefill
+                self._recomputed_tokens += max(
+                    0, req.total_to_prefill - matched_tokens)
             self._slots[req.slot] = req
 
     # ---------------------------------------------------------- preemption
 
     def _preempt_one(self) -> bool:
-        """Free the lowest-priority (latest-arrival) scheduled sequence to
-        relieve block pressure. The victim may be the requester itself
+        """Free the lowest-priority scheduled sequence to relieve block
+        pressure: batch-class victims before interactive ones, latest
+        arrival within a class. The victim may be the requester itself
         (callers detect that via its WAITING state). Returns False when
         there is nothing left to preempt."""
         victims = [r for r in self._scheduled()
                    if r.state in (PREFILL, DECODE)]
         if not victims:
             return False
-        victim = max(victims, key=lambda r: r.arrival)
+        victim = max(victims, key=self._prio)
+        self._unpin_req(victim)
         self._bm.free(victim.request_id)
         self._slots[victim.slot] = None
         victim.slot = None
@@ -458,13 +680,20 @@ class InferenceEngine:
                 attrs={"request": victim.request_id,
                        "tokens_generated": len(victim.generated)})
         self._waiting.append(victim)
-        self._waiting.sort(key=lambda r: r.arrival)
+        self._waiting.sort(key=self._prio)
         return True
 
     def _ensure_blocks(self, req: Request, num_tokens: int) -> bool:
-        """Grow req's block table, preempting victims until it fits.
-        False when req itself was preempted (caller must drop it)."""
+        """Grow req's block table — reclaiming cold cached prefixes
+        first, then preempting victims — until it fits. False when req
+        itself was preempted (caller must drop it)."""
         while not self._bm.ensure(req.request_id, num_tokens):
+            deficit = (self._bm.blocks_for_tokens(num_tokens)
+                       - len(self._bm.block_table(req.request_id))
+                       - self._bm.num_free())
+            if (self._prefix is not None
+                    and self._prefix.evict_for(deficit) > 0):
+                continue
             if self.config.scheduling == "static":
                 # A drained gang member's KV is never read again — reclaim
                 # its blocks before preempting anything still running.
@@ -489,7 +718,7 @@ class InferenceEngine:
         cands = [r for r in self._scheduled() if r.state == PREFILL]
         if not cands:
             return False
-        req = min(cands, key=lambda r: r.arrival)   # oldest first
+        req = min(cands, key=self._prio)   # interactive first, then oldest
         total = req.total_to_prefill
         chunk = min(cfg.prefill_chunk, total - req.processed)
         if not self._ensure_blocks(req, req.processed + chunk):
@@ -511,6 +740,13 @@ class InferenceEngine:
             nxt, self._arenas = self._call(
                 "prefill", self._prefill_fn, self._params, self._arenas,
                 *args)
+        if self._draft_len > 0:
+            # Keep the draft's KV in lockstep: same chunk, same blocks.
+            # Cached-prefix blocks carry draft KV from their original
+            # prefill (deterministic writes), so hits skip BOTH models.
+            self._draft_arenas = self._call(
+                "draft_prefill", self._draft_prefill_fn,
+                self._draft_params, self._draft_arenas, *args[:4])
         req.processed += chunk
         if req.processed >= total:
             self._emit_token(req, int(nxt[0]), emissions)
@@ -563,6 +799,91 @@ class InferenceEngine:
         for req in active:
             req.processed += 1
             self._emit_token(req, int(nxt[req.slot]), emissions)
+        return True
+
+    def _spec_decode_step(self, emissions) -> bool:
+        """Speculative round for every DECODE row: draft proposes k
+        tokens (k+1 scan steps so the draft KV stays complete), target
+        verifies [current, d1..dk] in one [B, k+1] forward. Row i with
+        a accepted drafts emits d1..da plus the target's bonus token —
+        provably the same tokens plain decoding would emit (greedy
+        verify), just more of them per target pass. Rejected proposals
+        need no KV rollback: every stale slot is at a position >= the
+        row's new `processed`, and the next round's scatter overwrites
+        it before any attention read (the causal mask hides it until
+        then). Over-provisioned tail blocks stay in the row's table for
+        the next round and are released at finish/preemption — never
+        leaked."""
+        import numpy as np
+
+        cfg = self.config
+        k = self._draft_len
+        active: List[tuple] = []
+        for req in list(self._scheduled()):
+            if req.state != DECODE:
+                continue
+            # Rows near the context limit shorten their round: writes
+            # never pass max_context (the block table has no slots
+            # there; a clipped write would corrupt the last block).
+            allow = max(0, min(k, cfg.max_context - req.processed - 1))
+            if self._ensure_blocks(req, req.processed + allow + 1):
+                active.append((req, allow))
+        active = [(r, a) for r, a in active
+                  if r.state == DECODE and r.slot is not None]
+        if not active:
+            return False
+        B = cfg.batch_slots
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros(B, np.int32)
+        wmask_seq = np.zeros((k + 1, B, 1), bool)
+        rows: List[Optional[Request]] = [None] * B
+        for req, allow in active:
+            i = req.slot
+            rows[i] = req
+            toks[i, 0] = req.cur_token
+            pos[i] = req.processed
+            wmask_seq[:allow + 1, i, 0] = True
+        bt = self._block_table_rows(rows)
+        props, self._draft_arenas = self._call(
+            "propose", self._propose_fn, self._draft_params,
+            self._draft_arenas, toks, bt, pos, wmask_seq)
+        props = np.asarray(props)               # [B, k+1]; col j = d_{j+1}
+        vtoks = np.zeros((B, k + 1), np.int32)
+        vmask = np.zeros((B, k + 1), bool)
+        for req, allow in active:
+            i = req.slot
+            vtoks[i, 0] = req.cur_token
+            vtoks[i, 1:] = props[i, :k]
+            vmask[i, :allow + 1] = True
+        if self._adapters is not None:
+            aidx = np.zeros(B, np.int32)
+            for req, _ in active:
+                aidx[req.slot] = req.adapter_row
+            tgt, self._arenas = self._call(
+                "verify", self._verify_fn, self._params, self._arenas,
+                self._adapters.device_banks(), aidx, vtoks, bt, pos, vmask)
+        else:
+            tgt, self._arenas = self._call(
+                "verify", self._verify_fn, self._params, self._arenas,
+                vtoks, bt, pos, vmask)
+        tgt = np.asarray(tgt)                   # [B, k+1] target argmaxes
+        for req, allow in active:
+            i = req.slot
+            a = 0
+            while a < allow and props[i, a] == tgt[i, a]:
+                a += 1
+            self._spec_rounds += 1
+            self._spec_proposed += allow
+            self._spec_accepted += a
+            self._spec_hist[a] += 1
+            # KV through pos+a is now final; positions beyond hold
+            # rejected-draft garbage the next round overwrites.
+            req.processed += a + 1
+            for j in range(a + 1):
+                if req.done:
+                    break
+                token = int(props[i, j]) if j < a else int(tgt[i, a])
+                self._emit_token(req, token, emissions)
         return True
 
     # ------------------------------------------------------------- helpers
@@ -636,6 +957,20 @@ class InferenceEngine:
         for event in req._held_emits:   # static error: flush, then fail
             self._fire(req, event, emissions)
         req._held_emits = []
+        # Donate the finished sequence's full-block prefix to the radix
+        # cache BEFORE freeing: insert increfs the novel suffix, free
+        # decrefs the request's own references, net the cache keeps
+        # exactly the new blocks. Errors skip the donation (a cancelled
+        # stream's KV is valid but its tail may be mid-write).
+        if (self._prefix is not None and not error
+                and self._bm.registered(req.request_id)):
+            stream = req.prompt + req.generated
+            nb = min(req.processed, len(stream)) // self.config.block_size
+            if nb > 0:
+                self._prefix.insert(
+                    stream[:nb * self.config.block_size],
+                    self._bm.block_table(req.request_id)[:nb])
+        self._unpin_req(req)
         self._bm.free(req.request_id)
         if req.slot is not None:
             self._slots[req.slot] = None
@@ -677,6 +1012,15 @@ class InferenceEngine:
             self._arenas = make_paged_arena(
                 self._model.config, self.config.num_blocks,
                 self.config.block_size, sharding=self._arena_sharding)
+            if self._draft_arenas is not None:
+                self._draft_arenas = make_paged_arena(
+                    self._draft_model.config, self.config.num_blocks,
+                    self.config.block_size,
+                    sharding=self._draft_arena_sharding)
+            # Fresh arenas invalidate every cached block's contents: a
+            # warm radix tree pointing at zeroed KV would serve garbage.
+            if self._prefix is not None:
+                self._prefix.clear()
         for fn, args in emissions:
             try:
                 fn(*args)
@@ -776,16 +1120,60 @@ class InferenceEngine:
             "prefill_compiles": self._program_compiles("prefill"),
             "decode_compiles": self._program_compiles("decode"),
             "kv": self._bm.stats(),
+            "prefix_cache": (self._prefix.stats() if self._prefix is not None
+                             else {"enabled": False, "cached_blocks": 0,
+                                   "hit_rate": 0.0, "hit_tokens": 0}),
+            "spec_decode": {
+                "draft_len": self._draft_len,
+                "rounds": self._spec_rounds,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "accept_rate": (self._spec_accepted / self._spec_proposed
+                                if self._spec_proposed else 0.0),
+                "mean_accepted": (self._spec_accepted / self._spec_rounds
+                                  if self._spec_rounds else 0.0),
+                "accepted_hist": list(self._spec_hist),
+                "draft_prefill_compiles":
+                    self._program_compiles("draft_prefill"),
+                "propose_compiles": self._program_compiles("propose"),
+                "verify_compiles": self._program_compiles("verify"),
+            },
+            "slo": {
+                "reserved_slots": self._slo_reserved,
+                "waiting_interactive": sum(
+                    1 for r in self._waiting
+                    if r.slo_class == "interactive"),
+                "waiting_batch": sum(1 for r in self._waiting
+                                     if r.slo_class == "batch"),
+            },
             **({"adapters": self._adapters.stats()}
                if self._adapters is not None else {}),
         }
 
     def check_no_leaks(self):
-        """Test hook: after every request finishes, the arena must be
-        fully free and internally consistent."""
+        """Test hook: once every request has finished, the only arena
+        references left are the radix cache's (its synthetic tables are
+        audited by check_consistency like live sequences), nothing is
+        pinned, and the cache's own tree matches its tables. Without a
+        cache this degenerates to the classic blocks_in_use == 0."""
         with self._lock:
             self._bm.check_consistency()
-            assert self._bm.blocks_in_use() == 0, self._bm.stats()
+            cached = (self._prefix.cached_blocks()
+                      if self._prefix is not None else 0)
+            assert self._bm.blocks_in_use() == cached, (
+                self._bm.stats(), cached)
+            if self._prefix is not None:
+                self._prefix.check_consistency()
+                if not self._live:
+                    assert self._prefix.total_pins() == 0
+
+    def drop_prefix_cache(self) -> int:
+        """Release every cached prefix block back to the pool (test
+        drains, memory-pressure escape hatch). Returns blocks freed."""
+        with self._lock:
+            if self._prefix is None:
+                return 0
+            return self._prefix.clear()
 
 
 class EngineLoop:

@@ -124,12 +124,24 @@ class LlamaBlock(nn.Module):
         position to (masked-off tokens go to trash block 0), reads gather
         the row's logical context back out of the arena.
 
-        lora=(aq, bq, av, bv, adapter_idx): model-multiplexed low-rank
-        deltas on the q/v projections (classic LoRA targets). The banks
+        lora=(aq, bq, ao, bo, adapter_idx): model-multiplexed low-rank
+        LATE-FUSION deltas (ladder-style side adapter). The block reads
+        two backbone taps — the attn-normed input (aq/bq) and the
+        flattened attention mixer output (ao/bo) — and returns their
+        low-rank projection as a SIDE contribution instead of adding it
+        to the residual stream; the caller accumulates the per-layer
+        sides and merges the sum once, before the final norm. Because
+        the residual stream itself is untouched, every layer's K/V is
+        bit-identical to the base model's no matter which adapter ran:
+        the paged arena is ADAPTER-INVARIANT and the radix prefix cache
+        shares cached blocks across tenants exactly. (A classic
+        in-place q/o delta would NOT have this property: perturbing one
+        layer's output perturbs every deeper layer's K/V.) The banks
         hold one row per resident adapter ([n_rows, ...]; row 0 is the
-        zero identity) and `adapter_idx` [b] routes each BATCH ROW to its
-        adapter — routing is data, so one compiled program serves every
-        adapter mix and loading/evicting an adapter never recompiles."""
+        zero identity) and `adapter_idx` [b] routes each BATCH ROW to
+        its adapter — routing is data, so one compiled program serves
+        every adapter mix and loading/evicting an adapter never
+        recompiles."""
         cfg = self.cfg
         hd = cfg.head_dim
         b, s, _ = x.shape
@@ -137,21 +149,6 @@ class LlamaBlock(nn.Module):
         q = _dense(cfg.n_head * hd, ("embed", "heads"), cfg, "wq")(h)
         k = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wk")(h)
         v = _dense(cfg.n_kv_head * hd, ("embed", "heads"), cfg, "wv")(h)
-        if lora is not None:
-            aq, bq, av, bv, aidx = lora
-            # Per-row bank gather, then two thin einsums per target: the
-            # delta path costs O(b*s*e*r) next to the dense O(b*s*e*f).
-            # Compute in the model dtype end to end — bit-identical to a
-            # dedicated replica running the same bank row alone.
-            hq = h
-            dq = jnp.einsum("bsr,brf->bsf",
-                            jnp.einsum("bse,ber->bsr", hq, aq[aidx]),
-                            bq[aidx])
-            dv = jnp.einsum("bsr,brf->bsf",
-                            jnp.einsum("bse,ber->bsr", hq, av[aidx]),
-                            bv[aidx])
-            q = q + dq.astype(q.dtype)
-            v = v + dv.astype(v.dtype)
         q = q.reshape(b, s, cfg.n_head, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, cfg.n_kv_head, hd).transpose(0, 2, 1, 3)
@@ -236,7 +233,24 @@ class LlamaBlock(nn.Module):
                               vf.astype(jnp.float32)).astype(cfg.dtype)
             new_cache = (k_cache, v_cache)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_head * hd)
-        x = x + _dense(cfg.n_embd, ("heads", "embed"), cfg, "wo")(attn)
+        out = _dense(cfg.n_embd, ("heads", "embed"), cfg, "wo")(attn)
+        side = None
+        if lora is not None:
+            aq, bq, ao, bo, aidx = lora
+            # Per-row bank gather, then two thin einsums per tap: the
+            # delta path costs O(b*s*e*r) next to the dense O(b*s*e*f).
+            # Compute in the model dtype end to end — bit-identical to a
+            # dedicated replica running the same bank row alone. The sum
+            # is RETURNED, never added to x: the residual stream (and so
+            # the K/V written above) stays base-model-pure.
+            s_in = jnp.einsum("bsr,bre->bse",
+                              jnp.einsum("bse,ber->bsr", h, aq[aidx]),
+                              bq[aidx])
+            s_attn = jnp.einsum("bsr,bre->bse",
+                                jnp.einsum("bsf,bfr->bsr", attn, ao[aidx]),
+                                bo[aidx])
+            side = (s_in + s_attn).astype(cfg.dtype)
+        x = x + out
 
         h2 = RMSNorm(cfg, name="mlp_norm")(x)
         gate = _dense(cfg.intermediate, ("embed", "mlp"), cfg, "w_gate")(h2)
@@ -244,7 +258,7 @@ class LlamaBlock(nn.Module):
         h2 = nn.silu(gate) * up
         x = x + _dense(cfg.n_embd, ("mlp", "embed"), cfg, "w_down")(h2)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed")), \
-            new_cache
+            new_cache, side
 
 
 class Llama(nn.Module):
@@ -273,7 +287,7 @@ class Llama(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         positions = jnp.arange(s)
         for blk in self.blocks:
-            x, _ = blk(x, positions)
+            x, _, _ = blk(x, positions)
         x = self.final_norm(x)
         logits = self.lm_head(x)
         return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
@@ -288,7 +302,7 @@ class Llama(nn.Module):
         positions = row_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
         new_cache = []
         for i, blk in enumerate(self.blocks):
-            x, layer_cache = blk(x, positions, cache=cache[i])
+            x, layer_cache, _ = blk(x, positions, cache=cache[i])
             new_cache.append(layer_cache)
         x = self.final_norm(x)
         return self.lm_head(x), new_cache
@@ -306,26 +320,36 @@ class Llama(nn.Module):
         vocab], new_arenas) — all shapes static, so one jitted program
         per (b, s) serves the engine forever.
 
-        `lora_banks` (per-layer [(aq, bq, av, bv)]) + `adapter_idx` [b]
-        turn on model multiplexing: each batch row's q/v projections get
-        its adapter's low-rank delta (row 0 = identity). The banks are
-        fixed-shape arguments, so N adapters still compile the SAME two
-        programs and adapter churn is pure data movement."""
+        `lora_banks` (per-layer [(aq, bq, ao, bo)]) + `adapter_idx` [b]
+        turn on model multiplexing: each batch row gets its adapter's
+        per-layer low-rank LATE-FUSION deltas (row 0 = identity). Every
+        layer contributes a side term read off the backbone's
+        activations; the accumulated sum merges into the hidden state
+        ONCE, before the final norm — the residual stream and all K/V
+        writes stay base-model-pure, so cached prefix blocks are
+        shareable across adapters exactly. The banks are fixed-shape
+        arguments, so N adapters still compile the SAME two programs
+        and adapter churn is pure data movement."""
         cfg = self.config
         b, s = input_ids.shape
         x = self.embed.astype(cfg.dtype)[input_ids]
         positions = row_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
         new_arenas = []
+        side_sum = None
         for i, blk in enumerate(self.blocks):
             k_a, v_a = arenas[i]
             lora = None
             if lora_banks is not None:
-                aq, bq, av, bv = lora_banks[i]
-                lora = (aq, bq, av, bv, adapter_idx)
-            x, layer_cache = blk(x, positions,
-                                 cache=(k_a, v_a, block_tables, write_mask),
-                                 lora=lora)
+                aq, bq, ao, bo = lora_banks[i]
+                lora = (aq, bq, ao, bo, adapter_idx)
+            x, layer_cache, side = blk(
+                x, positions, cache=(k_a, v_a, block_tables, write_mask),
+                lora=lora)
+            if side is not None:
+                side_sum = side if side_sum is None else side_sum + side
             new_arenas.append((layer_cache[0], layer_cache[1]))
+        if side_sum is not None:
+            x = x + side_sum.astype(x.dtype)
         x = self.final_norm(x)
         return self.lm_head(x), new_arenas
 
@@ -361,29 +385,37 @@ def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int,
 
 
 def lora_bank_shapes(cfg: LlamaConfig, n_rows: int, rank: int):
-    """Per-layer bank shapes (aq, bq, av, bv): one row per resident
-    adapter, row 0 reserved as the zero identity. q targets the full
-    head width, v the kv-head width (grouped-query attention)."""
+    """Per-layer bank shapes (aq, bq, ao, bo): one row per resident
+    adapter, row 0 reserved as the zero identity. Both pairs are
+    LATE-FUSION taps targeting the embedding: aq/bq read the block's
+    attn-normed input, ao/bo the flattened attention mixer output. The
+    deltas never enter the residual stream (they merge once, before the
+    final norm), which keeps the paged KV arena adapter-invariant — the
+    radix prefix cache shares cached blocks across tenants because of
+    it."""
     return ((n_rows, cfg.n_embd, rank),
-            (n_rows, rank, cfg.n_head * cfg.head_dim),
-            (n_rows, cfg.n_embd, rank),
-            (n_rows, rank, cfg.n_kv_head * cfg.head_dim))
+            (n_rows, rank, cfg.n_embd),
+            (n_rows, cfg.n_head * cfg.head_dim, rank),
+            (n_rows, rank, cfg.n_embd))
 
 
 def lora_bank_shardings(cfg: LlamaConfig, mesh):
-    """NamedShardings for one layer's (aq, bq, av, bv) bank: the B
-    matrices' output dims split over "tp" WITH the heads they feed
-    (bq -> q heads, bv -> kv heads); the A matrices replicate (their
-    rank dim is tiny). Mirrors arena_sharding's no-trailing-None
-    discipline so bank reloads can never perturb the jit cache key."""
+    """NamedShardings for one layer's (aq, bq, ao, bo) bank: ao's INPUT
+    dim splits over "tp" WITH the flattened heads it reads (its rank-dim
+    partial sums reduce exactly where wo's do); aq, bq and bo replicate
+    (rank/embed dims are tiny or already replicated). Mirrors
+    arena_sharding's no-trailing-None discipline so bank reloads can
+    never perturb the jit cache key."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     validate_tp(cfg, _mesh_tp(mesh))
     del jax
     rep = NamedSharding(mesh, P())
-    split = NamedSharding(mesh, P(None, None, "tp"))
-    return (rep, split, rep, split)
+    return (rep,
+            rep,
+            NamedSharding(mesh, P(None, "tp")),
+            rep)
 
 
 def make_adapter_weights(cfg: LlamaConfig, rank: int, seed: int,
@@ -392,7 +424,7 @@ def make_adapter_weights(cfg: LlamaConfig, rank: int, seed: int,
     always yields the SAME weights, so a respawned replica reloading an
     adapter on demand — or a dedicated replica built for the parity
     proof — is bit-identical to the original. Returns per-layer
-    (aq_row, bq_row, av_row, bv_row) numpy arrays in the model dtype."""
+    (aq_row, bq_row, ao_row, bo_row) numpy arrays in the model dtype."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
@@ -400,9 +432,9 @@ def make_adapter_weights(cfg: LlamaConfig, rank: int, seed: int,
     out = []
     for _ in range(cfg.n_layer):
         rows = []
-        for shape in ((cfg.n_embd, rank), (rank, cfg.n_head * cfg.head_dim),
-                      (cfg.n_embd, rank),
-                      (rank, cfg.n_kv_head * cfg.head_dim)):
+        for shape in ((cfg.n_embd, rank), (rank, cfg.n_embd),
+                      (cfg.n_head * cfg.head_dim, rank),
+                      (rank, cfg.n_embd)):
             w = rng.standard_normal(shape, dtype=np.float32) * scale
             rows.append((w * 1.0).astype(dt))  # ml_dtypes casts in numpy
         out.append(tuple(rows))

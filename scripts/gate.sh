@@ -58,6 +58,13 @@ step "chaos smoke (seeded, 1 node kill)" \
 # buffers (exit nonzero on any hang/unbounded-recompute/leak).
 step "ingest smoke (seeded node kill mid-shuffle)" \
   env JAX_PLATFORMS=cpu python bench.py --ingest-smoke
+# Inference smoke: prefix-cache A/B over one seeded shared-prefix trace
+# plus spec-decode quick runs, <60s — hard asserts on ZERO recompiles
+# (prefill/decode/draft/propose/verify), ZERO leaked blocks on every
+# arm, a nonzero radix hit rate, and the target-as-draft acceptance
+# upper bound (exit nonzero on any invariant breach).
+step "inference smoke (prefix cache + spec decode)" \
+  env JAX_PLATFORMS=cpu python bench.py --inference-smoke
 # 100-node envelope smoke: placement at width + one seeded node kill with
 # AUTOSCALER-driven replacement, bounded — zero hangs, zero lost tasks,
 # lease-cache invalidation asserted (no stale-lease double execution).

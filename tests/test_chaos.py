@@ -395,11 +395,16 @@ def test_serve_stuck_transition_fails_loudly():
 
 @pytest.mark.slow
 def test_multiplexed_replica_kill_reloads_adapters_no_leaks():
-    """ISSUE 11 satellite: the adapter-multiplexed replica joins the
-    chaos victim set. Kill the one replica holding N adapters; the
-    controller respawns it, requests reload each adapter ON DEMAND
-    (same seeds => token-identical outputs), the rebuilt arena holds
-    zero leaked blocks, and recovery stays under the deadline."""
+    """ISSUE 11 satellite + ISSUE 16 warm-radix-tree extension: the
+    adapter-multiplexed replica joins the chaos victim set WITH a warm
+    radix prefix cache. The three adapters share one prompt, so the
+    baselines cross-share cached prefix blocks on one arena (KV is
+    adapter-invariant under q/o LoRA targeting). Kill the replica; the
+    controller respawns it, requests reload each adapter ON DEMAND and
+    rebuild the radix tree from scratch (same seeds => token-identical
+    outputs, warm or cold), the rebuilt arena holds zero leaked blocks
+    beyond the cache's own donations, and recovery stays under the
+    deadline."""
     from ray_tpu import serve
     from ray_tpu.inference import LLMServer
 
@@ -408,19 +413,28 @@ def test_multiplexed_replica_kill_reloads_adapters_no_leaks():
     try:
         adapters = {"m-a": {"seed": 11}, "m-b": {"seed": 22},
                     "m-c": {"seed": 33}}
+        # block_size 4: the 9-token shared prompt caches 2 full blocks,
+        # so adapters b/c hit adapter a's donated prefix.
         handle = serve.run(LLMServer.options(
             name="mux", num_replicas=1,
-            max_concurrent_queries=16).bind("tiny", 256, 8, None, adapters))
+            max_concurrent_queries=16).bind(
+                "tiny", 256, 8, {"block_size": 4, "max_blocks_per_seq": 16},
+                adapters))
 
         def gen(mid, timeout=120):
             return ray_tpu.get(handle.generate.remote(
-                {"ids": [1, 2, 3], "max_new_tokens": 6,
+                {"ids": [1, 2, 3, 4, 5, 6, 7, 8, 9], "max_new_tokens": 6,
                  "model_id": mid}), timeout=timeout)
 
         baseline = {mid: gen(mid) for mid in adapters}
         pre = ray_tpu.get(handle.metrics.remote(None), timeout=30)
         assert sorted(pre["adapters"]["resident"]) == sorted(adapters)
-        assert pre["kv"]["blocks_in_use"] == 0     # drained, no leaks
+        # Cross-adapter prefix sharing: the 2nd and 3rd adapters' shared
+        # prompt hit the 1st's cached blocks.
+        assert pre["prefix_cache"]["hits"] >= 2, pre["prefix_cache"]
+        # Drained: the only arena references are the cache's donations.
+        assert (pre["kv"]["blocks_in_use"]
+                == pre["prefix_cache"]["cached_blocks"] > 0), pre
 
         # SIGKILL-equivalent: the replica actor dies with 3 resident
         # adapters; the controller's health check replaces it.
@@ -442,17 +456,26 @@ def test_multiplexed_replica_kill_reloads_adapters_no_leaks():
         assert mttr_s < 60.0, f"MTTR {mttr_s:.1f}s exceeds the deadline"
         wd.assert_no_hangs()
 
-        # On-demand reload, token-identical to the pre-crash replica.
+        # On-demand reload, token-identical to the pre-crash replica —
+        # the first post-crash gen ran against a COLD tree, proving
+        # cached and uncached paths emit the same bytes.
         assert recovered == baseline["m-b"]
         for mid in ("m-a", "m-c"):
+            assert gen(mid) == baseline[mid], mid
+        # Second pass: now the rebuilt tree is warm again — every
+        # adapter's generation must hit it and stay bit-identical.
+        for mid in adapters:
             assert gen(mid) == baseline[mid], mid
         post = ray_tpu.get(handle.metrics.remote(None), timeout=30)
         # The fresh replica loaded exactly the adapters requested since
         # the crash (on demand — not a bulk restore at spawn).
         assert sorted(post["adapters"]["resident"]) == sorted(adapters)
         assert post["adapters"]["loads"] == 3
-        # Zero leaked arena blocks across the kill/respawn/reload cycle.
-        assert post["kv"]["blocks_in_use"] == 0, post["kv"]
+        assert post["prefix_cache"]["hits"] >= 3, post["prefix_cache"]
+        # Zero leaked arena blocks across the kill/respawn/reload/rewarm
+        # cycle: in-use equals exactly the warm tree's donations.
+        assert (post["kv"]["blocks_in_use"]
+                == post["prefix_cache"]["cached_blocks"] > 0), post["kv"]
         assert post["prefill_compiles"] == 1 and \
             post["decode_compiles"] == 1, post
     finally:

@@ -123,6 +123,7 @@ def test_get_timeout(ray_start_shared):
         ray_tpu.get(ref, timeout=0.3)
 
 
+@pytest.mark.slow  # >10s wall; tier-1 truncation headroom (gate.sh runs full suite)
 def test_nested_tasks(ray_start_shared):
     @ray_tpu.remote
     def outer(n):

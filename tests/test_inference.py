@@ -83,6 +83,147 @@ def test_block_manager_cow_exhaustion_degrades():
     bm.check_consistency()
 
 
+def test_block_manager_randomized_fuzz():
+    """Seeded fork/append/free fuzz: any interleaving of COW forks,
+    appends, frees and radix-style table adoptions keeps the refcount
+    invariants (`check_consistency` after EVERY op) and a full drain
+    returns the arena to empty — the zero-leak contract the engine's
+    `check_no_leaks` builds on."""
+    import random
+
+    rng = random.Random(0x5EED)
+    bm = BlockManager(num_blocks=25, block_size=4)
+    tokens = {}                        # live seq_id -> token count
+    spawned = 0
+    for _ in range(600):
+        roll = rng.random()
+        if roll < 0.35 or not tokens:              # new sequence
+            sid = f"s{spawned}"
+            spawned += 1
+            n = rng.randint(1, 12)
+            bm.register(sid)
+            if bm.ensure(sid, n):
+                tokens[sid] = n
+            else:                                  # pool full: back out
+                bm.free(sid)
+        elif roll < 0.60:                          # append one token
+            sid = rng.choice(sorted(tokens))
+            cow = bm.ensure_appendable(sid)
+            if cow is not None and cow[1] == -1:
+                pass                               # COW exhausted: no-op
+            elif bm.ensure(sid, tokens[sid] + 1):
+                tokens[sid] += 1
+        elif roll < 0.75:                          # fork (shared prefix)
+            child = f"s{spawned}"
+            spawned += 1
+            parent = rng.choice(sorted(tokens))
+            bm.fork(parent, child)
+            tokens[child] = tokens[parent]
+        elif roll < 0.85:                          # adopt (radix-style)
+            twin = f"s{spawned}"
+            spawned += 1
+            donor = rng.choice(sorted(tokens))
+            bm.register_with_blocks(twin, bm.block_table(donor))
+            tokens[twin] = tokens[donor]
+        else:                                      # free
+            sid = rng.choice(sorted(tokens))
+            bm.free(sid)
+            del tokens[sid]
+        bm.check_consistency()
+        assert bm.blocks_in_use() <= bm.capacity
+    for sid in sorted(tokens):
+        bm.free(sid)
+        bm.check_consistency()
+    assert bm.blocks_in_use() == 0 and bm.num_seqs() == 0
+
+
+# --------------------------------------------------------------------- #
+# Radix prefix cache (pure bookkeeping, no jax)
+# --------------------------------------------------------------------- #
+
+
+def test_radix_cache_insert_match_split_evict():
+    from ray_tpu.inference.kv_cache import RadixPrefixCache
+
+    bm = BlockManager(num_blocks=17, block_size=4)
+    cache = RadixPrefixCache(bm)
+    bm.register("donor")
+    assert bm.ensure("donor", 12)
+    table = list(bm.block_table("donor"))
+    assert cache.insert(list(range(12)), table) == 3   # 3 novel blocks
+    # The donor frees; the cache's synthetic table keeps the KV alive.
+    assert bm.free("donor") == 0
+    cache.check_consistency()
+    assert cache.cached_blocks() == 3 == bm.blocks_in_use()
+
+    # Full-prefix hit returns the donor's physical blocks in order.
+    hit, node = cache.match(list(range(12)))
+    assert hit == table and node is not None
+
+    # Partial match splits the edge so the returned node covers EXACTLY
+    # the matched span (pinning it protects nothing extra).
+    hit2, node2 = cache.match(list(range(8)) + [77, 78, 79, 80])
+    assert hit2 == table[:2]
+    cache.check_consistency()
+    cache.pin(node2)
+
+    # Adoption: a reader increfs the cached blocks, frees its own ref.
+    bm.register_with_blocks("reader", hit2)
+    bm.check_consistency()
+    assert bm.free("reader") == 0          # cache still holds them
+    assert cache.cached_blocks() == 3
+
+    # Eviction is LRU over UNPINNED leaves: the pinned 2-block prefix
+    # survives unbounded pressure; only the unpinned tail leaf goes.
+    assert cache.evict_for(1000) == 1
+    assert cache.cached_blocks() == 2
+    cache.unpin(node2)
+    assert cache.evict_for(1000) == 2
+    assert cache.cached_blocks() == 0
+    cache.check_consistency()
+    assert bm.blocks_in_use() == 0
+    s = cache.stats()
+    assert s["lookups"] == 2 and s["hits"] == 2
+    assert s["inserted_blocks"] == 3 and s["evicted_blocks"] == 3
+
+
+def test_radix_cache_dedupes_branches_and_clears():
+    from ray_tpu.inference.kv_cache import RadixPrefixCache
+
+    bm = BlockManager(num_blocks=17, block_size=4)
+    cache = RadixPrefixCache(bm)
+    bm.register("d1")
+    assert bm.ensure("d1", 12)
+    t1 = list(bm.block_table("d1"))
+    cache.insert(list(range(12)), t1)
+    bm.free("d1")
+
+    # Second donor shares the first 8 tokens, diverges in block 3: the
+    # shared span dedupes onto the tree's blocks (the donor's duplicates
+    # return to the pool when it frees), only the novel block is kept.
+    bm.register("d2")
+    assert bm.ensure("d2", 12)
+    t2 = list(bm.block_table("d2"))
+    toks2 = list(range(8)) + [90, 91, 92, 93]
+    assert cache.insert(toks2, t2) == 1
+    assert bm.free("d2") == 2              # the two duplicated blocks
+    cache.check_consistency()
+    assert cache.cached_blocks() == 4 == bm.blocks_in_use()
+
+    # Both branches resolve to their own tails over the shared prefix.
+    hit1, _ = cache.match(list(range(12)))
+    hit2, _ = cache.match(toks2)
+    assert hit1 == t1
+    assert hit2 == t1[:2] + t2[2:]
+    # Partial blocks never match (alphabet is FULL blocks only).
+    hit3, node3 = cache.match(list(range(3)))
+    assert hit3 == [] and node3 is None
+
+    assert cache.clear() == 4
+    cache.check_consistency()
+    assert cache.cached_blocks() == 0 and bm.blocks_in_use() == 0
+
+
 # --------------------------------------------------------------------- #
 # Engine
 # --------------------------------------------------------------------- #
@@ -129,11 +270,13 @@ def _make_engine(tiny_llama, **overrides):
     from ray_tpu.inference import EngineConfig, InferenceEngine
 
     model, params = tiny_llama
+    draft = {k: overrides.pop(k) for k in ("draft_model", "draft_params")
+             if k in overrides}
     kwargs = dict(batch_slots=3, block_size=4, num_blocks=64,
                   max_blocks_per_seq=16, prefill_chunk=8)
     kwargs.update(overrides)
     return InferenceEngine(EngineConfig(**kwargs), model=model,
-                           params=params)
+                           params=params, **draft)
 
 
 def test_engine_matches_reference_and_compiles_once(tiny_llama):
@@ -201,9 +344,13 @@ def test_preemption_recovers_and_leaks_nothing(tiny_llama):
     assert a.preemptions == 0 and b.preemptions >= 1
     assert a.generated == _reference_generate(model, params, a.prompt, 10)
     assert b.generated == _reference_generate(model, params, b.prompt, 10)
-    # The victim's blocks were freed and re-acquired; nothing leaked.
+    # The victim's blocks were freed and re-acquired; nothing leaked —
+    # the only remaining references are the radix cache's donations,
+    # and dropping those drains the arena to empty.
     engine.check_no_leaks()
-    assert stats["kv"]["blocks_in_use"] == 0
+    engine.drop_prefix_cache()
+    engine.check_no_leaks()
+    assert engine.stats()["kv"]["blocks_in_use"] == 0
     assert stats["decode_compiles"] == 1   # preemption didn't recompile
 
 
@@ -325,6 +472,234 @@ def test_static_gang_holds_results_until_drain(tiny_llama):
 
 
 # --------------------------------------------------------------------- #
+# Radix prefix cache through the engine
+# --------------------------------------------------------------------- #
+
+
+def test_prefix_cache_hit_skips_prefill_no_new_programs(tiny_llama):
+    """Acceptance: a repeated prompt adopts its cached blocks (skipping
+    their prefill), produces bit-identical output, and compiles ZERO new
+    XLA programs on the cached path."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama)              # block_size=4
+    prompt = list(range(1, 10))                    # 9 tokens
+    ref = _reference_generate(model, params, prompt, 6)
+    a = engine.add_request(prompt, max_new_tokens=6)
+    engine.run_until_idle()
+    assert a.generated == ref and a.cached_tokens == 0
+    s0 = engine.stats()["prefix_cache"]
+    assert s0["cached_blocks"] >= 2 and s0["hits"] == 0
+
+    b = engine.add_request(prompt, max_new_tokens=6)
+    engine.run_until_idle()
+    assert b.generated == ref
+    # Match is block-aligned and capped one token short of the stream:
+    # 8 of the 9 prompt tokens ride the cache, one still prefills.
+    assert b.cached_tokens == 8
+    st = engine.stats()
+    assert st["prefix_cache"]["hits"] == 1
+    assert st["prefix_cache"]["hit_tokens"] == 8
+    assert 0.0 < st["prefix_cache"]["hit_rate"] <= 1.0
+    assert st["prefill_compiles"] == 1 and st["decode_compiles"] == 1
+    engine.check_no_leaks()
+    engine.drop_prefix_cache()
+    engine.check_no_leaks()
+    assert engine.stats()["kv"]["blocks_in_use"] == 0
+
+
+def test_prefix_cache_evicts_under_arena_pressure(tiny_llama):
+    """A cold cached prefix yields its blocks to live traffic: the big
+    request fits by evicting cache leaves, not by preempting/failing."""
+    engine = _make_engine(tiny_llama, use_jit=False, batch_slots=1,
+                          num_blocks=13, block_size=4,
+                          max_blocks_per_seq=12, prefill_chunk=8)
+    engine.add_request(list(range(1, 9)), max_new_tokens=4)
+    engine.run_until_idle()
+    assert engine.stats()["prefix_cache"]["cached_blocks"] >= 2
+    big = engine.add_request(list(range(100, 140)), max_new_tokens=6)
+    engine.run_until_idle()
+    assert big.state == "FINISHED"
+    st = engine.stats()
+    assert st["prefix_cache"]["evicted_blocks"] >= 1
+    assert st["preemptions"] == 0
+    engine.check_no_leaks()
+
+
+def test_prefix_cache_live_sequence_pins_its_path(tiny_llama):
+    """A decoding sequence pins its matched node: even direct maximal
+    eviction pressure must not reclaim blocks its KV reads through."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama, use_jit=False)
+    prompt = list(range(1, 10))
+    engine.add_request(prompt, max_new_tokens=3)
+    engine.run_until_idle()                        # primes the cache
+    slow = engine.add_request(prompt, max_new_tokens=12)
+    while slow.state != "DECODE":
+        engine.step()
+    assert slow.cached_tokens == 8
+    assert engine.stats()["prefix_cache"]["pinned_nodes"] == 1
+    engine._prefix.evict_for(10_000)               # maximal pressure
+    # The pinned 2-block path survived; only unpinned tails could go.
+    assert engine.stats()["prefix_cache"]["cached_blocks"] >= 2
+    engine.run_until_idle()
+    assert slow.generated == _reference_generate(model, params, prompt, 12)
+    assert engine.stats()["prefix_cache"]["pinned_nodes"] == 0
+    engine.check_no_leaks()
+
+
+def test_fail_all_clears_prefix_cache_and_recovers(tiny_llama):
+    """The arena rebuild invalidates every cached block's contents, so
+    fail_all must drop the tree with it — and the engine re-primes."""
+    engine = _make_engine(tiny_llama, use_jit=False)
+    a = engine.add_request(list(range(1, 9)), max_new_tokens=4)
+    engine.run_until_idle()
+    assert engine.stats()["prefix_cache"]["cached_blocks"] > 0
+    engine.fail_all("injected")
+    st = engine.stats()
+    assert st["prefix_cache"]["cached_blocks"] == 0
+    assert st["kv"]["blocks_in_use"] == 0
+    b = engine.add_request(list(range(1, 9)), max_new_tokens=4)
+    engine.run_until_idle()
+    assert b.generated == a.generated
+    assert engine.stats()["prefix_cache"]["cached_blocks"] > 0
+    engine.check_no_leaks()
+
+
+# --------------------------------------------------------------------- #
+# Speculative decoding
+# --------------------------------------------------------------------- #
+
+
+def test_spec_decode_lossless_and_compiles_once(tiny_llama):
+    """Greedy spec decode is LOSSLESS: with the default truncated-target
+    draft the output is bit-identical to the dense reference, and the
+    three spec programs (draft prefill / propose / verify) each compile
+    exactly once across mixed admissions."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama, spec_decode_draft_len=3)
+    reqs = [engine.add_request([1 + i, 2 + i, 3 + i], max_new_tokens=6)
+            for i in range(3)]
+    engine.run_until_idle()
+    for r in reqs:
+        assert r.generated == _reference_generate(model, params,
+                                                  r.prompt, 6), r.request_id
+    sd = engine.stats()["spec_decode"]
+    assert sd["draft_len"] == 3 and sd["rounds"] > 0
+    assert sum(sd["accepted_hist"]) == sd["rounds"]
+    assert sd["draft_prefill_compiles"] == 1
+    assert sd["propose_compiles"] == 1
+    assert sd["verify_compiles"] == 1
+    assert engine.stats()["prefill_compiles"] == 1
+    engine.check_no_leaks()
+    engine.drop_prefix_cache()
+    assert engine.stats()["kv"]["blocks_in_use"] == 0
+
+
+def test_spec_decode_target_draft_accepts_everything(tiny_llama):
+    """Upper bound: with the target itself as draft every proposal is
+    accepted, so n tokens cost ceil(n / (k+1)) verify rounds."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama, use_jit=False,
+                          spec_decode_draft_len=3,
+                          draft_model=model, draft_params=params)
+    r = engine.add_request([1, 2, 3, 4], max_new_tokens=8)
+    engine.run_until_idle()
+    assert r.generated == _reference_generate(model, params, [1, 2, 3, 4], 8)
+    sd = engine.stats()["spec_decode"]
+    assert sd["accept_rate"] == 1.0
+    assert sd["rounds"] == 2                       # 8 tokens, k+1 = 4 each
+    assert sd["accepted_hist"][3] == 2
+    engine.check_no_leaks()
+
+
+@pytest.mark.slow  # ~15s eager decode; gate.sh runs the full suite
+def test_spec_decode_preemption_rolls_back_without_leaks(tiny_llama):
+    """Rejected drafts and preempted rows under block pressure: the
+    block tables roll back cleanly (no leaked blocks) and the recomputed
+    output stays bit-identical to the reference."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama, use_jit=False,
+                          spec_decode_draft_len=2, batch_slots=2,
+                          block_size=2, num_blocks=9,
+                          max_blocks_per_seq=8, prefill_chunk=4)
+    a = engine.add_request([1, 2, 3], max_new_tokens=10, request_id="a")
+    b = engine.add_request([4, 5, 6], max_new_tokens=10, request_id="b")
+    engine.run_until_idle()
+    assert a.state == b.state == "FINISHED"
+    assert engine.stats()["preemptions"] >= 1
+    assert a.generated == _reference_generate(model, params, a.prompt, 10)
+    assert b.generated == _reference_generate(model, params, b.prompt, 10)
+    engine.check_no_leaks()
+    engine.drop_prefix_cache()
+    assert engine.stats()["kv"]["blocks_in_use"] == 0
+
+
+# --------------------------------------------------------------------- #
+# SLO classes
+# --------------------------------------------------------------------- #
+
+
+def test_slo_interactive_admitted_before_earlier_batch(tiny_llama):
+    """Queue order is (class, arrival): a later interactive arrival
+    takes the next free slot ahead of a queued batch-class request."""
+    engine = _make_engine(tiny_llama, use_jit=False, batch_slots=1)
+    hold = engine.add_request([1, 2], max_new_tokens=6, slo_class="batch")
+    while hold.state != "DECODE":
+        engine.step()
+    bat = engine.add_request([3, 4], max_new_tokens=3, slo_class="batch")
+    inter = engine.add_request([5, 6], max_new_tokens=3,
+                               slo_class="interactive")
+    assert engine.stats()["slo"] == {"reserved_slots": 0,
+                                     "waiting_interactive": 1,
+                                     "waiting_batch": 1}
+    engine.run_until_idle()
+    assert inter.first_token_at < bat.first_token_at
+    engine.check_no_leaks()
+    with pytest.raises(ValueError, match="slo_class"):
+        engine.add_request([1], 1, slo_class="bulk")
+
+
+def test_slo_reserved_slots_hold_headroom_for_interactive(tiny_llama):
+    """With reserved headroom, batch-class admissions never take the
+    last slot(s) — an interactive arrival lands immediately."""
+    engine = _make_engine(tiny_llama, use_jit=False, batch_slots=2,
+                          slo_interactive_reserved_slots=1)
+    b1 = engine.add_request([1, 2], max_new_tokens=8, slo_class="batch")
+    b2 = engine.add_request([3, 4], max_new_tokens=8, slo_class="batch")
+    for _ in range(4):
+        engine.step()
+    assert b1.state in ("PREFILL", "DECODE") and b2.state == "WAITING"
+    i1 = engine.add_request([5, 6], max_new_tokens=2,
+                            slo_class="interactive")
+    engine.run_until_idle()
+    assert all(r.state == "FINISHED" for r in (b1, b2, i1))
+    assert i1.first_token_at < b2.first_token_at
+    engine.check_no_leaks()
+
+
+def test_slo_preemption_prefers_batch_victim(tiny_llama):
+    """Under block pressure the victim is the batch-class sequence even
+    though it arrived FIRST (class outranks age), and both requests
+    still finish with reference-exact output."""
+    model, params = tiny_llama
+    engine = _make_engine(tiny_llama, use_jit=False, batch_slots=2,
+                          block_size=2, num_blocks=9,
+                          max_blocks_per_seq=8, prefill_chunk=4)
+    bat = engine.add_request([1, 2, 3], max_new_tokens=10,
+                             slo_class="batch")
+    inter = engine.add_request([4, 5, 6], max_new_tokens=10,
+                               slo_class="interactive")
+    engine.run_until_idle()
+    assert engine.stats()["preemptions"] >= 1
+    assert inter.preemptions == 0 and bat.preemptions >= 1
+    assert inter.generated == _reference_generate(model, params,
+                                                  inter.prompt, 10)
+    assert bat.generated == _reference_generate(model, params,
+                                                bat.prompt, 10)
+    engine.check_no_leaks()
+
+
+# --------------------------------------------------------------------- #
 # Serve integration
 # --------------------------------------------------------------------- #
 
@@ -356,7 +731,9 @@ def test_llm_server_generate_and_stream_through_serve(ray_start_regular):
         metrics = ray_tpu.get(handle.metrics.remote(None), timeout=60)
         assert metrics["requests_finished"] >= 2
         assert metrics["decode_compiles"] == 1
-        assert metrics["kv"]["blocks_in_use"] == 0
+        # Idle arena holds only the prefix cache's donated blocks.
+        assert (metrics["kv"]["blocks_in_use"]
+                == metrics["prefix_cache"]["cached_blocks"])
         assert "queue_depth" in metrics and "tokens_per_sec" in metrics
     finally:
         serve.shutdown()

@@ -136,9 +136,37 @@ def test_unknown_model_rejected_at_submit(tiny_model):
         plain.add_request([1, 2], 4, model_id="m-a")
 
 
+def test_cross_adapter_prefix_hits_with_parity(tiny_model):
+    """Radix cache (PR 16): the KV arena is adapter-invariant (LoRA
+    deltas are late-fused side contributions merged once before
+    final_norm — the residual stream and every K/V write are base-model
+    pure), so a prefix cached under one adapter hits for every other
+    adapter AND the base model — with output parity vs a cold engine
+    that never saw the donor."""
+    model, params = tiny_model
+    eng = _mux_engine(model, params, capacity=3)
+    prompt = list(range(1, 18))        # 17 tokens -> 16 ride the cache
+    outs = {}
+    for mid in ("m-a", "m-b", None):
+        r = eng.add_request(prompt, 8, model_id=mid)
+        eng.run_until_idle()
+        outs[mid] = list(r.generated)
+    st = eng.stats()
+    assert st["prefix_cache"]["hits"] >= 2, st["prefix_cache"]
+    assert st["prefill_compiles"] == 1 and st["decode_compiles"] == 1
+    eng.check_no_leaks()
+    assert outs["m-a"] != outs["m-b"]  # adapters still steer generation
+    # Cold engines (no warm cache) reproduce every warm-path output.
+    for mid in ("m-a", "m-b"):
+        cold = _mux_engine(model, params, capacity=1)
+        r = cold.add_request(prompt, 8, model_id=mid)
+        cold.run_until_idle()
+        assert list(r.generated) == outs[mid], mid
+
+
 def test_tp2_multiplexed_parity(multi_device_workers, tiny_model):
     """Acceptance: adapter outputs are token-identical through a tp=2
-    mesh (banks shard their B output dims WITH the heads), with the
+    mesh (the A_o bank shards its input dim WITH the heads), with the
     compile-once discipline intact."""
     from ray_tpu.parallel.mesh import MeshSpec, build_mesh
 
@@ -154,6 +182,41 @@ def test_tp2_multiplexed_parity(multi_device_workers, tiny_model):
         stats = eng.stats()
         assert stats["prefill_compiles"] == 1, (name, stats)
         assert stats["decode_compiles"] == 1, (name, stats)
+        eng.check_no_leaks()
+    assert outs["single"] == outs["tp2"]
+
+
+@pytest.mark.slow  # ~11s: four extra jitted programs; gate.sh covers it
+def test_tp2_prefix_cache_and_spec_decode_parity(multi_device_workers,
+                                                 tiny_model):
+    """Round-3 features compose with tp=2 sharded arenas: radix hits and
+    speculative decoding stay token-identical through the mesh, with the
+    compile-once discipline intact for every program."""
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    model, params = tiny_model
+    mesh = build_mesh(MeshSpec({"tp": 2}), devices=jax.devices()[:2])
+    prompt = list(range(1, 18))
+    outs = {}
+    for name, m in (("single", None), ("tp2", mesh)):
+        eng = InferenceEngine(
+            EngineConfig(spec_decode_draft_len=2),
+            model=model, params=params, mesh=m,
+            draft_model=model, draft_params=params)
+        warm = eng.add_request(prompt, 8)
+        eng.run_until_idle()               # primes the radix tree
+        hit = eng.add_request(prompt, 8)
+        other = eng.add_request([9, 8, 7], 6)
+        eng.run_until_idle()
+        outs[name] = [list(r.generated)
+                      for r in (warm, hit, other)]
+        st = eng.stats()
+        assert hit.cached_tokens == 16, (name, hit.cached_tokens)
+        assert st["prefix_cache"]["hits"] >= 1, (name, st["prefix_cache"])
+        assert st["spec_decode"]["accept_rate"] == 1.0, (name, st)
+        assert st["spec_decode"]["propose_compiles"] == 1, (name, st)
+        assert st["spec_decode"]["verify_compiles"] == 1, (name, st)
+        assert st["prefill_compiles"] == 1, (name, st)
         eng.check_no_leaks()
     assert outs["single"] == outs["tp2"]
 

@@ -111,6 +111,7 @@ def test_rollout_worker_sample_layout():
     assert stats["episode_reward_mean"] > 5
 
 
+@pytest.mark.slow  # >10s wall; tier-1 truncation headroom (gate.sh runs full suite)
 def test_ppo_solves_cartpole(ray_start_shared):
     """North-star learning test (reference rllib_learning_tests_*):
     PPO through actor rollout workers reaches reward >= 150."""
@@ -250,6 +251,7 @@ def test_impala_smoke_and_batch_shapes(ray_start_shared):
         algo.stop()
 
 
+@pytest.mark.slow  # >10s wall; tier-1 truncation headroom (gate.sh runs full suite)
 def test_impala_learns_cartpole(ray_start_shared):
     """Second north-star workload (BASELINE.md: IMPALA async sampling +
     TPU learner): must reach reward >= 150 through async actor workers."""

@@ -44,6 +44,7 @@ def test_breakout_ppo_learns():
         f"Breakout PPO made no progress: {result.curve[-5:]}")
 
 
+@pytest.mark.slow  # >10s wall; tier-1 truncation headroom (gate.sh runs full suite)
 def test_atari_native_shape_pipeline(ray_start_shared):
     """The full Atari preprocessing pipeline at the NATIVE 210x160x3 uint8
     shape — grayscale+resize to 84x84, framestack 4, CNN module, actor
