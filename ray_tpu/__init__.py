@@ -275,6 +275,25 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     _require_runtime().cancel(ref.object_id, force=force)
 
 
+# ------------------------------------------------------------ job-scoped KV
+
+
+def kv_put(key: str, value: bytes, namespace: Optional[str] = None) -> None:
+    """Store small metadata in the cluster KV, scoped to the calling
+    job: keys live under a `job:<id>:` prefix and are purged when the
+    job finishes — cross-job sharing goes through named detached actors
+    or storage, never the KV."""
+    _require_runtime().kv_put(key, value, namespace)
+
+
+def kv_get(key: str, namespace: Optional[str] = None) -> Optional[bytes]:
+    return _require_runtime().kv_get(key, namespace)
+
+
+def kv_del(key: str, namespace: Optional[str] = None) -> None:
+    _require_runtime().kv_del(key, namespace)
+
+
 # ----------------------------------------------------------------- cluster
 
 
@@ -332,4 +351,5 @@ __all__ = [
     "put", "get", "wait", "get_actor", "kill", "cancel", "nodes",
     "cluster_resources", "available_resources", "timeline", "ObjectRef",
     "ActorHandle", "ActorClass", "RemoteFunction",
+    "kv_put", "kv_get", "kv_del",
 ]

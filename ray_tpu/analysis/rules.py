@@ -34,6 +34,11 @@ for the catalog with real before/after examples):
                                   one call hop to prove the helper
                                   replies, parks, or hands off on every
                                   path
+- RL018 job-scoped-state       — dicts keyed by job identifiers are
+                                  evicted on a job-teardown path (the
+                                  multi-job platform's churn contract:
+                                  job state dies WITH the job, not with
+                                  an unrelated LRU — docs/JOBS.md)
 
 (RL014 rpc-contract, RL015 config-knob-drift and RL016
 loop-confined-escape are whole-program rules — they live in
@@ -1213,11 +1218,16 @@ def _rl011_dict_attrs(cls: ast.ClassDef) -> Dict[str, int]:
     return out
 
 
-def _rl011_cleaned_attrs(cls: ast.ClassDef) -> Set[str]:
-    """Attrs with eviction/handoff evidence anywhere in the class."""
+def _rl011_cleaned_attrs(cls: ast.ClassDef,
+                         method_ok=None) -> Set[str]:
+    """Attrs with eviction/handoff evidence anywhere in the class.
+    `method_ok(name)` restricts which methods count as evidence sites
+    (RL018 passes its teardown-name filter; RL011 accepts any)."""
     out: Set[str] = set()
     for fn in cls.body:
         if not isinstance(fn, _FUNC_NODES):
+            continue
+        if method_ok is not None and not method_ok(fn.name):
             continue
         init = fn.name == "__init__"
         for node in ast.walk(fn):
@@ -1890,3 +1900,130 @@ def rl017_deferred_reply_completeness(ctx: FileContext
                             "replying — the parked caller would hang; "
                             "wrap it so every exception path also "
                             "replies")
+
+
+# =====================================================================
+# RL018 job-scoped-state
+# =====================================================================
+#
+# RL011 specialized to the multi-job platform's churn contract
+# (docs/JOBS.md "Job-scoped isolation"): control-plane state keyed by a
+# JOB identifier (job_id / job_hex / submission_id) must be evicted on a
+# job-TEARDOWN path, not merely "somewhere". Jobs are the tenancy unit —
+# they arrive and finish forever on a shared cluster, so a per-job entry
+# that survives its job is a leak with a guaranteed driver (every
+# submission grows it by one), and an entry evicted only by an unrelated
+# LRU/TTL is a correctness hazard: a recycled job id would inherit the
+# previous tenant's quota, forge refs, or KV. Statically checkable
+# shape:
+#
+#   class Admission:                        # control-plane module
+#       def __init__(self):
+#           self._jobs = {}                 # dict attribute born empty
+#       def admit(self, job_hex):
+#           self._jobs[job_hex] = now()     # job-keyed steady-state write
+#
+# with NO eviction evidence for that attribute inside any
+# teardown-shaped method — one whose name says it runs when a job (or
+# the hosting object) dies: finish/terminal/unregister/release/reclaim/
+# sweep/stop/shutdown/cleanup/close/purge/expire/evict/prune/dead/gc.
+# Eviction in such a method (pop/del/clear, wholesale reassignment, or a
+# bare handoff to a pruner) is the evidence the contract asks for.
+#
+# State that is genuinely bounded without per-job eviction (e.g. keyed
+# by a fixed roster the checker cannot see) annotates with
+# `# raylint: disable=RL018 — <why the key space is bounded>`.
+
+_RL018_PACKAGES = _RL011_PACKAGES | {"jobs", "job_submission"}
+
+_RL018_TEARDOWN_RE = re.compile(
+    r"(finish|terminal|unregister|release|reclaim|sweep|stop|shutdown|"
+    r"cleanup|close|purge|expire|evict|prune|dead|reap|delete|remove|gc)",
+    re.I)
+
+_RL018_JOBISH_RE = re.compile(r"(job|submission)", re.I)
+
+
+def _in_scope_rl018(path: str) -> bool:
+    # RL011's real-location scoping, widened to the jobs packages.
+    parts = os.path.abspath(path).replace("\\", "/").split("/")
+    for idx in range(len(parts) - 1, -1, -1):
+        if parts[idx] != "ray_tpu":
+            continue
+        root = "/".join(parts[:idx + 1])
+        if os.path.isfile(os.path.join(root, "__init__.py")):
+            return (len(parts) > idx + 2
+                    and parts[idx + 1] in _RL018_PACKAGES)
+    return True
+
+
+def _rl018_jobish_key(key: ast.AST) -> bool:
+    """Does the key expression mention a job-shaped identifier?"""
+    for node in ast.walk(key):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and (_RL018_JOBISH_RE.search(name)
+                     or name in ("sid", "jid")):
+            return True
+    return False
+
+
+def _rl018_job_keyed_writes(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """Attr -> first steady-state write whose key is job-derived."""
+    out: Dict[str, ast.AST] = {}
+    for fn in cls.body:
+        if not isinstance(fn, _FUNC_NODES) or fn.name == "__init__":
+            continue
+        for node in ast.walk(fn):
+            attr, key = None, None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        a = _rl011_self_attr(tgt.value)
+                        if a:
+                            attr, key = a, tgt.slice
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setdefault" and node.args:
+                a = _rl011_self_attr(node.func.value)
+                if a:
+                    attr, key = a, node.args[0]
+            if attr is None or isinstance(key, ast.Constant) \
+                    or not _rl018_jobish_key(key):
+                continue
+            if attr not in out or node.lineno < out[attr].lineno:
+                out[attr] = node
+    return out
+
+
+@rule("RL018", "job-scoped-state: per-job keyed dict with no eviction "
+               "on a job-teardown path")
+def rl018_job_scoped_state(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl018(ctx.path):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        dicts = _rl011_dict_attrs(cls)
+        if not dicts:
+            continue
+        cleaned = _rl011_cleaned_attrs(
+            cls, method_ok=lambda n: bool(_RL018_TEARDOWN_RE.search(n)))
+        writes = _rl018_job_keyed_writes(cls)
+        for attr, node in sorted(writes.items(),
+                                 key=lambda kv: kv[1].lineno):
+            if attr not in dicts or attr in cleaned:
+                continue
+            yield ctx.finding(
+                node, "RL018",
+                f"`self.{attr}` is keyed by a job identifier but no "
+                f"teardown-shaped method of {cls.name} ever removes an "
+                "entry — job-scoped state must die with its job "
+                "(docs/JOBS.md): evict it on the job-finished/"
+                "unregister/sweep path or annotate why the key space "
+                "is bounded")

@@ -206,6 +206,10 @@ class JobInfo:
     start_time: float = field(default_factory=time.time)
     end_time: Optional[float] = None
     namespace: str = "default"
+    # Links a driver job to the submitted-job record that launched it
+    # (empty for interactive drivers): job-tier status, logs, and tenant
+    # QoS resolve through this.
+    submission_id: str = ""
 
 
 class PlacementStrategy(str, Enum):

@@ -249,6 +249,23 @@ _flag("slo_interactive_reserved_slots", int, 0,
       "admitted while more than this many slots stay free, so a bulk "
       "flood cannot occupy the whole batch ahead of an interactive "
       "arrival. 0 disables; capped at batch_slots - 1")
+_flag("job_agent_enabled", _parse_bool, True,
+      "Route submitted jobs through the per-node job agents (GCS job "
+      "table + driver subprocess on a worker node, checkpointed across "
+      "GCS restarts). False falls back to the legacy in-GCS JobManager "
+      "(driver runs inside the GCS process, no persistence)")
+_flag("job_log_tail_bytes", int, 256 * 1024,
+      "Per-job cap on driver log bytes retained in the GCS log plane "
+      "(oldest lines evicted first); get_job_logs serves this tail")
+_flag("job_default_tenant_weight", float, 4.0,
+      "Dispatch fair-share weight for jobs submitted without a tenant "
+      "(and for interactive drivers) — the silver-tier default, so an "
+      "untenanted job neither starves nor dominates tenanted ones")
+_flag("job_prewarm_forge", _parse_bool, True,
+      "Start a per-runtime-env forge template when a job with preimports "
+      "is submitted, before its first task arrives — the submit-to-"
+      "first-task path then forks from a warm template instead of "
+      "paying template startup inline")
 _flag("log_to_driver", bool, True, "Stream worker logs back to the driver")
 _flag("include_dashboard", bool, True, "Start the HTTP dashboard on the head node")
 _flag("dashboard_port", int, 0, "Dashboard HTTP port; 0 = random free port")

@@ -36,9 +36,11 @@ LEASE_SPEC_NAME = "__lease__"
 
 
 def _env_signature(runtime_env: Optional[Dict[str, Any]]) -> str:
-    if not runtime_env:
-        return ""
-    return repr(sorted((k, repr(v)) for k, v in runtime_env.items()))
+    # One hash end to end: lease keys here, the raylet's granted-env
+    # marker, and per-env forge templates all agree on what "same
+    # runtime environment" means.
+    from ray_tpu.core.runtime_env import env_hash
+    return env_hash(runtime_env)
 
 
 class _Lease:

@@ -43,6 +43,7 @@ from ray_tpu.core.rpc import (
     RpcServer,
 )
 from ray_tpu.exceptions import RaySystemError
+from ray_tpu.jobs import state as _jobstate
 
 logger = logging.getLogger(__name__)
 
@@ -54,6 +55,9 @@ CH_RESOURCES = "RESOURCES"
 CH_ERROR = "ERROR"
 CH_LOG = "LOG"
 CH_PG = "PG"
+# Job lifecycle events for raylets (per-event push, never delta-batched:
+# a "finished" must reclaim workers NOW, not a flush tick later).
+CH_JOB = "JOB"
 
 
 class Pubsub:
@@ -229,6 +233,15 @@ class GcsServer:
         self._inflight_creates: Dict[NodeID, int] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.jobs: Dict[JobID, JobInfo] = {}
+        # Submitted-job table (jobs/state.py records, keyed by submission
+        # id): checkpointed with the other tables so a restarted GCS
+        # still knows every job; terminal records leave via delete_job.
+        self.submitted_jobs: Dict[str, Dict[str, Any]] = {}
+        # Per-job driver-log tail (bounded by job_log_tail_bytes each);
+        # entries die with their job record (delete_job / _finish_job has
+        # no claim here — logs outlive the driver so clients can read a
+        # FAILED job's output).
+        self.submitted_job_logs: Dict[str, deque] = {}
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
         self._kv_access_order: Dict[Tuple[str, bytes], int] = {}
         self._kv_access_ts: Dict[Tuple[str, bytes], float] = {}
@@ -306,6 +319,22 @@ class GcsServer:
                 target=self._persist_loop, name="gcs-persist", daemon=True)
             self._persist_thread.start()
             self._reschedule_unresolved_actors()
+            self._reschedule_submitted_jobs()
+
+    def _reschedule_submitted_jobs(self):
+        """GCS failover: jobs restored as SUBMITTED had their dispatch in
+        flight (or parked) when the previous incarnation died — re-kick
+        each; dispatch parks again if no node is alive yet (raylets are
+        still reconnecting) and register_node re-kicks on arrival.
+        RUNNING jobs need no kick: their agent keeps supervising through
+        the outage and the reconnecting raylet's register_node carries
+        the reconcile list."""
+        with self._lock:
+            pending = [sid for sid, rec in self.submitted_jobs.items()
+                       if rec["state"] == _jobstate.SUBMITTED]
+        for sid in pending:
+            logger.info("GCS failover: re-dispatching submitted job %s", sid)
+            self._exec.submit(self._dispatch_submitted_job, sid)
 
     def _reschedule_unresolved_actors(self):
         """GCS failover: actor creations/restarts that were IN FLIGHT when
@@ -373,6 +402,8 @@ class GcsServer:
                 "kv": self.kv,
                 "placement_groups": self.placement_groups,
                 "job_counter": self._job_counter,
+                "submitted_jobs": self.submitted_jobs,
+                "submitted_job_logs": self.submitted_job_logs,
             })
         # Serialized writers (stop() vs the persist loop) + fsync + atomic
         # replace: a reader never sees a torn or interleaved snapshot, and
@@ -431,6 +462,9 @@ class GcsServer:
         self.kv = state["kv"]
         self.placement_groups = state["placement_groups"]
         self._job_counter = state["job_counter"]
+        # .get(): snapshots from before the job tier lack these tables.
+        self.submitted_jobs = state.get("submitted_jobs", {})
+        self.submitted_job_logs = state.get("submitted_job_logs", {})
         # The outage shouldn't count against liveness: give every node a
         # fresh heartbeat window before health checks may declare it dead.
         now = time.time()
@@ -472,6 +506,30 @@ class GcsServer:
         # set and fail over an actor that is coming up right now.
         if data.get("reconcile_actors"):
             self._exec.submit(self._reconcile_node_actors, info.node_id)
+        # Job reconcile: RUNNING submitted jobs the table places on this
+        # node but that the (re)registering agent does not actually
+        # supervise died with the old raylet incarnation — their terminal
+        # report went nowhere. `running_jobs` is authoritative: the agent
+        # fate-shares with its drivers' supervision threads.
+        agent_jobs = set(data.get("running_jobs") or ())
+        node_hex = info.node_id.hex()
+        lost: List[str] = []
+        parked: List[str] = []
+        with self._lock:
+            for sid, rec in self.submitted_jobs.items():
+                if rec["node_id"] == node_hex and \
+                        rec["state"] == _jobstate.RUNNING and \
+                        sid not in agent_jobs:
+                    lost.append(sid)
+                elif rec["state"] == _jobstate.SUBMITTED and \
+                        rec["node_id"] is None:
+                    parked.append(sid)  # submit arrived before any node
+        for sid in lost:
+            self._job_terminal_transition(
+                sid, _jobstate.FAILED,
+                f"node {node_hex[:12]} restarted; driver lost")
+        for sid in parked:
+            self._exec.submit(self._dispatch_submitted_job, sid)
         self.pubsub.publish(CH_NODE, b"*", {"event": "alive", "node": info.to_public()})
         self._broadcast_resource_view(force=True)
         return {"node_count": len(self.nodes)}
@@ -693,19 +751,59 @@ class GcsServer:
         for name, epoch, rank in hits:
             self._collective_mark_dead(
                 name, epoch, rank, f"node {node_id.hex()[:12]} died: {reason}")
+        # Submitted jobs placed on the dead node fail with it (the
+        # agent's terminal report fate-shared with the raylet). That
+        # includes SUBMITTED-but-dispatched records: the agent may have
+        # spawned the driver just before dying, and re-running the
+        # entrypoint elsewhere would double-execute it — FAILED is the
+        # honest answer; the client owns retry policy.
+        node_hex = node_id.hex()
+        with self._lock:
+            lost = [sid for sid, rec in self.submitted_jobs.items()
+                    if rec["node_id"] == node_hex
+                    and rec["state"] in (_jobstate.SUBMITTED,
+                                         _jobstate.RUNNING)]
+        for sid in lost:
+            self._job_terminal_transition(
+                sid, _jobstate.FAILED,
+                f"node {node_hex[:12]} died: {reason}")
         self._broadcast_resource_view(force=True)
 
     # -------------------------------------------------------- job management
 
     def handle_register_job(self, conn: Connection, data: Dict[str, Any]):
+        sid = data.get("submission_id") or ""
         with self._lock:
             job_id = JobID.from_int(self._job_counter)
             self._job_counter += 1
             info = JobInfo(job_id=job_id, driver_pid=data.get("pid", 0),
                            entrypoint=data.get("entrypoint", ""),
-                           namespace=data.get("namespace", "default"))
+                           namespace=data.get("namespace", "default"),
+                           submission_id=sid)
+            # Table of record (reference GCS job table): finished driver
+            # jobs keep their row for get_jobs/dashboard history — the
+            # job's OWNED state (workers, leases, KV, forge refs) is what
+            # dies with it, via _finish_job's purge + "finished" publish.
+            # raylint: disable=RL018 — retained as the cluster's job history
             self.jobs[job_id] = info
             conn.meta["job_id"] = job_id
+            # Link the driver job to its submission record: job-scoped
+            # cleanup, tenant QoS, and the dashboard resolve through it.
+            rec = self.submitted_jobs.get(sid) if sid else None
+            qos: Dict[str, Any] = {}
+            renv: Dict[str, Any] = {}
+            if rec is not None:
+                rec["driver_job_id"] = job_id.hex()
+                qos = dict(rec["tenant_qos"])
+                renv = dict(rec["runtime_env"])
+        # Every driver — submitted or interactive — announces itself on
+        # the JOB channel: raylets seed their per-job admission entry
+        # (tenant QoS) and, for runtime_env jobs, pre-warm the per-env
+        # forge template before the first task needs a worker.
+        self.pubsub.publish(CH_JOB, b"*", {
+            "event": "running", "job_id": job_id.hex(),
+            "submission_id": sid, "tenant_qos": qos,
+            "runtime_env": renv})
         return {"job_id": job_id}
 
     def handle_reattach_job(self, conn: Connection, data: Dict[str, Any]):
@@ -728,6 +826,7 @@ class GcsServer:
             ]
 
     def _finish_job(self, job_id: JobID, state: str = "SUCCEEDED"):
+        job_hex = job_id.hex()
         with self._lock:
             job = self.jobs.get(job_id)
             if job is None or job.state != "RUNNING":
@@ -740,6 +839,25 @@ class GcsServer:
             doomed_pgs = [pg for pg in self.placement_groups.values()
                           if pg.job_id == job_id and pg.lifetime != "detached"
                           and pg.state != "REMOVED"]
+            # Job-scoped KV reclamation: everything clients stored under
+            # `job:<hex>:...` namespaces (ray_tpu.kv_put) dies with the
+            # job — detached actors persist state under their OWN names,
+            # never under the defunct job's namespace.
+            prefix = f"job:{job_hex}:"
+            purged = [k for k in self.kv if k[0].startswith(prefix)]
+            for k in purged:
+                del self.kv[k]
+                self._kv_access_order.pop(k, None)
+                self._kv_access_ts.pop(k, None)
+        if purged:
+            logger.info("job %s finished: purged %d job-scoped kv keys",
+                        job_hex[:12], len(purged))
+        # Raylets reclaim on this push: idle workers tagged with this
+        # job's id retire (their runtime_env dies with the job), per-env
+        # forge refcounts drop, and the job's admission entry is removed.
+        self.pubsub.publish(CH_JOB, b"*",
+                            {"event": "finished", "job_id": job_hex,
+                             "submission_id": job.submission_id})
         try:
             for actor in doomed:
                 self._exec.submit(self._kill_actor, actor.actor_id,
@@ -1797,35 +1915,266 @@ class GcsServer:
             return self._job_manager
 
     def handle_submit_job(self, conn: Connection, data: Dict[str, Any]):
+        if not GLOBAL_CONFIG.job_agent_enabled:
+            try:
+                sid = self.job_manager.submit(
+                    data["entrypoint"],
+                    submission_id=data.get("submission_id"),
+                    runtime_env=data.get("runtime_env"),
+                    metadata=data.get("metadata"))
+                return {"submission_id": sid}
+            except (ValueError, RuntimeError) as e:
+                return {"error": str(e)}
+        import uuid
+
+        from ray_tpu.core.runtime_env import env_hash
+        from ray_tpu.tenancy.registry import TenantSpec
+
+        sid = data.get("submission_id") or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        tenant = data.get("tenant")
         try:
-            sid = self.job_manager.submit(
-                data["entrypoint"], submission_id=data.get("submission_id"),
-                runtime_env=data.get("runtime_env"),
-                metadata=data.get("metadata"))
-            return {"submission_id": sid}
-        except ValueError as e:
-            return {"error": str(e)}
+            if isinstance(tenant, str) and tenant:
+                qos = TenantSpec(name=tenant).qos()
+            elif isinstance(tenant, dict):
+                qos = TenantSpec(**tenant).qos()
+            else:
+                qos = {}
+        except (TypeError, ValueError) as e:
+            return {"error": f"bad tenant spec: {e}"}
+        renv = data.get("runtime_env") or {}
+        rec = _jobstate.new_record(
+            sid, data["entrypoint"], renv, data.get("metadata"),
+            qos, env_hash(renv), time.time())
+        with self._lock:
+            if sid in self.submitted_jobs:
+                return {"error": f"submission_id {sid!r} already exists"}
+            self.submitted_jobs[sid] = rec
+            self.submitted_job_logs[sid] = deque()
+        # Forge pre-warm rides the submit event (not dispatch): every
+        # node may host this job's WORKERS, so every raylet gets the
+        # chance to stand up the per-env template before the first task.
+        if renv.get("preimports"):
+            self.pubsub.publish(CH_JOB, b"*", {
+                "event": "submitted", "submission_id": sid,
+                "runtime_env": dict(renv)})
+        self._exec.submit(self._dispatch_submitted_job, sid)
+        return {"submission_id": sid}
+
+    def _dispatch_submitted_job(self, sid: str):
+        """Place a SUBMITTED job on the least-loaded alive node's agent.
+        No alive node -> the record parks (node_id None) and the next
+        register_node re-kicks this dispatch."""
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is None or rec["state"] != _jobstate.SUBMITTED \
+                    or rec["node_id"] is not None:
+                return
+            alive = [n for n in self.nodes.values() if n.state == "ALIVE"]
+            if not alive:
+                return  # parked; register_node re-kicks
+            load: Dict[str, int] = {}
+            for r in self.submitted_jobs.values():
+                if r["node_id"] and not _jobstate.is_terminal(r):
+                    load[r["node_id"]] = load.get(r["node_id"], 0) + 1
+            target = min(alive,
+                         key=lambda n: load.get(n.node_id.hex(), 0))
+            rec["node_id"] = target.node_id.hex()
+            node_id = target.node_id
+            entrypoint = rec["entrypoint"]
+            renv = dict(rec["runtime_env"])
+        try:
+            self._raylet(node_id).call(
+                "agent_run_job",
+                {"submission_id": sid, "entrypoint": entrypoint,
+                 "runtime_env": renv}, timeout=30)
+        except Exception as e:  # noqa: BLE001 — node died under us
+            self._job_terminal_transition(
+                sid, _jobstate.FAILED,
+                f"dispatch to node {node_id.hex()[:12]} failed: {e}")
+            return
+        # stop_job racing the dispatch: it flipped the record to STOPPED
+        # before the agent knew the job — the stop RPC found nothing to
+        # kill, so the kill is ours to deliver now that the agent does.
+        with self._lock:
+            stopped = (rec["state"] == _jobstate.STOPPED)
+        if stopped:
+            self._agent_stop(sid, node_id.hex())
+
+    def _agent_stop(self, sid: str, node_hex: str):
+        try:
+            self._raylet(NodeID.from_hex(node_hex)).call(
+                "agent_stop_job", {"submission_id": sid}, timeout=10)
+        except Exception:  # noqa: BLE001 — node dead: nothing to kill
+            logger.debug("agent_stop_job for %s failed", sid, exc_info=True)
+
+    def _job_terminal_transition(self, sid: str, state: str,
+                                 message: str = "") -> bool:
+        """Single writer for terminal job states: first terminal wins
+        (an agent's late FAILED report must not overwrite a client's
+        STOPPED, and vice versa)."""
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is None or _jobstate.is_terminal(rec):
+                return False
+            rec["state"] = state
+            rec["message"] = message
+            rec["end_time"] = time.time()
+            driver_hex = rec.get("driver_job_id") or ""
+        # A job that dies before its driver registers never reaches the
+        # driver-side _finish_job publish — without this, sid-owned
+        # per-env forge refs on the raylets would leak. Raylet handling
+        # is idempotent, so the double publish on the normal path (this
+        # + driver disconnect) is harmless.
+        self.pubsub.publish(CH_JOB, b"*",
+                            {"event": "finished", "job_id": driver_hex,
+                             "submission_id": sid})
+        return True
+
+    # Agent-report endpoints (called by jobs/agent.py on each raylet).
+
+    def handle_job_started(self, conn: Connection, data: Dict[str, Any]):
+        sid = data["submission_id"]
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is None or _jobstate.is_terminal(rec):
+                # Deleted or stopped while the spawn was in flight; the
+                # stop path already told (or will tell) the agent.
+                return {"stale": True}
+            rec["state"] = _jobstate.RUNNING
+            rec["start_time"] = time.time()
+            rec["driver_pid"] = data.get("pid")
+        return {}
+
+    def handle_job_terminal(self, conn: Connection, data: Dict[str, Any]):
+        sid = data["submission_id"]
+        rc = data.get("returncode", -1)
+        if data.get("stopped"):
+            state, msg = _jobstate.STOPPED, "stopped"
+        elif rc == 0:
+            state, msg = _jobstate.SUCCEEDED, ""
+        else:
+            state = _jobstate.FAILED
+            msg = data.get("message") or f"entrypoint exited with code {rc}"
+        self._job_terminal_transition(sid, state, msg)
+        return {}
+
+    def handle_job_log_append(self, conn: Connection, data: Dict[str, Any]):
+        sid = data["submission_id"]
+        lines: List[str] = data.get("lines") or []
+        dropped = int(data.get("dropped") or 0)
+        budget = max(1024, GLOBAL_CONFIG.job_log_tail_bytes)
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            buf = self.submitted_job_logs.get(sid)
+            if buf is None:
+                return {"stale": True}  # job deleted; drop the tail
+            buf.extend(lines)
+            if dropped:
+                buf.append(f"... {dropped} log lines dropped (rate limit)")
+            size = sum(len(ln) + 1 for ln in buf)
+            while buf and size > budget:
+                size -= len(buf.popleft()) + 1
+            pid = (rec or {}).get("driver_pid") or 0
+        # Republish on the LOG plane in the driver-print shape; keyed by
+        # the submission id, so interactive drivers (filtering on their
+        # own job hex) never see another job's output, while tail_job_logs
+        # subscribers and the dashboard do.
+        if lines or dropped:
+            self.pubsub.publish(CH_LOG, b"*", {
+                "worker": f"job:{sid[:12]}", "pid": pid, "job": sid,
+                "lines": [("stdout", ln) for ln in lines],
+                "dropped": dropped})
+        return {}
+
+    # Client-facing job queries: the submitted-job table answers first;
+    # anything it doesn't know falls back to the legacy in-GCS manager
+    # (only if one was ever created — querying must not instantiate it).
+
+    @property
+    def _legacy_job_manager(self):
+        if not GLOBAL_CONFIG.job_agent_enabled:
+            return self.job_manager
+        return getattr(self, "_job_manager", None)
 
     def handle_job_info(self, conn: Connection, data: Dict[str, Any]):
-        details = self.job_manager.details(data["submission_id"])
+        sid = data["submission_id"]
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is not None:
+                return {"found": True,
+                        "details": _jobstate.public_details(rec)}
+        legacy = self._legacy_job_manager
+        details = legacy.details(sid) if legacy is not None else None
         if details is None:
             return {"found": False}
         return {"found": True, "details": details}
 
     def handle_job_logs(self, conn: Connection, data: Dict[str, Any]):
-        logs = self.job_manager.logs(data["submission_id"])
+        sid = data["submission_id"]
+        with self._lock:
+            buf = self.submitted_job_logs.get(sid)
+            if buf is not None:
+                text = "\n".join(buf) + ("\n" if buf else "")
+                return {"found": True, "logs": text}
+            known = sid in self.submitted_jobs
+        if known:
+            return {"found": True, "logs": ""}
+        legacy = self._legacy_job_manager
+        logs = legacy.logs(sid) if legacy is not None else None
         if logs is None:
             return {"found": False}
         return {"found": True, "logs": logs}
 
     def handle_stop_job(self, conn: Connection, data: Dict[str, Any]):
-        return {"stopped": self.job_manager.stop(data["submission_id"])}
+        sid = data["submission_id"]
+        node_hex = None
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is not None:
+                if _jobstate.is_terminal(rec):
+                    return {"stopped": False}
+                rec["state"] = _jobstate.STOPPED
+                rec["message"] = "stopped"
+                rec["end_time"] = time.time()
+                node_hex = rec["node_id"]
+        if rec is not None:
+            # Release sid-owned prewarm refs now; the driver's own
+            # teardown (disconnect -> _finish_job) publishes the
+            # job_id-carrying finished event once the kill lands.
+            self.pubsub.publish(CH_JOB, b"*",
+                                {"event": "finished", "job_id": "",
+                                 "submission_id": sid})
+            if node_hex:
+                # Off the RPC thread: the agent's stop is fire-and-forget
+                # from the client's perspective (status is already
+                # STOPPED; the kill handshake runs on the node).
+                self._exec.submit(self._agent_stop, sid, node_hex)
+            return {"stopped": True}
+        legacy = self._legacy_job_manager
+        return {"stopped": legacy.stop(sid) if legacy is not None else False}
 
     def handle_delete_job(self, conn: Connection, data: Dict[str, Any]):
-        return {"deleted": self.job_manager.delete(data["submission_id"])}
+        sid = data["submission_id"]
+        with self._lock:
+            rec = self.submitted_jobs.get(sid)
+            if rec is not None:
+                if not _jobstate.is_terminal(rec):
+                    return {"deleted": False}
+                del self.submitted_jobs[sid]
+                self.submitted_job_logs.pop(sid, None)
+                return {"deleted": True}
+        legacy = self._legacy_job_manager
+        return {"deleted": legacy.delete(sid) if legacy is not None
+                else False}
 
     def handle_list_jobs(self, conn: Connection, data=None):
-        return self.job_manager.list()
+        with self._lock:
+            out = [_jobstate.public_details(rec)
+                   for rec in self.submitted_jobs.values()]
+        legacy = self._legacy_job_manager
+        if legacy is not None:
+            out.extend(legacy.list())
+        return out
 
     # ------------------------------------------------------- metrics export
 

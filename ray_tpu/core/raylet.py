@@ -23,6 +23,7 @@ Differences from the reference, deliberate for the TPU design:
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import os
@@ -53,9 +54,24 @@ from ray_tpu.core.rpc import (
 )
 from ray_tpu.core.worker_forge import ForgeUnavailable, WorkerForge
 from ray_tpu.exceptions import RaySystemError
+from ray_tpu.jobs.agent import JobAgent
+from ray_tpu.jobs.tenancy import JobAdmission
 from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _marker_preimports(env_extra: Optional[Dict[str, str]]) -> List[str]:
+    """The runtime_env `preimports` set riding in a grant's
+    RAY_TPU_RUNTIME_ENV marker (runtime_env.granted_env) — what routes a
+    spawn to its per-env forge template."""
+    marker = (env_extra or {}).get("RAY_TPU_RUNTIME_ENV")
+    if not marker:
+        return []
+    try:
+        return list(json.loads(marker).get("preimports") or [])
+    except (ValueError, AttributeError):
+        return []
 
 
 # --------------------------------------------------------------------------- #
@@ -246,7 +262,7 @@ class WorkerPool:
 
     def forge_available(self, env_extra: Optional[Dict[str, str]]) -> bool:
         """Would a spawn for this grant take the millisecond fork path?"""
-        forge = self._raylet.forge
+        forge = self._raylet.forge_for(env_extra)
         return (forge is not None and forge.alive
                 and WorkerForge.compatible(env_extra or {}))
 
@@ -281,7 +297,10 @@ class WorkerPool:
         with self._lock:
             self._workers[worker_id] = handle
             self._starting += 1
-        forge = self._raylet.forge
+        # Per-runtime-env routing: a grant carrying preimports forks from
+        # its own template (warm module set), everything else from the
+        # node-wide default.
+        forge = self._raylet.forge_for(env_extra)
         proc = None
         spawn_err: Optional[str] = None
         try:
@@ -756,6 +775,25 @@ class Raylet:
         # Worker forge (forkserver template) — started in start() when
         # enabled; spawn_worker falls back to cold exec while it is down.
         self.forge: Optional[WorkerForge] = None
+        # Job tier (docs/JOBS.md): per-node agent hosting submitted-job
+        # driver subprocesses (started in start() when enabled), and the
+        # per-job dispatch admission (stride fairness + rate quotas).
+        self.job_agent: Optional[JobAgent] = None
+        self.job_admission = JobAdmission(
+            default_weight=GLOBAL_CONFIG.job_default_tenant_weight)
+        # Per-runtime-env forge templates: preimports-csv key ->
+        # {"forge": WorkerForge|None, "owners": set}. Owners are job
+        # hexes / submission ids; the JOB-channel "finished" event drops
+        # refs and the last owner out retires the template — bounded by
+        # the set of LIVE jobs with preimports, not job history (RL018).
+        self._env_forges: Dict[str, Dict[str, Any]] = {}
+        self._env_forges_lock = threading.Lock()
+        # Recently finished jobs (job hex -> monotonic ts): the reaper
+        # retires their leftover idle workers (ones that were busy when
+        # the finished event arrived) and TTL-expires entries, so this
+        # tracks a ~60s window of terminations, never all of history
+        # (RL018: sweep is _sweep_finished_jobs in the reaper loop).
+        self._finished_jobs: Dict[str, float] = {}
         # Per-process waiter threads for cold-spawned workers (event-driven
         # death detection; the 2s reaper loop stays as anti-entropy).
         self._proc_waiters: List[threading.Thread] = []
@@ -781,6 +819,11 @@ class Raylet:
                 logger.warning("worker forge failed to start; cold spawns "
                                "only", exc_info=True)
                 self.forge = None
+        if GLOBAL_CONFIG.job_agent_enabled:
+            self.job_agent = JobAgent(
+                self.node_id.hex(), self.session_dir,
+                gcs_call=lambda m, p: self.gcs.call(m, p, timeout=10.0),
+                gcs_address=self.gcs_address)
         self._node_info = NodeInfo(
             node_id=self.node_id,
             address=self.server.address,
@@ -825,6 +868,11 @@ class Raylet:
         if getattr(self, "memory_monitor", None) is not None:
             self.memory_monitor.stop()
         self._dispatch_event.set()
+        if self.job_agent is not None:
+            # Before kill_all: driver subprocesses get their group kill
+            # (and the grace window) while their workers are still being
+            # torn down — no orphaned entrypoints outlive the node.
+            self.job_agent.shutdown()
         self.pool.kill_all()
         if self.forge is not None:
             # After kill_all (every known worker got its signal first):
@@ -833,6 +881,12 @@ class Raylet:
             # An in-flight fork the pool never saw dies on its own when
             # its registration against this stopped raylet fails.
             self.forge.stop()
+        with self._env_forges_lock:
+            env_forges = [e["forge"] for e in self._env_forges.values()
+                          if e["forge"] is not None]
+            self._env_forges.clear()
+        for f in env_forges:
+            f.stop()
         with self._proc_waiters_lock:
             waiters = list(self._proc_waiters)
             self._proc_waiters.clear()
@@ -894,10 +948,18 @@ class Raylet:
         a GCS outage are lost, and a restored ghost address would
         otherwise make every caller error against it until a minutes-long
         timeout."""
-        client.call("register_node", {"info": self._node_info,
-                                      "reconcile_actors": True})
+        client.call("register_node", {
+            "info": self._node_info,
+            "reconcile_actors": True,
+            # Reconcile list for the job table: RUNNING jobs the GCS
+            # believes live here but a restarted agent doesn't know are
+            # failed instead of hanging forever.
+            "running_jobs": (self.job_agent.running()
+                             if self.job_agent is not None else []),
+        })
         client.call("subscribe", {"channel": "RESOURCES", "key": b"*"})
         client.call("subscribe", {"channel": "OBJECT", "key": b"*"})
+        client.call("subscribe", {"channel": "JOB", "key": b"*"})
 
     def handle_list_live_actors(self, conn: Connection, data=None):
         """Actors this node currently hosts OR is creating right now —
@@ -911,6 +973,153 @@ class Raylet:
         with self._lock:
             live.update(self._pending_actor_creates.keys())
         return {"actors": list(live)}
+
+    # ------------------------------------------------------------- job tier
+
+    def handle_agent_run_job(self, conn: Connection, data: Dict[str, Any]):
+        """GCS -> agent: launch a submitted job's driver on this node."""
+        if self.job_agent is None:
+            raise RuntimeError("job agent disabled on this node")
+        self.job_agent.run_job(data["submission_id"], data["entrypoint"],
+                               data.get("runtime_env"))
+        return {"ok": True}
+
+    def handle_agent_stop_job(self, conn: Connection, data: Dict[str, Any]):
+        stopped = False
+        if self.job_agent is not None:
+            stopped = self.job_agent.stop_job(data["submission_id"])
+        return {"stopped": stopped}
+
+    def _on_job_event(self, msg: Dict[str, Any]):
+        """JOB-channel pubsub from the GCS — the raylet side of the job
+        lifecycle: seed admission + pre-warm forges at the front, reclaim
+        workers/forges/admission entries at the back."""
+        event = msg.get("event")
+        if event == "submitted":
+            # Submission-time pre-warm: the per-env template pays its
+            # preimport bill WHILE the driver subprocess is still
+            # starting, so the job's first task forks instead of cold-
+            # spawning (bench_jobs measures exactly this overlap).
+            renv = msg.get("runtime_env") or {}
+            if GLOBAL_CONFIG.job_prewarm_forge and renv.get("preimports"):
+                self._env_forge_for(renv["preimports"],
+                                    owner=msg.get("submission_id", ""))
+        elif event == "running":
+            job_hex = msg.get("job_id") or ""
+            if job_hex:
+                self.job_admission.register(job_hex, msg.get("tenant_qos"))
+            renv = msg.get("runtime_env") or {}
+            if renv.get("preimports"):
+                self._env_forge_for(renv["preimports"], owner=job_hex)
+        elif event == "finished":
+            job_hex = msg.get("job_id") or ""
+            sid = msg.get("submission_id") or ""
+            if job_hex:
+                self.job_admission.unregister(job_hex)
+                with self._lock:
+                    self._finished_jobs[job_hex] = time.monotonic()
+                self._reclaim_job_workers(job_hex)
+            self._release_env_forges({o for o in (job_hex, sid) if o})
+            self._dispatch_event.set()
+
+    def _reclaim_job_workers(self, job_hex: str):
+        """Retire idle workers whose granted env belongs to a finished
+        job: their runtime_env (working_dir, env_vars, preimports) died
+        with the job, so no future task can ever lease them — left
+        alone they'd sit as permanent orphans against the pool cap."""
+        with self.pool._lock:
+            victims = [h for h in self.pool._workers.values()
+                       if h.state == "idle" and not h.is_actor
+                       and h.granted_env.get("RAY_TPU_JOB_ID") == job_hex]
+            for h in victims:
+                h.state = "busy"  # reserve so dispatch can't lease them
+        for h in victims:
+            self._on_worker_dead(h, "job finished")
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass  # already reaped
+
+    _FINISHED_JOB_TTL_S = 60.0
+
+    def _sweep_finished_jobs(self):
+        """Reaper-loop anti-entropy for job cleanup: workers that were
+        BUSY when the finished event arrived go idle a moment later and
+        would dodge the event-time reclaim; re-sweeping for the TTL
+        window catches them. Expiry bounds the dict (RL018)."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._finished_jobs:
+                return
+            for jh in [j for j, ts in self._finished_jobs.items()
+                       if now - ts > self._FINISHED_JOB_TTL_S]:
+                del self._finished_jobs[jh]
+            live = list(self._finished_jobs)
+        for job_hex in live:
+            self._reclaim_job_workers(job_hex)
+
+    def forge_for(self, env_extra: Optional[Dict[str, str]]
+                  ) -> Optional[WorkerForge]:
+        """The forge template serving this grant: the node-wide default
+        unless the runtime_env carries `preimports`, in which case a
+        per-env template (grown on demand, refcounted by owning job)."""
+        pre = _marker_preimports(env_extra)
+        if not pre:
+            return self.forge
+        if not GLOBAL_CONFIG.worker_forge_enabled:
+            return None
+        return self._env_forge_for(
+            pre, owner=(env_extra or {}).get("RAY_TPU_JOB_ID", ""))
+
+    def _env_forge_for(self, preimports: List[str], owner: str
+                       ) -> Optional[WorkerForge]:
+        """Get-or-create the template for this preimport set and add
+        `owner`'s ref. Launch happens OUTSIDE the lock (RL002: template
+        exec is a fork/exec); racers see forge=None while it launches
+        and cold-spawn — only the very first spawns pay that."""
+        base = [m.strip() for m in
+                GLOBAL_CONFIG.worker_forge_preimports.split(",") if m.strip()]
+        extra = [m for m in preimports if m and m not in base]
+        key = ",".join(base + extra)
+        with self._env_forges_lock:
+            ent = self._env_forges.get(key)
+            creator = ent is None
+            if creator:
+                ent = self._env_forges[key] = {"forge": None, "owners": set()}
+            if owner:
+                ent["owners"].add(owner)
+            if not creator:
+                return ent["forge"]
+        forge: Optional[WorkerForge] = None
+        try:
+            forge = WorkerForge(
+                self.session_dir, self.session_suffix, self.node_id.hex(),
+                on_worker_exit=self._on_forge_worker_exit, preimports=key)
+            forge.start()
+        except Exception:  # noqa: BLE001 — per-env forge is an optimization
+            logger.warning("per-env forge failed to start; cold spawns for "
+                           "runtime_env preimports=%s", key, exc_info=True)
+            forge = None
+        with self._env_forges_lock:
+            ent["forge"] = forge
+        return forge
+
+    def _release_env_forges(self, dead_owners: Set[str]):
+        if not dead_owners:
+            return
+        to_stop = []
+        with self._env_forges_lock:
+            for key, ent in list(self._env_forges.items()):
+                ent["owners"] -= dead_owners
+                if not ent["owners"]:
+                    del self._env_forges[key]
+                    if ent["forge"] is not None:
+                        to_stop.append(ent["forge"])
+        for f in to_stop:
+            # Detach only: the shared template lingers briefly and
+            # self-exits on idle, so a resubmitted job re-warms cheaply.
+            f.stop()
 
     def _pending_demand(self, cap: int = 64) -> List[Dict[str, float]]:
         """Resource shapes of queued tasks that can't run right now — the
@@ -1066,6 +1275,7 @@ class Raylet:
             # Long-dead handles leave the pool after a grace window so
             # worker churn cannot grow it without bound.
             self.pool.prune_dead()
+            self._sweep_finished_jobs()
 
     # ------------------------------------------------------- GCS push events
 
@@ -1115,6 +1325,8 @@ class Raylet:
             # tasks this node can never run get handed back to their
             # submitters for re-routing (reference task spilling).
             self._respill_infeasible()
+        elif channel == "JOB":
+            self._on_job_event(data["message"])
         elif channel == "OBJECT":
             oid = ObjectID(data["key"])
             with self._lock:
@@ -1435,20 +1647,46 @@ class Raylet:
             progressed = False
             with self._lock:
                 now = time.monotonic()
-                ready_idx = None
+                # Group the dep-free scan window by job (FIFO preserved
+                # within each job); the slot is then offered to jobs in
+                # stride order, so a weight-8 job's task storm cannot
+                # monopolize dispatch over a weight-1 job's trickle.
+                # With a single job this degrades to exactly the old
+                # FIFO scan.
+                by_job: Dict[str, List[int]] = {}
                 scanned = 0
                 for i, qt in enumerate(self._queue):
                     if qt.deps_remaining:
                         continue
-                    if self.resources.try_acquire(qt.spec.resources):
-                        ready_idx = i
-                        break
+                    by_job.setdefault(qt.spec.job_id.hex(), []).append(i)
                     if (now - qt.queued_at > self._DISPATCH_AGING_S
                             and self.resources.feasible(qt.spec.resources)):
-                        break  # aged feasible task: reserve, don't bypass
+                        # Aged feasible task: reserve — nothing younger
+                        # (in ANY job) may jump it; the node drains
+                        # until its resources fit.
+                        for jh in by_job:
+                            by_job[jh] = [x for x in by_job[jh] if x <= i]
+                        break
                     scanned += 1
                     if scanned >= self._DISPATCH_SCAN_LIMIT:
                         break
+                ready_idx = None
+                for jh in self.job_admission.order(list(by_job)):
+                    # Token-bucket rate quota: a throttled job's tasks
+                    # stay queued (the 0.2s dispatch tick retries);
+                    # other jobs' candidates still get the slot.
+                    if self.job_admission.admit(jh) > 0.0:
+                        continue
+                    for i in by_job[jh]:
+                        qt = self._queue[i]
+                        if self.resources.try_acquire(qt.spec.resources):
+                            ready_idx = i
+                            break
+                    if ready_idx is not None:
+                        break
+                    # Nothing dispatchable for this job right now: give
+                    # back the stride/bucket charge it didn't use.
+                    self.job_admission.refund(jh)
                 if ready_idx is None:
                     return
                 qt = self._queue[ready_idx]
@@ -1532,10 +1770,16 @@ class Raylet:
         for k, v in (renv.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         if renv.get("working_dir") or renv.get("py_modules") \
-                or renv.get("pip"):
+                or renv.get("pip") or renv.get("preimports"):
             from ray_tpu.core import runtime_env as renv_mod
 
             env.update(renv_mod.granted_env(renv))
+        # Job-scoped worker isolation: the job id is part of the granted
+        # env, so pop_idle's exact match never hands one job's worker
+        # (its env_vars, working_dir, preimported modules) to another
+        # job's task, and job-finish reclamation can find every worker
+        # the job left behind by this tag.
+        env["RAY_TPU_JOB_ID"] = spec.job_id.hex()
         return env
 
     def _dispatch_to(self, worker: WorkerHandle, qt: QueuedTask):

@@ -11,6 +11,7 @@ lifetime (frees propagate to the directory on ref drop).
 from __future__ import annotations
 
 import hashlib
+import json
 import logging
 import os
 import struct
@@ -140,11 +141,30 @@ class CoreRuntime:
         self._segment_pool = SegmentPool(
             session_suffix, GLOBAL_CONFIG.segment_pool_max_bytes)
         if job_id is None:
-            resp = self.gcs.call("register_job",
-                                 {"pid": os.getpid(), "namespace": namespace,
-                                  "entrypoint": " ".join(os.sys.argv)})
+            resp = self.gcs.call(
+                "register_job",
+                {"pid": os.getpid(), "namespace": namespace,
+                 "entrypoint": " ".join(os.sys.argv),
+                 # Set by the job agent for submitted-job drivers: links
+                 # this driver job to its submission record (job-tier
+                 # status, tenant QoS, job-scoped cleanup).
+                 "submission_id": os.environ.get("RAY_TPU_SUBMISSION_ID",
+                                                 "")})
             job_id = resp["job_id"]
         self.job_id = job_id
+        # Job-level runtime_env: a submitted driver inherits its job's
+        # prepared runtime_env (RAY_TPU_JOB_RUNTIME_ENV, set by the job
+        # agent) as the default for every task/actor it submits — that's
+        # what routes the job's tasks to its per-env forge workers. A
+        # worker inherits the prepared-URI subset riding its own grant
+        # (RAY_TPU_RUNTIME_ENV), so nested tasks stay in the job's env.
+        _renv_blob = os.environ.get("RAY_TPU_JOB_RUNTIME_ENV") \
+            or os.environ.get("RAY_TPU_RUNTIME_ENV")
+        try:
+            self._job_runtime_env = json.loads(_renv_blob) \
+                if _renv_blob else None
+        except ValueError:
+            self._job_runtime_env = None
         # The "driver task" context: puts and submissions hang off this id.
         self.current_task_id = TaskID.for_task(job_id)
         self._put_counter = 0
@@ -984,7 +1004,11 @@ class CoreRuntime:
 
     def _prepare_runtime_env(self, renv):
         """Local working_dir/py_modules paths -> content-addressed KV URIs
-        through the shared memoizing cache (core/runtime_env.EnvCache)."""
+        through the shared memoizing cache (core/runtime_env.EnvCache).
+        Tasks without their own runtime_env inherit the job-level one
+        (task-level wins outright when both are set)."""
+        if not renv:
+            renv = self._job_runtime_env
         if not renv or not (renv.get("working_dir") or renv.get("py_modules")
                             or renv.get("pip")):
             return renv
@@ -1195,6 +1219,33 @@ class CoreRuntime:
             raise ValueError(f"Failed to look up actor '{name}'. "
                              "It was either not created or died.")
         return resp["actor_id"], resp["creation_spec"]
+
+    # ---------------------------------------------------------- job-scoped KV
+
+    def _kv_namespace(self, namespace: Optional[str]) -> str:
+        """GCS KV keys written through the public kv_* API live under a
+        `job:<hex>:<ns>` namespace: the GCS purges the whole prefix when
+        the job finishes (_finish_job), so no job can leak KV state or
+        read/clobber another job's keys by accident. Detached actors
+        wanting to outlive their job must use named actors or storage,
+        never the owning job's KV."""
+        return f"job:{self.job_id.hex()}:{namespace or 'default'}"
+
+    def kv_put(self, key: str, value: bytes,
+               namespace: Optional[str] = None) -> None:
+        self.gcs.call("kv_put", {"namespace": self._kv_namespace(namespace),
+                                 "key": key.encode(), "value": bytes(value)})
+
+    def kv_get(self, key: str,
+               namespace: Optional[str] = None) -> Optional[bytes]:
+        resp = self.gcs.call("kv_get",
+                             {"namespace": self._kv_namespace(namespace),
+                              "key": key.encode()})
+        return resp.get("value")
+
+    def kv_del(self, key: str, namespace: Optional[str] = None) -> None:
+        self.gcs.call("kv_del", {"namespace": self._kv_namespace(namespace),
+                                 "key": key.encode()})
 
     # ----------------------------------------------------------------- get
 

@@ -114,6 +114,52 @@ def _normalize_pip(out: Dict[str, Any]) -> None:
     out["pip"]["env_hash"] = pip_env_hash(out["pip"])
 
 
+def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable short content hash identifying one runtime environment —
+    THE `env_sig` that keys worker-lease compatibility end to end
+    (direct_task lease keys, the raylet's granted-env marker, and the
+    forge's per-env template selection all derive from this one value).
+    Empty env -> "" so the no-runtime_env fast path stays marker-free.
+
+    Canonicalization: list-valued keys sort (py_modules/preimports order
+    must not fork worker pools); everything else goes through json with
+    repr fallback, so an exotic value degrades to a stable string rather
+    than raising mid-submission."""
+    if not runtime_env:
+        return ""
+    canon: Dict[str, Any] = {}
+    for k in sorted(runtime_env):
+        v = runtime_env[k]
+        if isinstance(v, (list, tuple, set)):
+            canon[k] = sorted(str(x) for x in v)
+        elif isinstance(v, dict):
+            canon[k] = {str(kk): str(v[kk]) for kk in sorted(v)}
+        else:
+            canon[k] = v
+    blob = json.dumps(canon, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _normalize_preimports(out: Dict[str, Any]) -> None:
+    """Canonicalize {"preimports": [...module names...]}: the modules a
+    job wants baked into its forge template so its workers fork warm.
+    Validated at submission (a typo'd module name must fail the submit,
+    not wedge a template on every node)."""
+    pre = out.get("preimports")
+    if pre is None:
+        return
+    mods = sorted({str(m).strip() for m in pre if str(m).strip()})
+    for m in mods:
+        if not all(seg.isidentifier() for seg in m.split(".")):
+            raise ValueError(
+                f"runtime_env preimports entry {m!r} is not a valid "
+                "module path")
+    if mods:
+        out["preimports"] = mods
+    else:
+        out.pop("preimports", None)
+
+
 def pip_env_hash(pip: Dict[str, Any]) -> str:
     """Content hash identifying one venv: the package list plus the
     wheelhouse manifest (path + file names + sizes + mtimes — mtime
@@ -145,6 +191,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], gcs
         return runtime_env
     out = dict(runtime_env)
     _normalize_pip(out)
+    _normalize_preimports(out)
     wd = out.get("working_dir")
     if wd and not wd.startswith(URI_PREFIX):
         if not os.path.isdir(wd):
@@ -240,11 +287,16 @@ def granted_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     separately)."""
     if not runtime_env:
         return {}
-    uris = {k: runtime_env[k] for k in ("working_dir", "py_modules", "pip")
+    uris = {k: runtime_env[k]
+            for k in ("working_dir", "py_modules", "pip", "preimports")
             if runtime_env.get(k)}
     if not uris:
         return {}
-    return {"RAY_TPU_RUNTIME_ENV": json.dumps(uris, sort_keys=True)}
+    # The env_sig rides next to the marker so every layer (worker-pool
+    # leasing, per-env forge templates, job reclaim) keys off ONE hash
+    # instead of re-deriving its own flavor of "same environment".
+    return {"RAY_TPU_RUNTIME_ENV": json.dumps(uris, sort_keys=True),
+            "RAY_TPU_ENV_SIG": env_hash(runtime_env)}
 
 
 def materialize(gcs, session_dir: str) -> None:
@@ -286,6 +338,16 @@ def materialize(gcs, session_dir: str) -> None:
     pip = uris.get("pip")
     if pip:
         _activate_venv(_ensure_venv(pip, cache))
+    # Preimports: forge-templated workers already hold these modules from
+    # the template process; this covers the cold-spawn fallback so both
+    # paths present an identical environment to user code.
+    import importlib
+    for mod in uris.get("preimports", []) or []:
+        try:
+            importlib.import_module(mod)
+        except Exception:
+            logger.warning("runtime_env: preimport %s failed", mod,
+                           exc_info=True)
     for uri in uris.get("py_modules", []) or []:
         path = fetch(uri)
         if path not in sys.path:

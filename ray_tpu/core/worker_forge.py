@@ -606,11 +606,17 @@ class WorkerForge:
 
     def __init__(self, session_dir: str, session_suffix: str,
                  node_hex: str,
-                 on_worker_exit: Optional[Callable[[int, int], None]] = None):
+                 on_worker_exit: Optional[Callable[[int, int], None]] = None,
+                 preimports: Optional[str] = None):
         self._session_dir = session_dir
         self._session_suffix = session_suffix
         self._node_hex = node_hex
         self.on_worker_exit = on_worker_exit
+        # Per-runtime-env template override (comma-separated module list):
+        # a job whose runtime_env carries `preimports` gets its own forge
+        # keyed on this set, so its workers fork with the job's heavy
+        # modules already imported. None -> the node-wide default set.
+        self._preimports_override = preimports
         self._template: Optional[_SharedTemplate] = None
         self.generation = 0
         self._sock: Optional[socket.socket] = None
@@ -655,7 +661,10 @@ class WorkerForge:
         earlier cluster in this process connects in milliseconds."""
         from ray_tpu.core.config import GLOBAL_CONFIG
 
-        self._template = shared_template(GLOBAL_CONFIG.worker_forge_preimports)
+        self._template = shared_template(
+            self._preimports_override
+            if self._preimports_override is not None
+            else GLOBAL_CONFIG.worker_forge_preimports)
         self._launch_template()
         t = threading.Thread(target=self._connect_loop,
                              args=(self.generation,),

@@ -145,6 +145,26 @@ class DashboardServer:
         elif path == "/api/jobs":
             self._json(req, {"driver_jobs": gcs.call("get_jobs"),
                              "submissions": gcs.call("list_jobs")})
+        elif path.startswith("/api/jobs/"):
+            # /api/jobs/<sid> -> status record; /api/jobs/<sid>/logs ->
+            # the retained log tail (job_log_tail_bytes budget).
+            rest = path[len("/api/jobs/"):]
+            sid, _, tail = rest.partition("/")
+            if tail == "logs":
+                resp = gcs.call("job_logs", {"submission_id": sid})
+                if not resp.get("found"):
+                    self._send(req, 404, b"no such job", "text/plain")
+                else:
+                    self._send(req, 200, resp["logs"].encode(),
+                               "text/plain")
+            elif not tail:
+                resp = gcs.call("job_info", {"submission_id": sid})
+                if not resp.get("found"):
+                    self._send(req, 404, b"no such job", "text/plain")
+                else:
+                    self._json(req, resp["details"])
+            else:
+                self._send(req, 404, b"not found", "text/plain")
         elif path == "/api/cluster_resources":
             self._json(req, gcs.call("cluster_resources"))
         elif path.startswith("/api/traces/"):

@@ -203,10 +203,21 @@ def make_collector() -> Optional[CollectorHandle]:
 
 
 def reap_collector(actor) -> None:
+    # GC-driven finalizer: may fire on ANY thread at ANY allocation,
+    # including control-plane threads (GCS/raylet RPC handlers) during
+    # the window between shutdown() and a later init(). It must never
+    # go through ray_tpu.kill(): _require_runtime() auto-inits when the
+    # runtime is down, which from a control-plane thread deadlocks
+    # against the in-progress init holding _init_lock (observed as
+    # register_node stalls + missed-heartbeat node death in suite runs).
+    # A dead runtime already reaped the actor; only reap on a live one.
     import ray_tpu
 
+    runtime = ray_tpu._global_runtime
+    if runtime is None:
+        return
     try:
-        ray_tpu.kill(actor)
+        runtime.kill_actor(actor._actor_id, no_restart=True)
     except Exception:  # noqa: BLE001 — cluster may already be down
         pass
 

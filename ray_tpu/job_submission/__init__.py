@@ -21,6 +21,9 @@ from ray_tpu.core.rpc import RpcClient
 
 
 class JobStatus:
+    # SUBMITTED: accepted into the GCS job table, driver not launched yet
+    # (agent path); the legacy in-GCS manager reports PENDING instead.
+    SUBMITTED = "SUBMITTED"
     PENDING = "PENDING"
     RUNNING = "RUNNING"
     SUCCEEDED = "SUCCEEDED"
@@ -39,6 +42,12 @@ class JobDetails:
     start_time: Optional[float] = None
     end_time: Optional[float] = None
     metadata: Dict[str, str] = field(default_factory=dict)
+    # Agent-path jobs only (jobs/state.py public_details — keep in sync);
+    # the legacy manager leaves these at their defaults.
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    tenant: str = ""
+    node_id: Optional[str] = None
+    driver_job_id: Optional[str] = None
 
 
 class JobSubmissionClient:
@@ -55,10 +64,23 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[Dict[str, Any]] = None,
-                   metadata: Optional[Dict[str, str]] = None) -> str:
+                   metadata: Optional[Dict[str, str]] = None,
+                   tenant: Optional[Any] = None) -> str:
+        """Submit an entrypoint. `runtime_env` is prepared CLIENT-side
+        (working_dir/py_modules zip + upload to the GCS blob store) so
+        the job record only ever carries content-addressed URIs — the
+        agent node needs no access to the client's filesystem. `tenant`
+        is a tenant name (str) or TenantSpec-shaped dict; the job's
+        tasks are then admitted under that tier/weight/rate quota by
+        every raylet dispatch loop (docs/JOBS.md "Jobs as tenants")."""
+        if runtime_env:
+            from ray_tpu.core.runtime_env import prepare
+
+            runtime_env = prepare(runtime_env, self._client)
         resp = self._client.call("submit_job", {
             "entrypoint": entrypoint, "submission_id": submission_id,
-            "runtime_env": runtime_env, "metadata": metadata or {}})
+            "runtime_env": runtime_env, "metadata": metadata or {},
+            "tenant": tenant})
         if resp.get("error"):
             raise RuntimeError(resp["error"])
         return resp["submission_id"]

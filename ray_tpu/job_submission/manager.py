@@ -15,6 +15,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.job_submission import JobStatus
+from ray_tpu.jobs import procutil
 
 
 class JobManager:
@@ -51,66 +52,11 @@ class JobManager:
         runner.start()
         return sid
 
-    @staticmethod
-    def _kill_group(proc: subprocess.Popen, grace_s: float = 3.0):
-        """SIGTERM the entrypoint's process group, then SIGKILL whatever
-        part of it outlives grace_s: a TERM-trapping driver must not
-        survive shutdown or park the waiting runner thread forever.
-
-        The direct child is the `sh -c` wrapper (shell=True), and its
-        death says nothing about the group — the shell dies on TERM
-        while a TERM-trapping python driver it spawned survives in the
-        same group. So the escalation is keyed on GROUP liveness, probed
-        with killpg(pgid, 0): while any member lives the pgid (== the
-        leader's pid, via start_new_session=True) cannot be recycled, so
-        a positive probe means the KILL lands on our group, never on a
-        stranger whose group reused a freed pid. The probe and the
-        signal cannot be fully atomic — the residual window is the
-        microseconds between them, within which the whole pid space
-        would have to wrap for the signal to land elsewhere."""
-        def _sig(sig, fallback):
-            try:
-                os.killpg(proc.pid, sig)
-            except OSError:
-                try:
-                    fallback()
-                except OSError:
-                    pass  # exited and reaped in between
-        _sig(15, proc.terminate)
-        if not JobManager._wait_group_dead(proc, grace_s):
-            _sig(9, proc.kill)
-            # Confirm the group is actually gone before returning:
-            # shutdown() joins this thread as its proof of kill delivery,
-            # and a caller that exits the process the moment we return
-            # must not race the SIGKILLed survivors' death. Bounded —
-            # SIGKILL cannot be trapped, so this only waits out the
-            # kernel teardown and init's zombie reap.
-            JobManager._wait_group_dead(proc, 2.0)
-        try:
-            proc.wait(timeout=2.0)
-        except subprocess.TimeoutExpired:
-            pass  # stuck in uninterruptible sleep past SIGKILL; stay bounded
-
-    @staticmethod
-    def _wait_group_dead(proc: subprocess.Popen, timeout_s: float) -> bool:
-        """Poll until no member of the entrypoint's process group remains
-        (killpg(pgid, 0) -> ESRCH), reaping the direct child along the
-        way. False if the group still has members after timeout_s."""
-        deadline = time.monotonic() + timeout_s
-        while True:
-            try:
-                os.killpg(proc.pid, 0)
-            except OSError:
-                return True  # whole group exited (and was reaped)
-            if time.monotonic() >= deadline:
-                return False
-            if proc.returncode is None:
-                try:
-                    proc.wait(timeout=0.1)  # reap the shell + pace the poll
-                except subprocess.TimeoutExpired:
-                    pass
-            else:
-                time.sleep(0.05)  # child reaped; poll surviving group
+    # Kill-handshake hygiene lives in jobs/procutil.py now, shared with
+    # the per-node job agent; these shims keep the existing call sites
+    # (and the direct unit tests against them) stable.
+    _kill_group = staticmethod(procutil.kill_group)
+    _wait_group_dead = staticmethod(procutil.wait_group_dead)
 
     def _run(self, sid: str, runtime_env: Optional[Dict[str, Any]]):
         job = self._jobs[sid]

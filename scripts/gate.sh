@@ -65,6 +65,13 @@ step "ingest smoke (seeded node kill mid-shuffle)" \
 # upper bound (exit nonzero on any invariant breach).
 step "inference smoke (prefix cache + spec decode)" \
   env JAX_PLATFORMS=cpu python bench.py --inference-smoke
+# Job-tier smoke: cold vs forge-template submit->first-task (warm must
+# be >=2x faster), 3 concurrent tenant jobs with distinct runtime envs
+# on one cluster, then the cleanup invariants — zero orphan job
+# processes via /proc cmdline scan (driver mark + cold-worker argv
+# diff) and num_unsealed 0 (exit nonzero on any breach).
+step "jobs smoke (submission plane + env forge + tenants)" \
+  env JAX_PLATFORMS=cpu python bench.py --jobs-smoke
 # 100-node envelope smoke: placement at width + one seeded node kill with
 # AUTOSCALER-driven replacement, bounded — zero hangs, zero lost tasks,
 # lease-cache invalidation asserted (no stale-lease double execution).

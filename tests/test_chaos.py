@@ -663,3 +663,91 @@ def test_pubsub_delta_batch_monotonic_across_gcs_failover():
             except Exception:  # noqa: BLE001
                 pass
         cluster.shutdown()
+
+
+# ------------------------------------------------------- job driver kill
+
+
+def test_driver_kill_detached_survives_next_job_unaffected():
+    """Driver-kill schedule for the job tier (docs/JOBS.md cleanup
+    contract): SIGKILL a submitted job's driver mid-run; its detached
+    actor survives with state, its non-detached actor is reclaimed, and
+    a second job submitted DURING the first's cleanup runs its first
+    task normally (cleanup never wedges dispatch)."""
+    import os
+    import signal
+    import sys
+
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    client = JobSubmissionClient(ray_tpu._global_runtime.gcs.address)
+    try:
+        sid = client.submit_job(entrypoint=(
+            f"{sys.executable} -c \""
+            "import os, time, ray_tpu; ray_tpu.init()\n"
+            "@ray_tpu.remote\n"
+            "class Keeper:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n"
+            "        return self.n\n"
+            "d = Keeper.options(name='chaos-keeper', "
+            "lifetime='detached').remote()\n"
+            "e = Keeper.options(name='chaos-eph').remote()\n"
+            "ray_tpu.get([d.bump.remote(), e.bump.remote()])\n"
+            "print('READY pid=%d' % os.getpid(), flush=True)\n"
+            "time.sleep(120)\""))
+        # Wait for the driver to report itself, then SIGKILL it — no
+        # SIGTERM grace, no atexit: the hardest driver death.
+        deadline = time.monotonic() + 60
+        pid = None
+        while time.monotonic() < deadline and pid is None:
+            for line in client.get_job_logs(sid).splitlines():
+                if line.startswith("READY pid="):
+                    pid = int(line.split("=", 1)[1])
+            time.sleep(0.2)
+        assert pid is not None, client.get_job_logs(sid)[-500:]
+        os.kill(pid, signal.SIGKILL)
+        # Second job races the first's cleanup: submit-to-first-task must
+        # complete normally while workers/actors of job 1 are torn down.
+        sid2 = client.submit_job(entrypoint=(
+            f"{sys.executable} -c \""
+            "import ray_tpu; ray_tpu.init()\n"
+            "@ray_tpu.remote\n"
+            "def first():\n"
+            "    return 'second-job-task-ran'\n"
+            "print(ray_tpu.get(first.remote()))\n"
+            "ray_tpu.shutdown()\""))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                client.get_job_status(sid2) not in JobStatus.TERMINAL:
+            time.sleep(0.25)
+        assert client.get_job_status(sid2) == JobStatus.SUCCEEDED, \
+            client.get_job_logs(sid2)[-500:]
+        assert "second-job-task-ran" in client.get_job_logs(sid2)
+        # Job 1 lands FAILED (killed, not stopped by the platform).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                client.get_job_status(sid) not in JobStatus.TERMINAL:
+            time.sleep(0.25)
+        assert client.get_job_status(sid) == JobStatus.FAILED
+        # Detached actor survives the driver kill with its state...
+        handle = ray_tpu.get_actor("chaos-keeper")
+        assert ray_tpu.get(handle.bump.remote(), timeout=30) == 2
+        # ...the non-detached one is reclaimed with the job.
+        deadline = time.monotonic() + 30
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                ray_tpu.get_actor("chaos-eph")
+                time.sleep(0.25)
+            except ValueError:
+                gone = True
+        assert gone, "non-detached actor outlived its killed driver"
+        ray_tpu.kill(handle)
+    finally:
+        client.close()
+        ray_tpu.shutdown()

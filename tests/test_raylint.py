@@ -1516,3 +1516,104 @@ def test_rl013_scoped_to_data_package(tmp_path):
     mod = serve / "router.py"
     mod.write_text(textwrap.dedent(RL013_BAD_NO_BOUND))
     assert lint_file(str(mod), rule_ids=["RL013"]) == []
+
+
+# ------------------------------------------------------------------ RL018
+
+RL018_BAD_NO_TEARDOWN = """
+    class Admission:
+        def __init__(self):
+            self._jobs = {}
+
+        def register(self, job_hex, qos):
+            self._jobs[job_hex] = qos
+"""
+
+# Eviction exists, but on a path with no teardown shape: RL011 would be
+# satisfied, RL018 is not — job state must die on the job-finished path,
+# not wherever an unrelated refresh happens to run.
+RL018_BAD_EVICTION_OFF_TEARDOWN = """
+    class Admission:
+        def __init__(self):
+            self._jobs = {}
+
+        def register(self, job_hex, qos):
+            self._jobs[job_hex] = qos
+
+        def refresh(self, job_hex):
+            self._jobs.pop(job_hex, None)
+"""
+
+RL018_GOOD_UNREGISTER = """
+    class Admission:
+        def __init__(self):
+            self._jobs = {}
+
+        def register(self, job_hex, qos):
+            self._jobs[job_hex] = qos
+
+        def unregister(self, job_hex):
+            self._jobs.pop(job_hex, None)
+"""
+
+RL018_GOOD_SWEEP_REASSIGN = """
+    class Reaper:
+        def __init__(self):
+            self._finished_jobs = {}
+
+        def note(self, job_hex, ts):
+            self._finished_jobs[job_hex] = ts
+
+        def _sweep_finished_jobs(self, now):
+            self._finished_jobs = {h: t for h, t
+                                   in self._finished_jobs.items()
+                                   if now - t < 60.0}
+"""
+
+RL018_GOOD_NON_JOB_KEYS = """
+    class Router:
+        def __init__(self):
+            self._routes = {}
+
+        def learn(self, replica, addr):
+            self._routes[replica] = addr
+"""
+
+
+def test_rl018_flags_job_keyed_dict_without_teardown(tmp_path):
+    findings = lint_src(tmp_path, RL018_BAD_NO_TEARDOWN, rules=["RL018"])
+    assert rule_ids(findings) == ["RL018"]
+    assert "_jobs" in findings[0].message
+    assert "die with its job" in findings[0].message
+
+
+def test_rl018_flags_eviction_off_the_teardown_path(tmp_path):
+    findings = lint_src(tmp_path, RL018_BAD_EVICTION_OFF_TEARDOWN,
+                        rules=["RL018"])
+    assert rule_ids(findings) == ["RL018"]
+    # ...while RL011 is satisfied by the same snippet: the rules are
+    # answering different questions.
+    assert lint_src(tmp_path, RL018_BAD_EVICTION_OFF_TEARDOWN,
+                    rules=["RL011"]) == []
+
+
+def test_rl018_quiet_with_unregister_pop(tmp_path):
+    assert lint_src(tmp_path, RL018_GOOD_UNREGISTER, rules=["RL018"]) == []
+
+
+def test_rl018_quiet_with_sweep_reassignment(tmp_path):
+    assert lint_src(tmp_path, RL018_GOOD_SWEEP_REASSIGN,
+                    rules=["RL018"]) == []
+
+
+def test_rl018_quiet_on_non_job_keys(tmp_path):
+    assert lint_src(tmp_path, RL018_GOOD_NON_JOB_KEYS,
+                    rules=["RL018"]) == []
+
+
+def test_rl018_suppression_with_reason(tmp_path):
+    src = RL018_BAD_NO_TEARDOWN.replace(
+        "self._jobs[job_hex] = qos",
+        "self._jobs[job_hex] = qos  "
+        "# raylint: disable=RL018 — retained as the job history table")
+    assert lint_src(tmp_path, src, rules=["RL018"]) == []
