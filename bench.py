@@ -1000,6 +1000,77 @@ def _envelope100_main(n_nodes: int, managed: int, kills: int,
                 for cli in members:
                     cli.close()
 
+        # --- query exchange AT width: a distributed sort whose scatter/
+        # reduce state lives ONLY on the managed workers (tasks need
+        # CPU + slot: thin nodes have no CPU, the head no slot), with the
+        # busiest worker killed mid-exchange. The epoch must finish
+        # sorted and complete, with recompute bounded by the victim's
+        # resident blocks + n_parts and replacement driven by the
+        # autoscaler floor — the same invariant the tier-1 slow test
+        # checks at 3 nodes, here gated at 100.
+        from ray_tpu import data as _rd
+        from ray_tpu.chaos import HangWatchdog as _Watchdog
+        from ray_tpu.data.context import DataContext as _DataContext
+        from ray_tpu.data.streaming.lineage import (
+            core_reconstructions as _core_recon,
+        )
+
+        q_rows, q_parts = (8_000, 4) if smoke else (16_000, 8)
+
+        def _keyed(batch):
+            return {"k": (batch["data"][:, 0].astype(_np.int64)) % 50,
+                    "data": batch["data"]}
+
+        _ctx = _DataContext.get_current()
+        _old_inflight = _ctx.max_tasks_in_flight_per_op
+        # Throttled launch keeps the exchange mid-flight at kill time, so
+        # the victim's death destroys state the sort still needs.
+        _ctx.max_tasks_in_flight_per_op = 2
+        try:
+            qds = _rd.range_tensor(q_rows, shape=(64,),
+                                   parallelism=q_parts) \
+                .with_resources(resources={"slot": 0.05}) \
+                .map_batches(_keyed).sort(key="k")
+            q_base = _core_recon()
+            q_rows_seen, q_last, q_killed = 0, None, {}
+            t_kill = 0.0
+            with _Watchdog(limit_s=90.0) as wd:
+                for i, batch in enumerate(qds.iter_batches(batch_size=512)):
+                    q_rows_seen += len(batch["k"])
+                    ks = _np.asarray(batch["k"])
+                    assert (_np.diff(ks) >= 0).all()
+                    if q_last is not None:
+                        assert ks[0] >= q_last
+                    q_last = int(ks[-1])
+                    if i == 1 and not q_killed:
+                        victim = max(
+                            (r for r in cluster.raylets if not r.is_head
+                             and r.resources.total.get("CPU")),
+                            key=lambda r: r.store.stats()["num_objects"])
+                        q_killed["resident"] = \
+                            victim.store.stats()["num_objects"]
+                        t_kill = _time.perf_counter()
+                        cluster.crash_node(victim)
+            wd.assert_no_hangs()
+            assert q_rows_seen == q_rows, \
+                f"query leg lost rows: {q_rows_seen}/{q_rows}"
+            q_recomputed = (_core_recon() - q_base) \
+                + (qds._lineage.recomputed_blocks if qds._lineage else 0)
+            assert q_recomputed >= 1, \
+                "the kill destroyed nothing the sort used"
+            q_bound = max(q_killed.get("resident", 0), 1) + q_parts
+            assert q_recomputed <= q_bound, (q_recomputed, q_killed)
+            out["envelope100_query_rows"] = q_rows_seen
+            out["envelope100_query_recomputed_blocks"] = q_recomputed
+            out["envelope100_query_kill_recovered_s"] = round(
+                _time.perf_counter() - t_kill, 2)
+            out["envelope100_query_zero_hangs"] = wd.hang_count == 0
+        finally:
+            _ctx.max_tasks_in_flight_per_op = _old_inflight
+        # The autoscaler refills the floor before the chaos phase leans
+        # on the same fleet.
+        cluster.wait_for_nodes(timeout=120)
+
         # --- chaos AT width: the PR-10 schedule with autoscaler-driven
         # replacement, under continuous direct-path task load. The
         # side-channel exec marks prove lease-cache invalidation: a task
@@ -1141,11 +1212,17 @@ def _pull_micro_main(obj_mb: int, delay_ms: float) -> dict:
 
     chunk = 1 << 20
     GLOBAL_CONFIG._overrides["object_transfer_chunk_bytes"] = chunk
+    # The window/latency arms measure the SOCKET path; on this one-host
+    # bench every raylet is same-host, so the sealed-segment attach fast
+    # path would silently replace the link under test. Off for the
+    # legacy arms, re-enabled for the attach arm below.
+    GLOBAL_CONFIG._overrides["object_transfer_same_host_attach"] = False
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
     cluster.add_node(num_cpus=1)
     cluster.add_node(num_cpus=1)
     cluster.wait_for_nodes()
     out: dict = {}
+    session_suffix = cluster.raylets[0].session_suffix
     try:
         seed, p1, p2 = cluster.raylets
         size = obj_mb << 20
@@ -1180,8 +1257,34 @@ def _pull_micro_main(obj_mb: int, delay_ms: float) -> dict:
         out["pull_window4_s"] = round(w4, 4)
         out["pull_pipeline_speedup"] = round(w1 / w4, 3)
         out["pull_raw_gb_s"] = round(size / raw / 1e9, 3)
+
+        # --- same-host sealed-segment attach: the zero-socket handoff.
+        # No link model armed on either side, knob on: the pull must
+        # adopt the holder's segment (tmpfs hardlink — zero bytes
+        # moved), serve zero chunk bytes, leave zero unsealed buffers,
+        # and clear 2.0 GB/s.
+        GLOBAL_CONFIG._overrides.pop("object_transfer_same_host_attach",
+                                     None)
+        p2._chunk_fetch_delay_s = 0.0
+        served_before = seed._chunk_bytes_served
+        attach_s = pull(p2, seed_obj(4), window=4)
+        assert p2._attach_hits >= 1, \
+            "same-host pull took the socket path, not the attach path"
+        assert seed._chunk_bytes_served == served_before, \
+            "attach arm served chunk bytes over the socket"
+        for r in cluster.raylets:
+            assert r.store.stats()["num_unsealed"] == 0
+        out["pull_attach_gb_s"] = round(size / attach_s / 1e9, 3)
+        out["pull_attach_bytes"] = p2._attach_bytes
+        assert out["pull_attach_gb_s"] >= 2.0, \
+            f"same-host attach {out['pull_attach_gb_s']} GB/s < 2.0 GB/s"
     finally:
         cluster.shutdown()
+    # Zero leaked segments: after shutdown every shm segment of this
+    # session (sealed objects AND attach staging) must be unlinked.
+    leaked = [n for n in os.listdir("/dev/shm") if session_suffix in n]
+    assert not leaked, f"leaked shm segments: {leaked[:5]}"
+    out["pull_attach_leaked_segments"] = 0
     return out
 
 
@@ -3027,6 +3130,204 @@ def bench_ingest(quick: bool, smoke: bool = False,
     return out
 
 
+def bench_query(quick: bool, smoke: bool = False,
+                seed: int = 20260807) -> dict:
+    """Distributed query tier acceptance bench (ISSUE 18): width-scale
+    sort/groupby/join through the windowed shuffle, plus the locality-
+    routing A/B.
+
+    Phase A measures the exchange operators against a SAME-RUN anchor
+    (one plain streaming pass over identical rows — normalizes the
+    2-core sandbox out of the numbers) with row-identity verified inline
+    and the driver's sort footprint asserted bounded by the key sample.
+    `query_regressed` is a soft flag (printed, never fatal) when the
+    sort exceeds 12x the anchor pass.
+
+    Phase B A/Bs locality-routed split handout: two consumers pinned to
+    the two block-holding nodes drain the same-shape dataset with
+    routing off then on, and the cross-node byte meter (summed
+    `_chunk_bytes_served` over all raylets; the same-host attach is
+    disabled so every remote pull pays the socket) must drop. HARD
+    asserts: row totals, routed arm strictly cheaper, zero unsealed
+    buffers.
+
+    `smoke=True` (gate step) runs both phases at bounded sizes, <60s."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.data.context import DataContext
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 3})
+    # Per-node pin resources make Phase B's consumer placement exact:
+    # consumer i sits WITH (then, in the off arm, WITHOUT) its blocks.
+    for i in range(2):
+        cluster.add_node(num_cpus=2,
+                         resources={"churn": 2, f"pin{i}": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+    out: dict = {"query_seed": seed}
+    try:
+        # --- Phase A: exchange operators vs same-run anchor ------------
+        rows = 20_000 if (smoke or quick) else 60_000
+        n_parts = 8
+
+        def keyed(batch):
+            return {"k": (batch["data"][:, 0].astype(np.int64)) % 97,
+                    "data": batch["data"]}
+
+        base = rd.range_tensor(rows, shape=(16,), parallelism=n_parts) \
+            .map_batches(keyed)
+
+        t0 = time.perf_counter()
+        anchor_rows = sum(len(b["k"])
+                          for b in base.iter_batches(batch_size=2048))
+        anchor_s = time.perf_counter() - t0
+        assert anchor_rows == rows
+
+        ds_sort = base.sort(key="k")
+        t0 = time.perf_counter()
+        sorted_rows, nbytes, last = 0, 0, None
+        for batch in ds_sort.iter_batches(batch_size=2048):
+            ks = np.asarray(batch["k"])
+            sorted_rows += len(ks)
+            nbytes += batch["data"].nbytes
+            assert (np.diff(ks) >= 0).all(), "sort output out of order"
+            if last is not None:
+                assert ks[0] >= last
+            last = int(ks[-1])
+        sort_s = time.perf_counter() - t0
+        assert sorted_rows == rows, f"sort lost rows: {sorted_rows}/{rows}"
+        sstats = ds_sort.last_sort_stats
+        # The driver's whole per-row footprint is the boundary sample.
+        assert sstats["driver_sample_bytes"] <= 64 * 1024, sstats
+        out["query_sort_sample_rows"] = sstats["sample_rows"]
+        out["query_sort_driver_sample_bytes"] = sstats["driver_sample_bytes"]
+        out["query_sort_gb_s"] = round(nbytes / 1e9 / sort_s, 4)
+
+        t0 = time.perf_counter()
+        groups = base.groupby("k").count().take_all()
+        groupby_s = time.perf_counter() - t0
+        assert sum(g["count()"] for g in groups) == rows
+        assert len(groups) == 97
+
+        left = rd.from_items(
+            [{"id": i % 512, "lv": i} for i in range(rows // 4)],
+            parallelism=n_parts)
+        right = rd.from_items(
+            [{"id": i, "rv": i * 3} for i in range(512)], parallelism=2)
+        ctx = DataContext.get_current()
+        old_bj = ctx.broadcast_join_bytes
+        try:
+            ctx.broadcast_join_bytes = 0  # force the hash exchange
+            ds_join = left.join(right, on="id")
+            t0 = time.perf_counter()
+            join_rows = sum(1 for _ in ds_join.iter_rows())
+            join_s = time.perf_counter() - t0
+        finally:
+            ctx.broadcast_join_bytes = old_bj
+        assert join_rows == rows // 4, f"join lost rows: {join_rows}"
+        assert ds_join.last_join_stats["strategy"] == "hash"
+
+        out["query_anchor_pass_s"] = round(anchor_s, 3)
+        out["query_sort_s"] = round(sort_s, 3)
+        out["query_groupby_s"] = round(groupby_s, 3)
+        out["query_join_s"] = round(join_s, 3)
+        # Soft regression flag (chaos_mttr_regressed convention): the
+        # exchange adds sample+scatter+reduce over a plain pass; 12x the
+        # same-run anchor flags a pathological slowdown, not noise.
+        if sort_s > 12 * max(anchor_s, 0.05):
+            out["query_regressed"] = True
+            print(f"WARNING: query sort {sort_s:.2f}s exceeds 12x the "
+                  f"same-run anchor pass {anchor_s:.2f}s", file=sys.stderr)
+
+        # --- Phase B: locality-routed handout A/B ----------------------
+        # Socket path only: the same-host attach would hide exactly the
+        # bytes this A/B exists to measure.
+        GLOBAL_CONFIG._overrides["object_transfer_same_host_attach"] = False
+
+        @ray_tpu.remote(num_cpus=1)
+        class ShardConsumer:
+            def consume(self, shard, routing: bool) -> dict:
+                from ray_tpu.data.context import DataContext as _DC
+
+                # The knob is resolved consumer-side (this process).
+                _DC.get_current().locality_routing = bool(routing)
+                n = 0
+                for b in shard.iter_batches(batch_size=512):
+                    n += len(b["data"])
+                st = shard.ingest_stats()
+                return {"rows": n,
+                        "locality_hits": st["locality_hits"],
+                        "locality_misses": st["locality_misses"]}
+
+        # Deterministic placement: 8 blocks pinned to EACH worker (the
+        # pin resources), interleaved so the coordinator's lookahead
+        # always holds a block local to either consumer. Blocks are
+        # 512 KiB — real store residency with directory entries (inline
+        # blocks live nowhere and can't be routed to).
+        @ray_tpu.remote(num_cpus=1)
+        def make_block(tag: int):
+            import numpy as _inp
+            return {"data": _inp.full((2000, 32), float(tag))}
+
+        n_per_node = 8
+        ref_grid = [[make_block.options(
+            resources={f"pin{i}": 0.01}).remote(i * n_per_node + j)
+            for j in range(n_per_node)] for i in range(2)]
+        refs = [ref_grid[i][j] for j in range(n_per_node)
+                for i in range(2)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+        ab_rows = 2000 * len(refs)
+
+        from ray_tpu.data.dataset import Dataset as _DSet
+
+        def run_arm(routing: bool) -> dict:
+            ds = _DSet([(None, (r,)) for r in refs])
+            shards = rd.DataIterator(ds).iter_shards(2, prefetch=0)
+            served0 = sum(r._chunk_bytes_served for r in cluster.raylets)
+            actors = [ShardConsumer.options(
+                resources={f"pin{i}": 1}).remote() for i in range(2)]
+            try:
+                results = ray_tpu.get(
+                    [a.consume.remote(s, routing)
+                     for a, s in zip(actors, shards)], timeout=300)
+            finally:
+                for a in actors:
+                    ray_tpu.kill(a)
+            served = sum(r._chunk_bytes_served
+                         for r in cluster.raylets) - served0
+            assert sum(r["rows"] for r in results) == ab_rows
+            return {"cross_node_bytes": served,
+                    "hits": sum(r["locality_hits"] for r in results),
+                    "misses": sum(r["locality_misses"] for r in results)}
+
+        off = run_arm(routing=False)
+        on = run_arm(routing=True)
+        GLOBAL_CONFIG._overrides.pop("object_transfer_same_host_attach",
+                                     None)
+        out["query_locality_bytes_off"] = off["cross_node_bytes"]
+        out["query_locality_bytes_on"] = on["cross_node_bytes"]
+        out["query_locality_hits_on"] = on["hits"]
+        assert off["hits"] == 0, off  # routing off advertises no node
+        assert on["hits"] >= 1, \
+            f"locality routing never landed a local block: {on}"
+        assert on["cross_node_bytes"] < off["cross_node_bytes"], (
+            "locality routing did not reduce cross-node bytes: "
+            f"on={on} off={off}")
+        for r in cluster.raylets:
+            assert r.store.stats()["num_unsealed"] == 0
+    finally:
+        try:
+            cluster.shutdown()
+        except Exception:  # noqa: BLE001 — nodes already churned away
+            pass
+    return out
+
+
 # --------------------------------------------------------------------------- #
 # Job tier: submission plane, runtime-env forge templates, jobs-as-tenants
 # --------------------------------------------------------------------------- #
@@ -3321,6 +3622,16 @@ def main(out=None):
                          "runs, hard asserts on zero recompiles and "
                          "zero leaked blocks) and exit nonzero on any "
                          "invariant breach")
+    ap.add_argument("--skip-query", action="store_true",
+                    help="skip the distributed query bench (sort/"
+                         "groupby/join through the windowed shuffle + "
+                         "locality-routing A/B)")
+    ap.add_argument("--query-smoke", action="store_true",
+                    help="run ONLY the bounded query smoke (gate step: "
+                         "sort/groupby/join row-identity with bounded "
+                         "driver sample + locality A/B cross-node byte "
+                         "drop, <60s) and exit nonzero on any invariant "
+                         "breach")
     ap.add_argument("--skip-jobs", action="store_true",
                     help="skip the job-tier bench (submission plane, "
                          "runtime-env forge, jobs-as-tenants)")
@@ -3367,6 +3678,18 @@ def main(out=None):
                               f"{type(e).__name__}: {e}"}), file=stream)
             sys.exit(1)
         print(json.dumps({"inference_smoke": smoke}), file=stream)
+        stream.flush()
+        sys.exit(0)
+
+    if args.query_smoke:
+        stream = out or sys.stdout
+        try:
+            smoke = bench_query(quick=True, smoke=True)
+        except Exception as e:  # noqa: BLE001 — the gate needs the reason
+            print(json.dumps({"query_smoke_error":
+                              f"{type(e).__name__}: {e}"}), file=stream)
+            sys.exit(1)
+        print(json.dumps({"query_smoke": smoke}), file=stream)
         stream.flush()
         sys.exit(0)
 
@@ -3510,6 +3833,11 @@ def main(out=None):
             extra.update(bench_ingest(args.quick))
         except Exception as e:  # noqa: BLE001
             extra["ingest_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_query:
+        try:
+            extra.update(bench_query(args.quick))
+        except Exception as e:  # noqa: BLE001
+            extra["query_error"] = f"{type(e).__name__}: {e}"
     if not args.skip_jobs:
         try:
             extra.update(bench_jobs(args.quick))

@@ -39,6 +39,12 @@ for the catalog with real before/after examples):
                                   multi-job platform's churn contract:
                                   job state dies WITH the job, not with
                                   an unrelated LRU — docs/JOBS.md)
+- RL019 driver-materialization — data-plane code never collects a whole
+                                  row/block iterator into driver memory
+                                  (the query tier's scalability
+                                  contract: drivers hold bounded
+                                  metadata, operators run in the
+                                  exchange — docs/DATA_QUERY.md)
 
 (RL014 rpc-contract, RL015 config-knob-drift and RL016
 loop-confined-escape are whole-program rules — they live in
@@ -2027,3 +2033,91 @@ def rl018_job_scoped_state(ctx: FileContext) -> Iterable[Finding]:
                 "(docs/JOBS.md): evict it on the job-finished/"
                 "unregister/sweep path or annotate why the key space "
                 "is bounded")
+
+
+# =====================================================================
+# RL019 driver-materialization
+# =====================================================================
+#
+# The query tier's scalability contract (docs/DATA_QUERY.md): sort,
+# groupby and join run as budget-bounded dataflows through the
+# exchange; the DRIVER holds bounded metadata — refs, a capped key
+# sample, range boundaries — never the rows. The shape that silently
+# breaks this is a helper that collects a whole row/block iterator into
+# driver memory:
+#
+#   rows = [r for r in ds.iter_rows()]          # every row, driver-RAM
+#   blocks = list(parent._iter_block_values())  # every block
+#   vals = ray_tpu.get([r for r in refs])       # every block, at once
+#
+# Each is O(dataset) driver memory: correct on toy inputs, an OOM (and
+# a scalability lie — the operator LOOKS distributed) at width.
+# Flagged shapes, in data-plane modules only:
+#
+#  (a) list()/sorted()/tuple() directly over a row/block iterator call
+#      (.iter_rows() / ._iter_block_values() / .take_all());
+#  (b) a list/set/dict comprehension iterating such a call;
+#  (c) ray_tpu.get / ray.get of a LIST of refs (literal or
+#      comprehension) — a bulk get materializes every block at once
+#      even though each ref is bounded metadata on its own.
+#
+# Streaming a `for` loop over the same iterators is fine (one block
+# resident at a time; accumulation is RL013's jurisdiction), and
+# ref-level iteration (`_iter_block_refs`) is always fine — refs are
+# bounded metadata. Deliberately driver-resident ENDPOINTS — take_all,
+# to_pandas, the user asked for a local copy — annotate with
+# `# raylint: disable=RL019 — <why the copy is the contract>`.
+
+_RL019_ITERS = {"iter_rows", "_iter_block_values", "take_all"}
+_RL019_COLLECTORS = {"list", "sorted", "tuple"}
+_RL019_GETTERS = {"ray_tpu.get", "ray.get"}
+
+
+def _rl019_iter_call(node: ast.AST) -> Optional[str]:
+    """The iterator-method name when `node` is a call of a whole-dataset
+    row/block iterator, else None."""
+    if isinstance(node, ast.Call):
+        name = last_segment(dotted(node.func))
+        if name in _RL019_ITERS:
+            return name
+    return None
+
+
+@rule("RL019", "driver-materialization: data-plane code collects a whole "
+               "row/block iterator (or a ref list, by value) into driver "
+               "memory")
+def rl019_driver_materialization(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope_rl013(ctx.path):  # same patrol area: the data plane
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in _RL019_COLLECTORS and node.args:
+            name = _rl019_iter_call(node.args[0])
+            if name:
+                yield ctx.finding(
+                    node, "RL019",
+                    f"{node.func.id}(...{name}()) materializes the whole "
+                    "dataset in driver memory — O(dataset) RAM where the "
+                    "contract is bounded metadata; stream the iterator, "
+                    "push the work through the exchange, or annotate why "
+                    "this endpoint is deliberately driver-resident")
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            for gen in node.generators:
+                name = _rl019_iter_call(gen.iter)
+                if name:
+                    yield ctx.finding(
+                        node, "RL019",
+                        f"comprehension over {name}() materializes the "
+                        "whole dataset in driver memory — O(dataset) RAM "
+                        "where the contract is bounded metadata; stream "
+                        "it block-by-block or run the operator in the "
+                        "exchange (or annotate the deliberate endpoint)")
+                    break
+        elif isinstance(node, ast.Call) \
+                and dotted(node.func) in _RL019_GETTERS and node.args \
+                and isinstance(node.args[0], (ast.List, ast.ListComp)):
+            yield ctx.finding(
+                node, "RL019",
+                "bulk get of a ref list resolves every block into driver "
+                "memory simultaneously — pass refs onward (tasks resolve "
+                "them where they run) or get them one window at a time")

@@ -157,6 +157,9 @@ class NodeInfo:
             "NodeManagerAddress": self.ip,
             "NodeManagerHostname": self.hostname,
             "RayletAddress": self.address,
+            # shm namespace of the node's store: same-host consumers
+            # attach sealed segments by name (zero-socket handoff).
+            "SessionSuffix": self.session_suffix,
             "Resources": dict(self.resources_total),
             "Available": dict(self.resources_available),
             "Labels": dict(self.labels),

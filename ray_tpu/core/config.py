@@ -163,6 +163,34 @@ _flag("data_prefetch_shards", int, 2,
       "consuming step (per-host double buffering over the transfer "
       "plane); 0 disables prefetch (every batch pays its pull latency "
       "in step-stall time)")
+_flag("data_tenant_budget_bytes", int, 0,
+      "Per-tenant cap on data-plane in-flight bytes, summed across every "
+      "ByteBudget the tenant's executions hold (tenant = "
+      "DataContext.tenant, else the submitting job id, else 'default'). "
+      "Admission past the cap is refused with backpressure — the "
+      "execution drains and retries instead of silently starving a "
+      "sibling tenant's working set out of the store. A tenant with "
+      "nothing in flight is always admitted (progress guarantee, same "
+      "shape as the per-op one). 0 disables tenant capping")
+_flag("data_locality_routing", _parse_bool, True,
+      "Locality-routed data-plane consumption: shuffle-reduce tasks are "
+      "NodeAffinity(soft)-placed on the node holding the most bucket "
+      "bytes, and split-coordinator shard pulls prefer blocks already "
+      "resident on the consumer's node (lookahead reorder within the "
+      "coordinator's window). Off: reduces schedule wherever the "
+      "default policy lands and shards hand out blocks strictly FIFO")
+_flag("query_sort_sample_rows", int, 1024,
+      "Distributed sort: total key samples pulled to the driver to pick "
+      "range-partition boundaries. This bounds DRIVER-resident bytes for "
+      "a sort of any size — the rows themselves only ever move through "
+      "the windowed shuffle. More samples = tighter partition balance "
+      "on skewed keys")
+_flag("query_broadcast_join_bytes", int, 4 * 1024 * 1024,
+      "Join strategy cutover: a build (right) side at or below this many "
+      "bytes is broadcast — shipped once per node over the transfer "
+      "plane's partial-location tree and joined against each probe "
+      "block in place — instead of hash-shuffling both sides. 0 forces "
+      "the hash-shuffle path always")
 _flag("lineage_max_bytes", int, 64 * 1024 * 1024, "Max lineage bytes retained for reconstruction")
 _flag("max_object_reconstructions", int, 3, "Owner-side re-executions of a creating task after object loss")
 _flag("max_reconstruction_depth", int, 16, "Max recursive dependency depth for lineage reconstruction")
@@ -184,6 +212,16 @@ _flag("object_transfer_sender_concurrency", int, 4,
 _flag("object_transfer_refetch_location_chunks", int, 8,
       "Re-query the object directory for new locations every N completed "
       "chunks during a pull (late-joining sources get picked up mid-pull)")
+_flag("object_transfer_same_host_attach", _parse_bool, True,
+      "Same-host fast path for pulls: when a holder raylet shares this "
+      "host, attach its SEALED shm segment by name and memcpy directly "
+      "into the local store — zero socket copies, no chunk RPCs. Safe "
+      "by construction: the final segment name only exists after the "
+      "atomic-rename seal, so an attach can never observe torn bytes "
+      "(FileNotFoundError = not same host or not sealed yet, and the "
+      "pull falls back to the chunked transfer plane). Benches that "
+      "model link bandwidth disable it per-arm so topology numbers "
+      "stay honest")
 _flag("collective_stall_timeout_s", float, 60.0,
       "Host-collective abort horizon: an op waiting on a peer contribution "
       "this long with no progress raises CollectiveError instead of "

@@ -377,14 +377,17 @@ class SharedMemoryStore:
 
     def adopt(self, object_id: ObjectID, size: int):
         """Track a segment created and sealed by another local process
-        (driver/worker `put`): attach it and account for its memory."""
+        (driver/worker `put`) or hardlinked in by the raylet's same-host
+        attach: attach it and account for its memory. Capacity is
+        ensured BEFORE attaching so a full store never leaks the
+        mapping."""
         with self._lock:
             if object_id in self._objects:
                 return
+            self._ensure_capacity(size)
             # Attach registers with the resource tracker (3.12 behavior); the
             # eventual unlink() in delete() unregisters — keep them balanced.
             shm = shared_memory.SharedMemory(name=_segment_name(self._session, object_id))
-            self._ensure_capacity(size)
             self._objects[object_id] = _LocalObject(object_id, size, sealed=True, shm=shm)
             self._used += size
 

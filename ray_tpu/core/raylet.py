@@ -33,6 +33,7 @@ import subprocess
 import sys
 import threading
 import time
+import weakref
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -661,6 +662,15 @@ class QueuedTask:
     lease_req_id: Optional[bytes] = None
 
 
+# In-process raylet registry (fake clusters / tests / benches run many
+# raylets in one process). The same-host attach path consults it for two
+# things: resolving a holder's shm session suffix without an RPC, and —
+# bench honesty — detecting that the SPECIFIC holder models a network
+# link (_chunk_serve_delay_s / _chunk_serve_bw_bps), in which case the
+# attach bypass must stand down so link-model numbers stay meaningful.
+_LOCAL_RAYLETS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
 class Raylet:
     def __init__(
         self,
@@ -688,6 +698,7 @@ class Raylet:
             capacity_bytes=object_store_memory,
             spill_dir=os.path.join(session_dir, "spill"),
         )
+        _LOCAL_RAYLETS[self.node_id.hex()] = self
         cpus = int(resources.get(CPU, 1) or 1)
         self.pool = WorkerPool(self, max_workers=max(4, cpus * 4))
         # CPU workers no longer pay the site-level jax import at spawn
@@ -728,6 +739,14 @@ class Raylet:
         self._completed_pullers: Dict[bytes, Dict[str, float]] = {}
         self._chunk_serve_delay_s = 0.0   # sender occupancy per chunk
         self._chunk_fetch_delay_s = 0.0   # per-RPC RTT on the pull side
+        # Same-host sealed-segment attach (zero-socket handoff): a pull
+        # whose holder shares this host copies the sealed shm segment
+        # directly instead of chunking over the wire. Counters feed
+        # debug_state and the pull microbench's attach arm.
+        self._attach_hits = 0
+        self._attach_bytes = 0
+        self._chunk_bytes_served = 0      # egress actually sent via RPC
+        self._peer_suffix_cache: Dict[str, str] = {}
         # Test/bench link model: when set, ALL chunk egress from this node
         # serializes through one token (a NIC) at this many bytes/s —
         # sleeps, never spins, so the modeled network dominates instead of
@@ -2466,6 +2485,12 @@ class Raylet:
                 return False
         if self.store.contains(oid):
             return True
+        # Same-host fast path: a holder sharing this host already has the
+        # sealed bytes in /dev/shm — attach its segment by final name
+        # (atomic-rename seal => never torn) and memcpy shm->shm, no
+        # socket hop at all. Falls through to the chunk pull on any miss.
+        if self._try_same_host_attach(oid, entry, size):
+            return True
         try:
             buf = self.store.create(oid, size)
         except ObjectStoreFullError as e:
@@ -2583,6 +2608,189 @@ class Raylet:
                         self._stale_partials.discard(oid)
                 except Exception:  # noqa: BLE001 — heartbeat retries
                     pass
+
+    # ---------------------------------------------------- same-host attach
+
+    def _session_suffix_for(self, node_hex: str) -> Optional[str]:
+        """shm session suffix of a SAME-HOST holder; None when the node
+        is remote, dead, or unknown. In-process registry first (free),
+        then the directory's SessionSuffix (hostname-gated), then the
+        peer RPC — cached, since a node's suffix never changes."""
+        peer = _LOCAL_RAYLETS.get(node_hex)
+        if peer is not None:
+            return peer.session_suffix
+        cached = self._peer_suffix_cache.get(node_hex)
+        if cached is not None:
+            return cached or None  # "" caches a known-remote node
+        my_host = self._node_info.hostname if self._node_info else ""
+        suffix = ""
+        try:
+            for n in self.gcs.call("get_nodes", timeout=5):
+                if n["NodeID"] != node_hex or not n["Alive"]:
+                    continue
+                if n.get("NodeManagerHostname") != my_host:
+                    break  # different host: shm can't reach it
+                suffix = n.get("SessionSuffix") or ""
+                break
+        except Exception:  # noqa: BLE001 — advisory; chunk pull covers it
+            return None
+        if not suffix:
+            try:
+                addr = self._addr_for_node(node_hex)
+                if addr:
+                    resp = self._peer(addr).call("get_session_suffix",
+                                                 timeout=5)
+                    suffix = resp.get("session_suffix") or ""
+            except Exception:  # noqa: BLE001
+                suffix = ""
+        # raylint: disable=RL011,RL012 — keyed by node id (bounded by lifetime cluster membership, ids never reused); a dead node's entry is inert: the directory stops listing it as a holder, so the key is never consulted again
+        self._peer_suffix_cache[node_hex] = suffix
+        return suffix or None
+
+    def _try_same_host_attach(self, oid: ObjectID, entry: Dict[str, Any],
+                              size: int) -> bool:
+        """Adopt a sealed object from a same-host holder's shm segment
+        into this node's store, bypassing the chunk protocol entirely.
+        Only FULL holders qualify (a partial holder's segment is
+        unsealed => unattachable by final name, by construction).
+        Declines whenever a transfer-shaping hook is armed on either
+        end, so benches that model a network keep measuring the
+        network."""
+        if not GLOBAL_CONFIG.object_transfer_same_host_attach:
+            return False
+        if self._chunk_fetch_delay_s:
+            return False  # this puller models per-RPC RTT: stay honest
+        my_hex = self.node_id.hex()
+        for n in entry.get("nodes") or ():
+            node_hex = n.hex() if hasattr(n, "hex") else str(n)
+            if node_hex == my_hex:
+                continue
+            peer = _LOCAL_RAYLETS.get(node_hex)
+            if peer is not None and (peer._chunk_serve_delay_s
+                                     or peer._chunk_serve_bw_bps):
+                continue  # holder models a link: pull through it instead
+            suffix = self._session_suffix_for(node_hex)
+            if not suffix:
+                continue
+            if self._attach_copy_from_segment(oid, suffix, size):
+                return True
+        return False
+
+    def _attach_copy_from_segment(self, oid: ObjectID, peer_suffix: str,
+                                  size: int) -> bool:
+        """Adopt `rtpu_{peer_suffix}_{oid}` as this node's copy via a
+        tmpfs HARDLINK to our own session name — zero bytes moved. Both
+        names share the inode; the holder's eventual unlink drops only
+        its name, so our copy's lifetime is independent (POSIX frees the
+        pages when the last name AND mapping are gone). The final name
+        only exists AFTER the holder's atomic-rename seal, so the link
+        target is complete by construction. Pool-recycle safety: a
+        holder's SegmentPool rewrites an inode only after the GCS
+        confirmed the object freed cluster-wide — at which point reads
+        of it anywhere are already undefined, same as holder-local
+        zero-copy views. Falls back to a memcpy adoption where shm is
+        not a linkable filesystem."""
+        import os as _os
+
+        from ray_tpu.core.object_store import (
+            _SHM_DIR,
+            _STAGING,
+            _segment_name,
+        )
+
+        if not _STAGING:  # no linkable /dev/shm on this platform
+            return self._attach_memcpy_from_segment(oid, peer_suffix, size)
+        src = _os.path.join(_SHM_DIR, _segment_name(peer_suffix, oid))
+        dst = _os.path.join(_SHM_DIR,
+                            _segment_name(self.session_suffix, oid))
+        try:
+            _os.link(src, dst)
+        except FileNotFoundError:
+            return False  # evicted/spilled since the directory answered
+        except FileExistsError:
+            if self.store.contains(oid):
+                return True  # raced another pull of the same object
+            # Stale file under our name (ours to manage): replace it.
+            try:
+                _os.unlink(dst)
+                _os.link(src, dst)
+            except OSError:
+                return False
+        except OSError:
+            return self._attach_memcpy_from_segment(oid, peer_suffix,
+                                                    size)
+        try:
+            if _os.stat(dst).st_size < size:
+                _os.unlink(dst)
+                return False  # stale directory size: not a copy to trust
+            try:
+                self.store.adopt(oid, size)
+            except ObjectStoreFullError as e:
+                with self._lock:
+                    self._pull_errors[oid] = str(e)
+                _os.unlink(dst)
+                raise
+        except OSError:
+            return False  # holder unlinked the inode mid-adopt: chunk path
+        with self._lock:
+            self._pull_errors.pop(oid, None)
+            self._attach_hits += 1
+            self._attach_bytes += size
+        self._announce_attached(oid, size)
+        return True
+
+    def _attach_memcpy_from_segment(self, oid: ObjectID, peer_suffix: str,
+                                    size: int) -> bool:
+        """Portability fallback for `_attach_copy_from_segment`: attach
+        the holder's segment read-only and memcpy it into our own store
+        (create -> copy -> seal). An open mapping keeps the bytes alive
+        for the copy even if the holder unlinks mid-read (POSIX)."""
+        from multiprocessing import shared_memory
+
+        from ray_tpu._native import copy_at
+        from ray_tpu.core.object_store import _segment_name, _untrack
+
+        try:
+            shm = shared_memory.SharedMemory(
+                name=_segment_name(peer_suffix, oid))
+        except FileNotFoundError:
+            return False  # evicted/spilled since the directory answered
+        except Exception:  # noqa: BLE001 — permissions, platform quirks
+            return False
+        _untrack(shm)  # the holder owns the segment's lifetime, not us
+        try:
+            if shm.size < size:
+                return False  # stale directory size: not our copy to trust
+            try:
+                buf = self.store.create(oid, size)
+            except ObjectStoreFullError as e:
+                with self._lock:
+                    self._pull_errors[oid] = str(e)
+                raise
+            copy_at(buf, 0, shm.buf[:size])
+            self.store.seal(oid)
+            with self._lock:
+                self._pull_errors.pop(oid, None)
+                self._attach_hits += 1
+                self._attach_bytes += size
+            self._announce_attached(oid, size)
+            return True
+        finally:
+            try:
+                shm.close()
+            except BufferError:
+                pass  # transient view still alive; kernel reclaims at exit
+
+    def _announce_attached(self, oid: ObjectID, size: int):
+        """Register this node as a holder of a just-adopted object so
+        later pullers can route (or attach) to us."""
+        try:
+            self.gcs.call("object_location_add",
+                          {"object_id": oid, "node_id": self.node_id,
+                           "size": size}, timeout=10)
+        except Exception:  # noqa: BLE001 — heartbeat re-announces
+            with self._lock:
+                self._unannounced_objects[oid] = size
 
     def _pull_chunk_worker(self, oid: ObjectID, state: _ActivePull,
                            peers: _PeerSet, plan: Dict[str, Any],
@@ -2930,6 +3138,11 @@ class Raylet:
                     time.sleep(  # raylint: disable=RL002
                         (end - offset) / self._chunk_serve_bw_bps)
             self._record_outbound(oid, puller, offset, end - offset, size)
+            with self._outbound_lock:
+                # Cross-node byte meter: benches A/B locality routing by
+                # summing this over all raylets (attach hits never pass
+                # here — that's the point).
+                self._chunk_bytes_served += end - offset
             conn.reply_raw(msg_id, "pull_object_chunk",
                            _pack_chunk_reply({"st": "ok", "s": size},
                                              buf[offset:end]))
@@ -3202,4 +3415,9 @@ class Raylet:
                 "resources_total": total,
                 "resources_available": avail,
                 "store": self.store.stats(),
+                "transfer": {
+                    "attach_hits": self._attach_hits,
+                    "attach_bytes": self._attach_bytes,
+                    "chunk_bytes_served": self._chunk_bytes_served,
+                },
             }

@@ -191,6 +191,9 @@ class CoreRuntime:
         # wait_for_actor: suppresses the per-poll directory query).
         self._created_pending: set = set()
         self._raylet_clients: Dict[str, RpcClient] = {raylet_address: self.raylet}
+        # addr -> monotonic time of last failed dial (see _raylet_for);
+        # entries expire after _DEAD_DIAL_TTL_S and are pruned inline.
+        self._raylet_dial_failures: Dict[str, float] = {}
         # By-value argument dedupe cache (see serialize_args): LRU of
         # (type, value) -> serialized blob, hard-capped by
         # arg_dedupe_cache_entries (evicted oldest-first on insert).
@@ -877,7 +880,12 @@ class CoreRuntime:
                     # The node the router chose died between its view
                     # refresh and our dial (a kill can land at any
                     # instant): one transparent re-route via the local
-                    # raylet, never a raised submit.
+                    # raylet, never a raised submit. Brief pause first —
+                    # dead dials now fail in milliseconds (negative
+                    # cache), so without it the 8-hop budget can burn
+                    # out before the router's node view catches up with
+                    # the death we just observed.
+                    time.sleep(0.1)
                     target = self.raylet
                     target_addr = self.raylet.address
                 spilled = target is not self.raylet
@@ -885,16 +893,62 @@ class CoreRuntime:
             raise RaySystemError(f"unexpected submit status {resp}")
         raise RaySystemError("task spillback loop exceeded 8 hops")
 
+    # A failed dial is remembered this long; within the window further
+    # dials to the address fail instantly instead of re-running the
+    # connect-retry loop (a raylet never restarts on an old address — a
+    # new raylet gets a new port — so "recently refused" means dead).
+    _DEAD_DIAL_TTL_S = 5.0
+
     def _raylet_for(self, address: str) -> RpcClient:
         with self._lock:
             client = self._raylet_clients.get(address)
-            if client is None or client.is_closed:
-                client = RpcClient(
-                    address, name="runtime->raylet-remote",
-                    push_handler=self._on_raylet_push,
-                    on_close=lambda: self._on_remote_raylet_lost(address))
+            if client is not None and not client.is_closed:
+                return client
+            failed_at = self._raylet_dial_failures.get(address)
+            if failed_at is not None and \
+                    time.monotonic() - failed_at < self._DEAD_DIAL_TTL_S:
+                raise ConnectionLost(
+                    f"raylet {address} recently unreachable")
+        # Dial OUTSIDE the runtime lock: a dead node refuses connects
+        # until the dial deadline, and holding the lock through that
+        # stalls every other runtime operation (observed: a node kill
+        # mid-shuffle wedged the whole driver while reconstruction
+        # threads convoyed on one dead spillback target). The short
+        # deadline is deliberate — unlike the GCS (which restarts at
+        # the same address and deserves the patient retry loop), a
+        # refused raylet dial will never start succeeding.
+        try:
+            client = RpcClient(
+                address, name="runtime->raylet-remote",
+                connect_timeout=2.0,
+                push_handler=self._on_raylet_push,
+                on_close=lambda: self._on_remote_raylet_lost(address))
+        except ConnectionLost:
+            with self._lock:
+                now = time.monotonic()
+                # Prune expired entries while here: the cache stays
+                # bounded by recent churn, not lifetime churn.
+                self._raylet_dial_failures = {
+                    a: t for a, t in self._raylet_dial_failures.items()
+                    if now - t < self._DEAD_DIAL_TTL_S}
+                self._raylet_dial_failures[address] = now
+            raise
+        with self._lock:
+            self._raylet_dial_failures.pop(address, None)
+            existing = self._raylet_clients.get(address)
+            if existing is not None and not existing.is_closed:
+                existing_client = existing
+            else:
                 self._raylet_clients[address] = client
-            return client
+                existing_client = None
+        if existing_client is not None:
+            # Lost a dial race: keep the first client, drop ours.
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+            return existing_client
+        return client
 
     def _resubmit_respilled(self, spec: TaskSpec):
         if self._closed:

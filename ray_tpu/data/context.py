@@ -31,6 +31,13 @@ class DataContext:
     # a value is an explicit per-process override that always wins.
     inflight_budget_bytes: Optional[int] = None
     prefetch_shards: Optional[int] = None
+    locality_routing: Optional[bool] = None
+    sort_sample_rows: Optional[int] = None
+    broadcast_join_bytes: Optional[int] = None
+    # Tenant the data plane charges this process's executions to (the
+    # per-tenant budget ledger in streaming/budget.py). None resolves to
+    # the submitting job id (RAY_TPU_JOB_ID) and finally "default".
+    tenant: Optional[str] = None
 
     def resolved_inflight_budget_bytes(self) -> int:
         """0 = negotiate against the object store (ByteBudget.negotiated)."""
@@ -46,6 +53,34 @@ class DataContext:
         from ray_tpu.core.config import GLOBAL_CONFIG
 
         return GLOBAL_CONFIG.data_prefetch_shards
+
+    def resolved_locality_routing(self) -> bool:
+        if self.locality_routing is not None:
+            return self.locality_routing
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.data_locality_routing
+
+    def resolved_sort_sample_rows(self) -> int:
+        if self.sort_sample_rows is not None:
+            return self.sort_sample_rows
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.query_sort_sample_rows
+
+    def resolved_broadcast_join_bytes(self) -> int:
+        if self.broadcast_join_bytes is not None:
+            return self.broadcast_join_bytes
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.query_broadcast_join_bytes
+
+    def resolved_tenant(self) -> str:
+        if self.tenant:
+            return self.tenant
+        import os
+
+        return os.environ.get("RAY_TPU_JOB_ID") or "default"
 
     _instance: Optional["DataContext"] = None
     _lock = threading.Lock()

@@ -118,14 +118,20 @@ def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
     """Map side of the push shuffle: scatter one block's rows into n_out
     bucket blocks (returned as separate objects via num_returns).
 
-    Columnar fast path: a random scatter of a dict-of-arrays block
-    slices arrays by the assignment mask instead of materializing one
-    Python dict per row — the row->partition assignment draws the SAME
-    rng as the row path, so bucket membership is representation-
-    independent and seeded-deterministic either way."""
+    Modes: "random" (seeded scatter), "hash" (stable key hash — groups
+    co-locate), "keyed" (key_fn IS the partition assignment, row ->
+    partition index — the query tier's range partitioner).
+
+    Columnar fast path: a random or keyed scatter of a dict-of-arrays
+    block slices arrays by the assignment vector instead of
+    materializing one Python dict per row — the row->partition
+    assignment is computed identically to the row path (same rng draw /
+    same searchsorted-vs-bisect semantics), so bucket membership is
+    representation-independent and deterministic either way."""
     from ray_tpu.data.block import _is_batch_dict
 
-    if mode == "random" and _is_batch_dict(block) and block:
+    columnar = _is_batch_dict(block) and block
+    if mode == "random" and columnar:
         n = BlockAccessor(block).num_rows()
         rng = np.random.default_rng(
             None if seed is None else seed * 100003 + salt)
@@ -134,12 +140,22 @@ def _shuffle_map_block(block, n_out, mode, seed, salt, key_fn):
             return block
         return tuple({k: v[assignment == b] for k, v in block.items()}
                      for b in range(n_out))
+    if mode == "keyed" and columnar and hasattr(key_fn, "assign_block"):
+        assignment = key_fn.assign_block(block)
+        if assignment is not None:
+            if n_out == 1:
+                return block
+            return tuple({k: v[assignment == b] for k, v in block.items()}
+                         for b in range(n_out))
     rows = list(BlockAccessor(block).rows())
     buckets: List[list] = [[] for _ in range(n_out)]
     if mode == "hash":
         for row in rows:
             k = key_fn(row) if key_fn else row
             buckets[_stable_key_hash(k) % n_out].append(row)
+    elif mode == "keyed":
+        for row in rows:
+            buckets[int(key_fn(row)) % n_out].append(row)
     else:  # random scatter, deterministic per (seed, block salt)
         rng = np.random.default_rng(
             None if seed is None else seed * 100003 + salt)
@@ -269,29 +285,27 @@ class Dataset:
 
     def sort(self, key: Optional[Any] = None, descending: bool = False
              ) -> "Dataset":
-        """Global sort (all-to-all barrier, like repartition)."""
-        parent = self
+        """Distributed global sort: sample-based range partitioning
+        through the windowed shuffle, per-partition stable local sort
+        (ray_tpu/data/query/sort.py). The driver holds only the boundary
+        sample (bounded by `query_sort_sample_rows`), never rows —
+        output is row-identical to a driver-side stable sort for any
+        sample draw."""
+        from ray_tpu.data.query.sort import sort_dataset
 
-        def work() -> List[WorkItem]:
-            rows = [r for r in parent.iter_rows()]
-            if key is None:
-                if rows and isinstance(rows[0], dict):
-                    raise ValueError(
-                        "sort() on record rows needs a key: pass a column "
-                        "name (sort(key='col')) or a callable")
-                rows.sort(reverse=descending)
-            elif callable(key):
-                rows.sort(key=key, reverse=descending)
-            else:
-                rows.sort(key=lambda r: r[key], reverse=descending)
-            if not rows:
-                return []
-            nb = max(1, parent.num_blocks())
-            per = max(1, -(-len(rows) // nb))
-            return [(None, (rows[i: i + per],))
-                    for i in range(0, len(rows), per)]
+        return sort_dataset(self, key, descending)
 
-        return _DeferredDataset(work)
+    def join(self, other: "Dataset", on,
+             how: str = "inner") -> "Dataset":
+        """Distributed join (ray_tpu/data/query/join.py): broadcast when
+        `other` (the build side) fits `query_broadcast_join_bytes`,
+        hash-shuffle exchange of both sides otherwise. `on` is a column
+        name or a (left_col, right_col) pair; `how` is "inner" or
+        "left". Colliding non-key columns from `other` get the zip()
+        "_1" suffix."""
+        from ray_tpu.data.query.join import join_datasets
+
+        return join_datasets(self, other, on, how)
 
     def with_resources(self, **resources) -> "Dataset":
         """Run this dataset's tasks with resource options (e.g. num_cpus).
@@ -316,6 +330,7 @@ class Dataset:
         parent = self
 
         def work() -> List[WorkItem]:
+            # raylint: disable=RL019 — documented driver-side re-slice; width-scale callers pass shuffle=True
             blocks = [b for b in parent._iter_block_values()]
             merged = BlockAccessor.concat(blocks) if blocks else []
             total = BlockAccessor(merged).num_rows()
@@ -475,6 +490,7 @@ class Dataset:
         return out[:limit]
 
     def take_all(self) -> List[Any]:
+        # raylint: disable=RL019 — the deliberate driver-resident endpoint: the caller asked for a local copy
         return [r for r in self.iter_rows()]
 
     def count(self) -> int:
@@ -655,6 +671,7 @@ class Dataset:
     def to_pandas(self):
         import pandas as pd
 
+        # raylint: disable=RL019 — a DataFrame IS a local copy; the caller opted out of the streaming plane
         blocks = [BlockAccessor(b).to_pandas()
                   for b in self._iter_block_values()]
         return pd.concat(blocks, ignore_index=True) if blocks else pd.DataFrame()
@@ -863,12 +880,270 @@ class _WindowedShuffleDataset(Dataset):
                     lineage.clear()
 
 
+class _RangeSortDataset(Dataset):
+    """Distributed sort (ray_tpu/data/query/sort.py): bounded remote key
+    sample -> range boundaries -> keyed windowed exchange -> fused stable
+    local sort. Inherits the windowed shuffle's budget/spill/lineage
+    behavior; `last_sort_stats` records the driver-resident sample bytes
+    (the operator's entire driver footprint) for assertion."""
+
+    def __init__(self, parent: Dataset, key, descending: bool,
+                 lenient: bool = False,
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        super().__init__([], transforms, resources or parent._resources)
+        self._parent = parent
+        self._sort_plan = (key, descending, lenient)
+        self.last_sort_stats: Dict[str, Any] = {}
+        self.last_shuffle_stats: Dict[str, Any] = {}
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return _RangeSortDataset(self._parent, *self._sort_plan,
+                                 self._transforms + [transform],
+                                 self._resources)
+
+    def _copy(self) -> "Dataset":
+        return _RangeSortDataset(self._parent, *self._sort_plan,
+                                 list(self._transforms), self._resources)
+
+    def num_blocks(self) -> int:
+        return max(1, self._parent.num_blocks())
+
+    def _sample_boundaries(self, parent_refs, key, n_parts):
+        """Remote per-block key samples -> sorted boundary cut points.
+        Driver-resident state is KEYS ONLY, bounded by
+        `query_sort_sample_rows`; `last_sort_stats` carries the measured
+        byte count so tests can assert the bound. Raises TypeError for
+        unorderable key mixtures (callers in lenient mode catch it)."""
+        import ray_tpu
+        from ray_tpu.core import serialization
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.query.sort import (_sample_block_keys,
+                                             compute_boundaries)
+
+        ctx = DataContext.get_current()
+        sample_rows = max(n_parts, ctx.resolved_sort_sample_rows())
+        per_block = max(1, -(-sample_rows // len(parent_refs)))
+        sampler = ray_tpu.remote(_sample_block_keys)
+        if self._resources:
+            sampler = sampler.options(**self._resources)
+        sample_refs = [
+            sampler.remote(ref, per_block, key, 0, salt)
+            for salt, ref in enumerate(parent_refs)]
+        # bounded-sample: per_block * n_blocks ~= query_sort_sample_rows
+        # keys total — never rows, never unbounded.
+        samples = [k for part in ray_tpu.get(sample_refs) for k in part]
+        if len(samples) > sample_rows:  # cap exactly, not just ~per-block
+            rng = np.random.default_rng(0)
+            keep = sorted(rng.choice(len(samples), size=sample_rows,
+                                     replace=False).tolist())
+            samples = [samples[i] for i in keep]
+        boundaries = compute_boundaries(samples, n_parts)
+        self.last_sort_stats = {
+            "sample_rows": len(samples),
+            "driver_sample_bytes": serialization.serialized_size(
+                serialization.serialize(samples)),
+            "n_parts": n_parts,
+        }
+        return boundaries
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        if self._materialized_refs is not None:
+            yield from self._materialized_refs
+            return
+        from ray_tpu.data.executor import StreamingExecutor
+        from ray_tpu.data.query.sort import (_RangePartitioner,
+                                             make_local_sort_transform)
+        from ray_tpu.data.streaming.budget import pipeline_budget
+        from ray_tpu.data.streaming.lineage import BlockLineage
+        from ray_tpu.data.streaming.shuffle import iter_shuffled_refs
+
+        key, descending, lenient = self._sort_plan
+        # Parent executes ONCE; refs (not data) are held so the sample
+        # and scatter passes read the same blocks. Sealed parents spill
+        # under pressure, so pinning refs is disk-bounded, not RAM.
+        parent_refs = list(self._parent._iter_block_refs())
+        if not parent_refs:
+            return
+        n_parts = self.num_blocks()
+        try:
+            boundaries = self._sample_boundaries(parent_refs, key, n_parts)
+        except TypeError:
+            if not lenient:
+                raise
+            # Unorderable key mixture: degrade to unsorted passthrough
+            # (the groupby result-ordering contract).
+            yield from self._execute_work(
+                ((None, (r,)) for r in parent_refs))
+            return
+        partitioner = _RangePartitioner(boundaries, key, descending,
+                                        n_parts)
+        collector = self._ensure_collector()
+        lineage = BlockLineage()
+        stats: Dict[str, Any] = {}
+        with pipeline_budget() as budget:
+            reduce_refs = iter_shuffled_refs(
+                iter(parent_refs), n_parts, mode="keyed", seed=0,
+                key_fn=partitioner, budget=budget, stage_stats=collector,
+                stats=stats, resources=self._resources, lineage=lineage)
+            transforms = [make_local_sort_transform(key, descending,
+                                                    lenient)]
+            transforms += self._transforms
+            executor = StreamingExecutor(transforms,
+                                         resources=self._resources,
+                                         stats_collector=collector,
+                                         lineage=lineage)
+            self._lineage = lineage
+            if getattr(self, "_executed_blocks", None) is None:
+                self._executed_blocks = 0
+            try:
+                for ref in executor.execute(
+                        (None, (r,)) for r in reduce_refs):
+                    self._executed_blocks += 1
+                    yield ref
+            finally:
+                self.last_shuffle_stats = stats
+                self._last_budget_stats = executor.last_budget_stats
+                lineage.clear()
+
+
+class _JoinDataset(Dataset):
+    """Distributed join (ray_tpu/data/query/join.py). Strategy picked at
+    iteration time from the build side's actual sealed bytes: broadcast
+    (right refs ride every probe task's args; the store ships each right
+    block to a node at most once) or hash exchange of BOTH sides through
+    the windowed shuffle under ONE shared pipeline budget.
+    `last_join_stats` records the decision + build size."""
+
+    def __init__(self, parent: Dataset, right: Dataset, left_on: str,
+                 right_on: str, how: str,
+                 transforms: Optional[List[Callable]] = None,
+                 resources: Optional[dict] = None):
+        super().__init__([], transforms, resources or parent._resources)
+        self._parent = parent
+        self._join_plan = (right, left_on, right_on, how)
+        self.last_join_stats: Dict[str, Any] = {}
+
+    def _derive(self, transform: Callable) -> "Dataset":
+        return _JoinDataset(self._parent, *self._join_plan,
+                            self._transforms + [transform],
+                            self._resources)
+
+    def _copy(self) -> "Dataset":
+        return _JoinDataset(self._parent, *self._join_plan,
+                            list(self._transforms), self._resources)
+
+    def num_blocks(self) -> int:
+        return max(1, self._parent.num_blocks())
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        if self._materialized_refs is not None:
+            yield from self._materialized_refs
+            return
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.executor import StreamingExecutor
+        from ray_tpu.data.query.join import (_KeyGetter,
+                                             join_partition_blocks)
+        from ray_tpu.data.streaming.budget import pipeline_budget
+        from ray_tpu.data.streaming.lineage import BlockLineage
+        from ray_tpu.data.streaming.shuffle import (_block_size,
+                                                    iter_shuffled_refs)
+
+        right, left_on, right_on, how = self._join_plan
+        ctx = DataContext.get_current()
+        # Build side materializes to refs either way: broadcast ships
+        # them to every probe, hash exchange re-scatters them. Sizes
+        # come from the object directory, not from pulling data.
+        right_refs = list(right._iter_block_refs())
+        est_default = ctx.target_min_block_size
+        build_bytes = sum(_block_size(r) or est_default
+                          for r in right_refs)
+        threshold = ctx.resolved_broadcast_join_bytes()
+        broadcast = build_bytes <= threshold
+        self.last_join_stats = {
+            "strategy": "broadcast" if broadcast else "hash",
+            "build_bytes": build_bytes,
+            "broadcast_threshold": threshold,
+        }
+        collector = self._ensure_collector()
+        lineage = BlockLineage()
+        self._lineage = lineage
+        executor = StreamingExecutor(self._transforms,
+                                     resources=self._resources,
+                                     stats_collector=collector,
+                                     lineage=lineage)
+        if getattr(self, "_executed_blocks", None) is None:
+            self._executed_blocks = 0
+
+        def _run(work_iter):
+            try:
+                for ref in executor.execute(work_iter):
+                    self._executed_blocks += 1
+                    yield ref
+            finally:
+                self._last_budget_stats = executor.last_budget_stats
+                lineage.clear()
+
+        if broadcast:
+            yield from _run(
+                (join_partition_blocks,
+                 (left_on, right_on, how, None, lref, *right_refs))
+                for lref in self._parent._iter_block_refs())
+            return
+        rcols_hint = None
+        if how == "left":
+            # Left-join None-fill needs the GLOBAL right column set — a
+            # hash partition may receive none (or a columnar subset) of
+            # the build rows yet must still emit the same schema as the
+            # broadcast strategy. Column NAMES are bounded metadata, so
+            # this stays within the driver's sample-sized footprint.
+            from ray_tpu.data.query.join import right_block_columns
+            import ray_tpu
+            col_task = ray_tpu.remote(right_block_columns)
+            # raylint: disable=RL019 — bounded metadata: column names only, one short list per build block
+            col_lists = ray_tpu.get([col_task.remote(r)
+                                     for r in right_refs])
+            seen_cols: set = set()
+            rcols_hint = []
+            for cols in col_lists:
+                for c in cols:
+                    if c not in seen_cols:
+                        seen_cols.add(c)
+                        rcols_hint.append(c)
+        n_parts = self.num_blocks()
+        lstats: Dict[str, Any] = {}
+        rstats: Dict[str, Any] = {}
+        with pipeline_budget() as budget:
+            lgen = iter_shuffled_refs(
+                self._parent._iter_block_refs(), n_parts, mode="hash",
+                seed=0, key_fn=_KeyGetter(left_on), budget=budget,
+                stage_stats=collector, stats=lstats,
+                resources=self._resources, lineage=lineage)
+            rgen = iter_shuffled_refs(
+                iter(right_refs), n_parts, mode="hash", seed=0,
+                key_fn=_KeyGetter(right_on), budget=budget,
+                stage_stats=collector, stats=rstats,
+                resources=self._resources, lineage=lineage)
+            try:
+                yield from _run(
+                    (join_partition_blocks,
+                     (left_on, right_on, how, rcols_hint, lref, rref))
+                    for lref, rref in zip(lgen, rgen))
+            finally:
+                lgen.close()
+                rgen.close()
+                self.last_join_stats["left_shuffle"] = lstats
+                self.last_join_stats["right_shuffle"] = rstats
+
+
 class GroupedData:
-    """Result of `Dataset.groupby`: distributed map-side partial aggregates
-    merged on the driver (reference `GroupedData` / `AggregateFn` — the
-    shuffle-free path, which is exact for the algebraic aggregations here).
-    Aggregations return a Dataset of `{key, <agg>}` rows sorted by key;
-    `map_groups` applies a function to each group's rows in parallel tasks.
+    """Result of `Dataset.groupby`: the distributed hash-aggregate plan
+    (ray_tpu/data/query/aggregate.py) — per-block partial aggregation,
+    hash scatter of the partials through the windowed shuffle, merge +
+    finalize on the reducers, range-sorted output. Rows never transit
+    the driver. Aggregations return a Dataset of `{key, <agg>}` rows
+    sorted by key (when orderable); `map_groups` applies a function to
+    each group's rows in parallel tasks.
     """
 
     def __init__(self, ds: Dataset, key: Union[str, Callable[[Any], Any]]):
@@ -884,88 +1159,39 @@ class GroupedData:
     def _key_name(self) -> str:
         return self._key if isinstance(self._key, str) else "key"
 
-    def _merged_partials(self, on: Optional[str]) -> Dict[Any, Dict[str, Any]]:
-        keyf = self._key_fn()
+    def aggregate(self, *aggs) -> Dataset:
+        """Run composable AggregateFns (ray_tpu/data/query/aggregate.py)
+        through the distributed hash-aggregate plan; one result row per
+        key, columns named by each aggregation."""
+        from ray_tpu.data.query.aggregate import grouped_aggregate
 
-        def transform(block):
-            acc: Dict[Any, Dict[str, Any]] = {}
-            for row in BlockAccessor(block).rows():
-                kv = keyf(row)
-                v = row[on] if on is not None else None
-                slot = acc.get(kv)
-                if slot is None:
-                    slot = acc[kv] = {"k": kv, "count": 0, "vcount": 0,
-                                      "sum": None, "min": None, "max": None}
-                slot["count"] += 1
-                if v is not None:  # None = missing (reference ignore_nulls)
-                    slot["vcount"] += 1
-                    if slot["sum"] is None:
-                        slot["sum"], slot["min"], slot["max"] = v, v, v
-                    else:
-                        slot["sum"] = slot["sum"] + v
-                        slot["min"] = min(slot["min"], v)
-                        slot["max"] = max(slot["max"], v)
-            return list(acc.values())
-
-        merged: Dict[Any, Dict[str, Any]] = {}
-        for b in self._ds._derive(transform)._iter_block_values():
-            for part in BlockAccessor(b).rows():
-                slot = merged.get(part["k"])
-                if slot is None:
-                    merged[part["k"]] = dict(part)
-                elif part["sum"] is None:
-                    slot["count"] += part["count"]
-                elif slot["sum"] is None:
-                    count = slot["count"]
-                    slot.update(part)
-                    slot["count"] = count + part["count"]
-                else:
-                    slot["count"] += part["count"]
-                    slot["vcount"] += part["vcount"]
-                    slot["sum"] = slot["sum"] + part["sum"]
-                    slot["min"] = min(slot["min"], part["min"])
-                    slot["max"] = max(slot["max"], part["max"])
-        return merged
-
-    def _result(self, rows: List[Dict[str, Any]]) -> Dataset:
-        try:
-            rows.sort(key=lambda r: r[self._key_name()])
-        except TypeError:
-            pass
-        return Dataset([(None, (rows,))])
+        return grouped_aggregate(self._ds, self._key, self._key_name(),
+                                 list(aggs))
 
     def count(self) -> Dataset:
-        kn = self._key_name()
-        merged = self._merged_partials(None)
-        return self._result(
-            [{kn: m["k"], "count()": m["count"]} for m in merged.values()])
+        from ray_tpu.data.query.aggregate import Count
+
+        return self.aggregate(Count())
 
     def sum(self, on: str) -> Dataset:
-        kn = self._key_name()
-        merged = self._merged_partials(on)
-        return self._result(
-            [{kn: m["k"], f"sum({on})": m["sum"]} for m in merged.values()])
+        from ray_tpu.data.query.aggregate import Sum
+
+        return self.aggregate(Sum(on))
 
     def mean(self, on: str) -> Dataset:
-        kn = self._key_name()
-        merged = self._merged_partials(on)
-        return self._result(
-            [{kn: m["k"],
-              f"mean({on})": (m["sum"] / m["vcount"]) if m["vcount"]
-              else None}
-             for m in merged.values()])
+        from ray_tpu.data.query.aggregate import Mean
+
+        return self.aggregate(Mean(on))
 
     def min(self, on: str) -> Dataset:
-        kn = self._key_name()
-        merged = self._merged_partials(on)
-        return self._result(
-            [{kn: m["k"], f"min({on})": m["min"]} for m in merged.values()])
+        from ray_tpu.data.query.aggregate import Min
+
+        return self.aggregate(Min(on))
 
     def max(self, on: str) -> Dataset:
-        kn = self._key_name()
-        merged = self._merged_partials(on)
-        return self._result(
-            [{kn: m["k"], f"max({on})": m["max"]} for m in merged.values()])
+        from ray_tpu.data.query.aggregate import Max
+
+        return self.aggregate(Max(on))
 
     def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
         """Apply `fn` to each group's full row list; fn returns a row or a

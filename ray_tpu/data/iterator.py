@@ -75,7 +75,17 @@ class _SplitCoordinator:
 
     Blocks are handed out first-come-first-served; `equal` slices each block
     so no consumer can run ahead by more than one block.
+
+    Locality-aware handout: the coordinator keeps a small lookahead of
+    produced refs and, when a consumer identifies its node, prefers a
+    ref ALREADY RESIDENT there (one batched directory RPC over the
+    lookahead — the consumer's pull then reads local shared memory
+    instead of the wire). Any consumer still receives SOME block on
+    every call — locality reorders the handout, it never starves a
+    split — and every block is handed out exactly once.
     """
+
+    _LOOKAHEAD = 4
 
     def __init__(self, ds_blob: bytes, n: int, equal: bool):
         import cloudpickle
@@ -85,9 +95,31 @@ class _SplitCoordinator:
         self._equal = equal
         self._epoch = -1
         self._iter: Optional[Iterator[Any]] = None
+        self._ahead: List[Any] = []
+        self._locality = {"locality_hits": 0, "locality_misses": 0}
         self._lock = threading.Lock()
 
-    def next_block(self, split_id: int, epoch: int) -> Dict[str, Any]:
+    def _pick_local(self, node_hex: Optional[str]):
+        """(lookahead index, is_local) of a block resident on the
+        consumer's node; (0, False) — FIFO head, counted as a miss —
+        when nothing is local or locations are unknown. The routing
+        knob is NOT re-checked here: it resolves on the CONSUMER
+        (see StreamSplitDataIterator._iter_blocks — a consumer with
+        routing off advertises no node), because this actor may run in
+        a reused worker process whose DataContext carries another
+        consumer's override. One batched directory RPC over the whole
+        lookahead."""
+        if not node_hex or not self._ahead:
+            return 0, False
+        from ray_tpu.data.query import locality
+
+        for i, entry in enumerate(locality.locations_batch(self._ahead)):
+            if entry.get("known") and node_hex in (entry.get("nodes") or ()):
+                return i, True
+        return 0, False
+
+    def next_block(self, split_id: int, epoch: int,
+                   node_hex: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
             if epoch > self._epoch:
                 self._epoch = epoch
@@ -96,15 +128,23 @@ class _SplitCoordinator:
                 # pipelined pull (locality routing), instead of every
                 # block transiting this actor's response path by value.
                 self._iter = self._ds._iter_block_refs()
+                self._ahead = []
             if epoch < self._epoch or self._iter is None:
                 return {"end": True}
-            try:
-                return {"ref": next(self._iter)}
-            except StopIteration:
+            while len(self._ahead) < self._LOOKAHEAD:
+                try:
+                    self._ahead.append(next(self._iter))
+                except StopIteration:
+                    break
+            if not self._ahead:
                 return {"end": True}
+            idx, local = self._pick_local(node_hex)
+            self._locality[
+                "locality_hits" if local else "locality_misses"] += 1
+            return {"ref": self._ahead.pop(idx), "local": local}
 
     def stats(self) -> Dict[str, Any]:
-        return {"epoch": self._epoch, "n": self._n}
+        return {"epoch": self._epoch, "n": self._n, **self._locality}
 
 
 class StreamSplitDataIterator:
@@ -115,6 +155,13 @@ class StreamSplitDataIterator:
         self._split_id = split_id
         self._n = n
         self._epoch = 0
+        # This consumer's view of the coordinator's routing decisions:
+        # a hit = the handed block was already resident on this node
+        # (the pull below reads shared memory, not the wire).
+        self._locality = {"locality_hits": 0, "locality_misses": 0}
+
+    def locality_stats(self) -> Dict[str, int]:
+        return dict(self._locality)
 
     def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
                      prefetch_batches: int = 1
@@ -127,17 +174,35 @@ class StreamSplitDataIterator:
 
     def _iter_blocks(self) -> Iterator[Any]:
         import ray_tpu
+        from ray_tpu.data.query import locality
 
         epoch = self._epoch
         self._epoch += 1
+        # Identify this node ONCE per epoch; the coordinator then hands
+        # this consumer blocks already resident here when it can. The
+        # routing knob is resolved HERE (consumer side) — the
+        # coordinator may run in another process whose DataContext never
+        # saw a driver-side override; not advertising a node disables
+        # routing for this consumer regardless of where the coordinator
+        # lives.
+        from ray_tpu.data.context import DataContext
+
+        node_hex = (locality.local_node_hex()
+                    if DataContext.get_current().resolved_locality_routing()
+                    else None)
         while True:
             resp = ray_tpu.get(
-                self._coordinator.next_block.remote(self._split_id, epoch))
+                self._coordinator.next_block.remote(self._split_id, epoch,
+                                                    node_hex))
             if resp.get("end"):
                 return
             if "ref" in resp:
+                self._locality[
+                    "locality_hits" if resp.get("local")
+                    else "locality_misses"] += 1
                 # Locality pull: materialize on THIS host via the
-                # transfer plane (chunked, striped across holders).
+                # transfer plane (chunked, striped across holders) — a
+                # hit short-circuits to a local shared-memory read.
                 yield ray_tpu.get(resp["ref"])
             else:
                 yield resp["block"]

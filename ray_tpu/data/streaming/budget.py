@@ -21,6 +21,20 @@ The budget is negotiated against the local object store at execution start
 `RAY_TPU_DATA_INFLIGHT_BUDGET_BYTES`), else 25% of store capacity with a
 64 MiB floor. One execution = one budget; nested stages (a shuffle driving
 its parent pipeline) share the outermost budget via `pipeline_budget()`.
+
+**Tenants.** On a multi-job node (jobs-as-tenants, PR 17) every pipeline
+execution ALSO charges a process-global per-tenant ledger, keyed by the
+submitting job (`DataContext.resolved_tenant()`: explicit `tenant` field,
+else RAY_TPU_JOB_ID, else "default"). `data_tenant_budget_bytes` caps any
+one tenant's in-flight bytes ACROSS its concurrent executions: admission
+over the cap is refused — reject-with-backpressure, counted in
+`tenant_stats()["rejections"]` — rather than letting one job's wide
+shuffle silently starve every other job's pipeline out of the shared
+store. Same progress guarantee as the budget itself: a tenant with
+nothing in flight is always admitted, so a cap smaller than one block
+degrades to block-at-a-time execution, never deadlock. Cross-budget
+releases are observed by acquire()'s 1-second poll (budgets don't share
+a condition variable — the poll bounds the staleness instead).
 """
 
 from __future__ import annotations
@@ -79,6 +93,76 @@ class _OpAccount:
         self.bytes_total = 0
 
 
+class _TenantLedger:
+    """Process-global per-tenant in-flight byte accounting, mirrored from
+    every ByteBudget's ledger mutations. Own lock, always acquired AFTER
+    a budget's condition lock (one-way ordering — no deadlock)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Dict[str, int]] = {}
+
+    def _slot(self, tenant: str) -> Dict[str, int]:
+        slot = self._tenants.get(tenant)
+        if slot is None:
+            slot = self._tenants[tenant] = {
+                "bytes_in_flight": 0, "bytes_hwm": 0, "bytes_total": 0,
+                "rejections": 0}
+        return slot
+
+    @staticmethod
+    def limit() -> int:
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.data_tenant_budget_bytes
+
+    def would_exceed(self, tenant: str, nbytes: int) -> bool:
+        """Over the per-tenant cap? False when uncapped or when the
+        tenant has nothing in flight (the tenant-level progress
+        guarantee: an idle tenant always gets its first block)."""
+        lim = self.limit()
+        if lim <= 0:
+            return False
+        with self._lock:
+            slot = self._slot(tenant)
+            return (slot["bytes_in_flight"] > 0
+                    and slot["bytes_in_flight"] + nbytes > lim)
+
+    def add(self, tenant: str, delta: int) -> None:
+        with self._lock:
+            slot = self._slot(tenant)
+            slot["bytes_in_flight"] = max(0, slot["bytes_in_flight"] + delta)
+            if delta > 0:
+                slot["bytes_total"] += delta
+            slot["bytes_hwm"] = max(slot["bytes_hwm"],
+                                    slot["bytes_in_flight"])
+
+    def note_rejection(self, tenant: str) -> None:
+        with self._lock:
+            self._slot(tenant)["rejections"] += 1
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {t: dict(s) for t, s in self._tenants.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tenants.clear()
+
+
+_TENANTS = _TenantLedger()
+
+
+def tenant_stats() -> Dict[str, Dict[str, int]]:
+    """Per-tenant in-flight/hwm/total bytes and budget rejections across
+    every execution this process has run."""
+    return _TENANTS.stats()
+
+
+def reset_tenant_stats() -> None:
+    _TENANTS.reset()
+
+
 class ByteBudget:
     """Shared in-flight byte ledger with per-op backpressure accounting.
 
@@ -86,6 +170,11 @@ class ByteBudget:
     even when its single block exceeds the whole budget — otherwise a
     block larger than the budget would deadlock the pipeline instead of
     degrading it to window-at-a-time execution.
+
+    Every ledger mutation mirrors into the process-global per-tenant
+    ledger under the tenant resolved at construction, so concurrent
+    executions of one job are capped TOGETHER by
+    `data_tenant_budget_bytes` (see module docstring).
     """
 
     def __init__(self, total_bytes: int):
@@ -93,6 +182,9 @@ class ByteBudget:
         self._used = 0
         self._cond = threading.Condition()
         self._ops: Dict[str, _OpAccount] = {}
+        from ray_tpu.data.context import DataContext
+
+        self.tenant = DataContext.get_current().resolved_tenant()
 
     @classmethod
     def negotiated(cls) -> "ByteBudget":
@@ -124,14 +216,25 @@ class ByteBudget:
         with self._cond:
             acct = self._account(op)
             t0 = None
-            while (self._used + nbytes > self.total
-                   and acct.bytes_in_flight > 0):
+            while True:
+                over_budget = (self._used + nbytes > self.total
+                               and acct.bytes_in_flight > 0)
+                # The tenant cap is checked INSIDE the wait loop: another
+                # budget of the same tenant releasing bytes unblocks this
+                # acquire at the next 1 s poll (no shared condition).
+                over_tenant = _TENANTS.would_exceed(self.tenant, nbytes)
+                if not (over_budget or over_tenant):
+                    break
                 if t0 is None:
                     t0 = time.monotonic()
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     acct.blocked_s += time.monotonic() - t0
+                    if over_tenant:
+                        # Reject-with-backpressure, and make the denial
+                        # visible — never silent starvation.
+                        _TENANTS.note_rejection(self.tenant)
                     return False
                 self._cond.wait(min(1.0, remaining)
                                 if remaining is not None else 1.0)
@@ -142,6 +245,7 @@ class ByteBudget:
             acct.bytes_in_flight += nbytes
             acct.bytes_total += nbytes
             acct.bytes_hwm = max(acct.bytes_hwm, acct.bytes_in_flight)
+            _TENANTS.add(self.tenant, nbytes)
             return True
 
     def try_acquire(self, op: str, nbytes: int) -> bool:
@@ -168,6 +272,7 @@ class ByteBudget:
             acct.bytes_in_flight += delta
             acct.bytes_total += max(0, delta)
             acct.bytes_hwm = max(acct.bytes_hwm, acct.bytes_in_flight)
+            _TENANTS.add(self.tenant, delta)
             if delta < 0:
                 self._cond.notify_all()
 
@@ -177,6 +282,7 @@ class ByteBudget:
             nbytes = min(max(0, int(nbytes)), acct.bytes_in_flight)
             self._used = max(0, self._used - nbytes)
             acct.bytes_in_flight -= nbytes
+            _TENANTS.add(self.tenant, -nbytes)
             self._cond.notify_all()
 
     def release_op(self, op: str):
@@ -189,6 +295,7 @@ class ByteBudget:
             acct = self._ops.get(op)
             if acct is not None and acct.bytes_in_flight:
                 self._used = max(0, self._used - acct.bytes_in_flight)
+                _TENANTS.add(self.tenant, -acct.bytes_in_flight)
                 acct.bytes_in_flight = 0
             self._cond.notify_all()
 
@@ -197,6 +304,7 @@ class ByteBudget:
         executions starts from a clean ledger)."""
         with self._cond:
             self._ops.clear()
+            _TENANTS.add(self.tenant, -self._used)
             self._used = 0
             self._cond.notify_all()
 
@@ -220,7 +328,7 @@ class ByteBudget:
             bound = max(ops, key=lambda o: ops[o]["blocked_s"]) \
                 if ops else None
         return {"total_bytes": self.total, "used_bytes": self._used,
-                "ops": ops, "bound_op": bound}
+                "tenant": self.tenant, "ops": ops, "bound_op": bound}
 
 
 # --- execution-scoped budget sharing ----------------------------------------

@@ -206,6 +206,12 @@ class ShardIterator:
         out = self._clock.stats()
         out["epochs"] = self._epochs
         out["prefetch_depth"] = self._resolved_prefetch()
+        # Locality routing outcome of the underlying split (coordinator
+        # handed blocks already resident on this node vs remote pulls);
+        # absent for sources that don't track it.
+        src = self._source
+        if hasattr(src, "locality_stats"):
+            out.update(src.locality_stats())
         return out
 
     def __reduce__(self):

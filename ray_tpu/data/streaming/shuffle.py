@@ -175,6 +175,13 @@ def iter_shuffled_refs(parent_refs: Iterator[Any], n_out: int, *,
         stage_stats.fold(-2, "ShuffleMap")
 
     # ---- reduce: bounded in-flight, yield in partition order -------------
+    # Locality routing: each partition's reduce concats buckets already
+    # scattered across the cluster — pin it (softly) to the node holding
+    # the most bucket bytes so the concat reads shared memory instead of
+    # dragging buckets over the wire. Advisory: any directory miss falls
+    # back to default placement (query/locality.py).
+    from ray_tpu.data.query import locality
+    route_reduces = ctx.resolved_locality_routing()
     est_part = max(total_bytes // max(1, n_out), 1)
     reduce_in_flight: Dict[Any, int] = {}  # ref -> partition index
     ready_parts: Dict[int, Any] = {}
@@ -212,8 +219,14 @@ def iter_shuffled_refs(parent_refs: Iterator[Any], n_out: int, *,
                         op_red, _time.perf_counter() - t_blocked)
                     t_blocked = None
                 part_buckets = buckets[next_submit]
-                red_ref = sred.remote(mode, seed, next_submit,
-                                      *part_buckets)
+                sred_part = sred
+                if route_reduces and part_buckets:
+                    opts = locality.reduce_affinity(part_buckets)
+                    if opts is not None:
+                        # .options() merges, so resources survive the pin.
+                        sred_part = sred.options(**opts)
+                red_ref = sred_part.remote(mode, seed, next_submit,
+                                           *part_buckets)
                 if lineage is not None:
                     lineage.record(
                         red_ref, _shuffle_reduce_blocks,

@@ -65,6 +65,13 @@ step "ingest smoke (seeded node kill mid-shuffle)" \
 # upper bound (exit nonzero on any invariant breach).
 step "inference smoke (prefix cache + spec decode)" \
   env JAX_PLATFORMS=cpu python bench.py --inference-smoke
+# Query smoke: sort/groupby/join through the windowed shuffle on a
+# 3-node cluster, <60s — row-identity verified inline, the driver's sort
+# footprint bounded by the key sample, and the locality-routing A/B must
+# show the routed arm moving strictly fewer cross-node bytes (socket
+# path forced; exit nonzero on any invariant breach).
+step "query smoke (exchange operators + locality A/B)" \
+  env JAX_PLATFORMS=cpu python bench.py --query-smoke
 # Job-tier smoke: cold vs forge-template submit->first-task (warm must
 # be >=2x faster), 3 concurrent tenant jobs with distinct runtime envs
 # on one cluster, then the cleanup invariants — zero orphan job

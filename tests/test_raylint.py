@@ -1617,3 +1617,103 @@ def test_rl018_suppression_with_reason(tmp_path):
         "self._jobs[job_hex] = qos  "
         "# raylint: disable=RL018 — retained as the job history table")
     assert lint_src(tmp_path, src, rules=["RL018"]) == []
+
+
+# ------------------------------------------------------------------ RL019
+
+RL019_BAD_LIST_OVER_ROWS = """
+    def collect(ds):
+        return list(ds.iter_rows())
+"""
+
+RL019_BAD_SORTED_DRIVER_SORT = """
+    def global_sort(ds, key):
+        return sorted(ds.iter_rows(), key=key)
+"""
+
+RL019_BAD_COMPREHENSION = """
+    def all_blocks(parent):
+        blocks = [b for b in parent._iter_block_values()]
+        return blocks
+"""
+
+RL019_BAD_BULK_GET = """
+    import ray_tpu
+
+    def resolve(refs):
+        return ray_tpu.get([r for r in refs])
+"""
+
+RL019_GOOD_STREAMING_LOOP = """
+    def count(ds):
+        total = 0
+        for block in ds._iter_block_values():
+            total += len(block)
+        return total
+"""
+
+RL019_GOOD_REF_ITERATION = """
+    def ship(ds, fn):
+        # refs are bounded metadata — iterating (even collecting) them
+        # never materializes block bytes on the driver.
+        refs = list(ds._iter_block_refs())
+        return [fn.remote(r) for r in refs]
+"""
+
+
+def test_rl019_flags_list_over_row_iterator(tmp_path):
+    findings = lint_src(tmp_path, RL019_BAD_LIST_OVER_ROWS,
+                        rules=["RL019"])
+    assert rule_ids(findings) == ["RL019"]
+    assert "driver memory" in findings[0].message
+
+
+def test_rl019_flags_driver_side_sorted(tmp_path):
+    findings = lint_src(tmp_path, RL019_BAD_SORTED_DRIVER_SORT,
+                        rules=["RL019"])
+    assert rule_ids(findings) == ["RL019"]
+
+
+def test_rl019_flags_block_comprehension(tmp_path):
+    findings = lint_src(tmp_path, RL019_BAD_COMPREHENSION,
+                        rules=["RL019"])
+    assert rule_ids(findings) == ["RL019"]
+    assert "_iter_block_values" in findings[0].message
+
+
+def test_rl019_flags_bulk_get_of_ref_list(tmp_path):
+    findings = lint_src(tmp_path, RL019_BAD_BULK_GET, rules=["RL019"])
+    assert rule_ids(findings) == ["RL019"]
+    assert "bulk get" in findings[0].message
+
+
+def test_rl019_quiet_on_streaming_loop(tmp_path):
+    assert lint_src(tmp_path, RL019_GOOD_STREAMING_LOOP,
+                    rules=["RL019"]) == []
+
+
+def test_rl019_quiet_on_ref_iteration(tmp_path):
+    assert lint_src(tmp_path, RL019_GOOD_REF_ITERATION,
+                    rules=["RL019"]) == []
+
+
+def test_rl019_suppression_with_reason(tmp_path):
+    src = RL019_BAD_LIST_OVER_ROWS.replace(
+        "return list(ds.iter_rows())",
+        "return list(ds.iter_rows())  "
+        "# raylint: disable=RL019 — deliberate local-copy endpoint")
+    assert lint_src(tmp_path, src, rules=["RL019"]) == []
+
+
+def test_rl019_scoped_to_data_package(tmp_path):
+    # Driver-side materialization in a control-plane package is not the
+    # query tier's contract; RL019 only patrols the data plane (and
+    # fixtures).
+    pkg = tmp_path / "ray_tpu"
+    serve = pkg / "serve"
+    serve.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (serve / "__init__.py").write_text("")
+    mod = serve / "router.py"
+    mod.write_text(textwrap.dedent(RL019_BAD_LIST_OVER_ROWS))
+    assert lint_file(str(mod), rule_ids=["RL019"]) == []
