@@ -10,7 +10,11 @@ checks the ownership and concurrency disciplines the codebase depends on
 freed on every path, nothing blocking may run under a control-plane
 lock, broad excepts must not silently eat cancellation, threads must be
 daemonized or joined, XLA programs must be compiled once, and lock
-acquisition order must be acyclic.
+acquisition order must be acyclic.  The JAX surface gets its own
+dataflow-powered family (RL020-RL024 in :mod:`ray_tpu.analysis.jaxrules`,
+on the traced/static/host provenance layer of
+:mod:`ray_tpu.analysis.dataflow`): retrace hazards, host syncs in hot
+loops, use-after-donate, sharding-spec hygiene, and stale jit captures.
 
 Usage::
 
@@ -36,6 +40,7 @@ from ray_tpu.analysis.engine import (  # noqa: F401
 )
 from ray_tpu.analysis import rules as _rules  # noqa: F401  (registers rules)
 from ray_tpu.analysis import project as _project  # noqa: F401  (RL014-016)
+from ray_tpu.analysis import jaxrules as _jaxrules  # noqa: F401  (RL020-024)
 
 __all__ = ["Finding", "RULES", "PROJECT_RULES", "lint_paths",
            "lint_paths_full", "rule", "project_rule"]

@@ -32,7 +32,9 @@ from typing import List, Tuple
 from ray_tpu.analysis.engine import (
     CACHE_DIR_DEFAULT,
     PROJECT_RULES,
+    RETIRED_RULES,
     RULES,
+    RULE_SCOPES,
     FileContext,
     all_rule_ids,
     dotted,
@@ -196,10 +198,16 @@ def main(argv=None) -> int:
         args.fmt = "json"
 
     if args.list_rules:
-        descs = dict(RULES)
-        descs.update(PROJECT_RULES)
-        for rid, (_fn, desc) in sorted(descs.items()):
-            print(f"{rid}  {desc}")
+        for rid in all_rule_ids():
+            _fn, desc = RULES.get(rid) or PROJECT_RULES[rid]
+            kind = "file" if rid in RULES else "project"
+            title, sep, doc = desc.partition(": ")
+            print(f"{rid}  [{kind}] {title}")
+            if sep:
+                print(f"       {' '.join(doc.split())}")
+            print(f"       scope: {RULE_SCOPES.get(rid, 'all files')}")
+        for rid, successor in sorted(RETIRED_RULES.items()):
+            print(f"{rid}  [retired] superseded by {successor}")
         return 0
 
     paths = args.paths or _default_paths()
@@ -218,9 +226,17 @@ def main(argv=None) -> int:
     rule_ids = None
     if args.rules:
         rule_ids = [r.strip().upper() for r in args.rules.split(",")]
+        retired = [r for r in rule_ids if r in RETIRED_RULES]
+        if retired:
+            for r in retired:
+                print(f"rule {r} is retired — superseded by "
+                      f"{RETIRED_RULES[r]}; update the invocation "
+                      f"(--rules {RETIRED_RULES[r]})", file=sys.stderr)
+            return 2
         unknown = [r for r in rule_ids if r not in all_rule_ids()]
         if unknown:
-            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            print(f"unknown rule(s): {', '.join(unknown)} — "
+                  "`--list-rules` prints the catalog", file=sys.stderr)
             return 2
         if args.report_unused_suppressions:
             print("--report-unused-suppressions needs the full rule set "

@@ -1,0 +1,870 @@
+"""Per-function value-provenance dataflow for the JAX-surface rules.
+
+The lexical rules (RL001-RL019) match shapes; the accelerator-hazard
+family (RL020-RL024, :mod:`ray_tpu.analysis.jaxrules`) needs to know
+*what a value is* at a program point.  This module provides that layer:
+a lightweight statement-level CFG over one function body plus a forward
+fixpoint that tags every expression with a provenance:
+
+- **traced**  — a value living on the device / inside a trace: formal
+  args of a jit/pjit/shard_map-traced function, results of ``jnp.*`` /
+  ``jax.*`` ops, results of calling a jitted callable (directly or
+  through a dispatch wrapper that takes the jitted fn as an argument);
+- **static-python** — ordinary host Python values (the default);
+- **host-materialized** — a traced value pulled back to the host via
+  ``np.asarray`` / ``.item()`` / ``.tolist()`` / ``float()`` / ``int()``
+  / ``bool()`` / ``jax.device_get`` — each such call is a device sync
+  and is recorded as a :class:`Materialization` event.
+
+A separate SHAPE bit rides along the lattice: ``x.shape`` / ``x.dtype``
+/ ``len(x)`` of a traced value is *static* under trace (shapes are part
+of the cache key) but remembering that a static int derives from shape
+arithmetic is what lets RL020 flag shape-derived values fed back into a
+``static_argnums`` position (one recompile per distinct runtime shape).
+
+Everything here is syntactic and per-function: self attributes are
+tracked as dotted names within one body, nothing crosses function
+boundaries, unknown calls propagate the join of their argument tags.
+Under-approximation (a device value the analysis cannot see) costs a
+missed finding, never a false one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from ray_tpu.analysis.engine import FileContext, dotted, last_segment
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# ------------------------------------------------------------- the lattice
+#
+# Low two bits: STATIC < HOST < TRACED (join = max).  Bit 4: the value
+# derives from shape/dtype metadata of a device value (OR under join).
+
+STATIC = 0
+HOST = 1
+TRACED = 2
+SHAPE = 4
+
+
+def tag_of(mask: int) -> int:
+    return mask & 3
+
+
+def is_traced(mask: int) -> bool:
+    return (mask & 3) == TRACED
+
+
+def is_shape_derived(mask: int) -> bool:
+    return bool(mask & SHAPE)
+
+
+def join(a: int, b: int) -> int:
+    return max(a & 3, b & 3) | ((a | b) & SHAPE)
+
+
+# ------------------------------------------------------- jit-site extraction
+
+_JIT_DOTTED = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_TRACER_SEGMENTS = {"jit", "pjit", "shard_map"}
+
+
+def is_jit_name(node: ast.AST) -> bool:
+    name = dotted(node)
+    return name in _JIT_DOTTED or last_segment(name) in _TRACER_SEGMENTS
+
+
+def is_jit_call(call: ast.Call) -> bool:
+    return is_jit_name(call.func)
+
+
+def _const_int_tuple(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_str_tuple(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+@dataclass
+class JitSite:
+    """One place a function enters a trace: ``jax.jit(fn, ...)``, a
+    ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorator, or shard_map."""
+
+    line: int
+    call: Optional[ast.Call]          # the wrapping call (None: bare deco)
+    fn_def: Optional[ast.AST]         # resolved local def/lambda, if any
+    bound_to: Optional[str]           # dotted assign target / def name
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    in_loop: bool = False             # constructed inside a For/While
+    enclosing_fn: Optional[str] = None
+
+    def traced_params(self) -> List[str]:
+        """Positional params of the traced fn that carry tracers."""
+        if self.fn_def is None or isinstance(self.fn_def, ast.Lambda):
+            return []
+        args = self.fn_def.args
+        names = [a.arg for a in args.posonlyargs] + \
+                [a.arg for a in args.args]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        out = []
+        for i, n in enumerate(names):
+            if i in self.static_argnums or n in self.static_argnames:
+                continue
+            out.append(n)
+        return out
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _resolve_def(ctx: FileContext, expr: ast.AST) -> Optional[ast.AST]:
+    """A local FunctionDef/Lambda behind the traced-fn expression:
+    lambdas inline; names search the enclosing scopes innermost-out;
+    ``self._m`` searches the enclosing class."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    if name is None:
+        return None
+    scopes: List[ast.AST] = []
+    fn = ctx.enclosing_function(expr)
+    while fn is not None:
+        scopes.append(fn)
+        fn = ctx.enclosing_function(fn)
+    cls = ctx.enclosing_class(expr)
+    if cls is not None:
+        scopes.append(cls)
+    scopes.append(ctx.tree)
+    for scope in scopes:
+        for node in getattr(scope, "body", ()):
+            if isinstance(node, _FUNC_NODES) and node.name == name:
+                return node
+    return None
+
+
+def _binding_target(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    parent = ctx.parent(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return dotted(parent.targets[0])
+    return None
+
+
+def jit_sites(ctx: FileContext) -> List[JitSite]:
+    sites: List[JitSite] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and is_jit_call(node):
+            in_loop = False
+            encl = None
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                    in_loop = True
+                if isinstance(anc, _FUNC_NODES):
+                    encl = anc.name
+                    break
+            fn_expr = node.args[0] if node.args else None
+            sites.append(JitSite(
+                line=node.lineno, call=node,
+                fn_def=_resolve_def(ctx, fn_expr)
+                if fn_expr is not None else None,
+                bound_to=_binding_target(ctx, node),
+                static_argnums=_const_int_tuple(
+                    _kwarg(node, "static_argnums")),
+                static_argnames=_const_str_tuple(
+                    _kwarg(node, "static_argnames")),
+                donate_argnums=_const_int_tuple(
+                    _kwarg(node, "donate_argnums")),
+                in_loop=in_loop, enclosing_fn=encl))
+        elif isinstance(node, _FUNC_NODES):
+            for dec in node.decorator_list:
+                if is_jit_name(dec):
+                    sites.append(JitSite(
+                        line=node.lineno, call=None, fn_def=node,
+                        bound_to=node.name))
+                elif isinstance(dec, ast.Call):
+                    src: Optional[ast.Call] = None
+                    if is_jit_name(dec.func):
+                        src = dec          # @jax.jit(static_argnums=...)
+                    elif last_segment(dotted(dec.func)) == "partial" \
+                            and dec.args and is_jit_name(dec.args[0]):
+                        src = dec          # @partial(jax.jit, ...)
+                    if src is not None:
+                        sites.append(JitSite(
+                            line=node.lineno, call=None, fn_def=node,
+                            bound_to=node.name,
+                            static_argnums=_const_int_tuple(
+                                _kwarg(src, "static_argnums")),
+                            static_argnames=_const_str_tuple(
+                                _kwarg(src, "static_argnames")),
+                            donate_argnums=_const_int_tuple(
+                                _kwarg(src, "donate_argnums"))))
+    return sites
+
+
+# ------------------------------------------------------- statement-level CFG
+
+
+class CFG:
+    """Successor edges between the statements of ONE function body.
+    Compound headers (If/While/For/Try/With) are nodes themselves; their
+    nested statements are separate nodes.  Nested defs do not flow."""
+
+    def __init__(self) -> None:
+        self.entry: Optional[ast.stmt] = None
+        self.stmts: List[ast.stmt] = []
+        self.succ: Dict[int, List[ast.stmt]] = {}
+
+    def _edge(self, frm: ast.stmt, to: Optional[ast.stmt]) -> None:
+        if to is not None:
+            lst = self.succ.setdefault(id(frm), [])
+            if all(s is not to for s in lst):
+                lst.append(to)
+
+    def successors(self, stmt: ast.stmt) -> List[ast.stmt]:
+        return self.succ.get(id(stmt), [])
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    cfg = CFG()
+    body = getattr(fn, "body", None)
+    if not isinstance(body, list):         # Lambda: no statements
+        return cfg
+    cfg.entry = _wire(cfg, body, None, [])
+    return cfg
+
+
+def _wire(cfg: CFG, body: Sequence[ast.stmt], follow: Optional[ast.stmt],
+          loops: List[Tuple[ast.stmt, Optional[ast.stmt]]]
+          ) -> Optional[ast.stmt]:
+    """Wire `body`; `follow` is what executes after it.  Returns the
+    body's entry statement (or `follow` when the body is empty)."""
+    entry = follow
+    for stmt in reversed(list(body)):
+        entry = _wire_stmt(cfg, stmt, entry, loops)
+    return entry
+
+
+def _wire_stmt(cfg: CFG, stmt: ast.stmt, follow: Optional[ast.stmt],
+               loops: List[Tuple[ast.stmt, Optional[ast.stmt]]]
+               ) -> ast.stmt:
+    cfg.stmts.append(stmt)
+    if isinstance(stmt, ast.If):
+        cfg._edge(stmt, _wire(cfg, stmt.body, follow, loops))
+        if stmt.orelse:
+            cfg._edge(stmt, _wire(cfg, stmt.orelse, follow, loops))
+        else:
+            cfg._edge(stmt, follow)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        exit_to = _wire(cfg, stmt.orelse, follow, loops) \
+            if stmt.orelse else follow
+        body_entry = _wire(cfg, stmt.body, stmt, loops + [(stmt, exit_to)])
+        cfg._edge(stmt, body_entry)
+        cfg._edge(stmt, exit_to)           # zero-iteration path
+    elif isinstance(stmt, (ast.Return, ast.Raise)):
+        pass                               # terminates the path
+    elif isinstance(stmt, ast.Break):
+        if loops:
+            cfg._edge(stmt, loops[-1][1])
+    elif isinstance(stmt, ast.Continue):
+        if loops:
+            cfg._edge(stmt, loops[-1][0])
+    elif isinstance(stmt, ast.Try):
+        after = _wire(cfg, stmt.finalbody, follow, loops) \
+            if stmt.finalbody else follow
+        else_entry = _wire(cfg, stmt.orelse, after, loops) \
+            if stmt.orelse else after
+        handler_entries = [
+            _wire(cfg, h.body, after, loops) for h in stmt.handlers]
+        body_entry = _wire(cfg, stmt.body, else_entry, loops)
+        cfg._edge(stmt, body_entry)
+        for he in handler_entries:
+            cfg._edge(stmt, he)
+            # Any statement of the try body may raise into the handler
+            # — including a donating call that dies mid-statement.
+            for sub in stmt.body:
+                cfg._edge(sub, he)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        cfg._edge(stmt, _wire(cfg, stmt.body, follow, loops))
+    elif isinstance(stmt, ast.Match):
+        for case in stmt.cases:
+            cfg._edge(stmt, _wire(cfg, case.body, follow, loops))
+        cfg._edge(stmt, follow)            # no-case-matched path
+    else:
+        # Simple statements and nested def/class (whose bodies run when
+        # called, not here) fall through.
+        cfg._edge(stmt, follow)
+    return stmt
+
+
+def stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The statement's OWN expressions (headers only — nested statements
+    of compound bodies are separate CFG nodes)."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.target, stmt.value]
+    if isinstance(stmt, (ast.Expr, ast.Return)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg is not None else [])
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    return []
+
+
+# ------------------------------------------------------ provenance analysis
+
+#: host materializers: receiver-method style.
+_MAT_METHODS = {"item", "tolist"}
+#: host materializers: np namespace functions (NOT jnp.asarray — that
+#: stays on device).
+_MAT_NP = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+           "np.ascontiguousarray", "numpy.ascontiguousarray"}
+#: host materializers: builtins over one arg.
+_MAT_BUILTINS = {"float", "int", "bool"}
+
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.nn.",
+                    "jax.random.", "jax.scipy.", "jax.tree_util.",
+                    "jax.tree.")
+#: jax.* calls that return plain host values, not device arrays.
+_JAX_HOST_UTILS = {"jax.devices", "jax.local_devices", "jax.device_count",
+                   "jax.local_device_count", "jax.process_index",
+                   "jax.process_count", "jax.default_backend",
+                   "jax.eval_shape", "jax.make_jaxpr"}
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+
+
+@dataclass
+class Materialization:
+    """One device→host sync point (or trace-time concretization)."""
+
+    node: ast.Call
+    stmt: ast.stmt
+    kind: str            # "np.asarray", "int", ".item", "device_get", ...
+    in_comprehension: bool = False
+
+
+class FlowAnalysis:
+    """Forward provenance fixpoint over one function's CFG.
+
+    `seed` maps parameter names to initial masks (e.g. every traced
+    formal of a jitted function to TRACED); `device_callables` is the
+    set of dotted names known to return device values when called (the
+    file's jit-bound names) — a call THROUGH a dispatch wrapper counts
+    when the wrapper receives one of those names as an argument."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST,
+                 seed: Optional[Dict[str, int]] = None,
+                 device_callables: Optional[Iterable[str]] = None):
+        self.ctx = ctx
+        self.fn = fn
+        self.cfg = build_cfg(fn)
+        self.device_callables = set(device_callables or ())
+        self.expr_tags: Dict[int, int] = {}
+        #: id(call-node) -> Materialization (dict: fixpoint re-visits
+        #: overwrite instead of duplicating)
+        self._events: Dict[int, Materialization] = {}
+        self.env_in: Dict[int, Dict[str, int]] = {}
+        self._cur_stmt: Optional[ast.stmt] = None
+        self._comp_depth = 0
+        self._run(dict(seed or {}))
+
+    # -- results ---------------------------------------------------------
+
+    @property
+    def materializations(self) -> List[Materialization]:
+        return sorted(self._events.values(), key=lambda m: m.node.lineno)
+
+    def mask(self, expr: ast.AST) -> int:
+        return self.expr_tags.get(id(expr), STATIC)
+
+    # -- fixpoint --------------------------------------------------------
+
+    def _run(self, seed: Dict[str, int]) -> None:
+        entry = self.cfg.entry
+        if entry is None:
+            return
+        self.env_in[id(entry)] = dict(seed)
+        work: List[ast.stmt] = [entry]
+        visits: Dict[int, int] = {}
+        cap = max(len(self.cfg.stmts) * 8, 64)
+        while work:
+            stmt = work.pop()
+            visits[id(stmt)] = visits.get(id(stmt), 0) + 1
+            if visits[id(stmt)] > cap:
+                continue                   # termination backstop
+            env = dict(self.env_in.get(id(stmt), {}))
+            self._transfer(stmt, env)
+            for succ in self.cfg.successors(stmt):
+                cur = self.env_in.get(id(succ))
+                if cur is None:
+                    self.env_in[id(succ)] = dict(env)
+                    work.append(succ)
+                    continue
+                changed = False
+                for k, v in env.items():
+                    j = join(cur.get(k, STATIC), v)
+                    if cur.get(k, STATIC) != j:
+                        cur[k] = j
+                        changed = True
+                if changed:
+                    work.append(succ)
+
+    # -- transfer --------------------------------------------------------
+
+    def _transfer(self, stmt: ast.stmt, env: Dict[str, int]) -> None:
+        self._cur_stmt = stmt
+        if isinstance(stmt, ast.Assign):
+            mask = self._tag(stmt.value, env)
+            for tgt in stmt.targets:
+                self._bind(tgt, mask, env, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self._tag(stmt.value, env), env,
+                           stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            mask = join(self._tag(stmt.value, env),
+                        self._lookup(stmt.target, env))
+            self._bind(stmt.target, mask, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # Elements of a traced/host iterable carry its provenance.
+            self._bind(stmt.target, self._tag(stmt.iter, env), env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                mask = self._tag(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, mask, env)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                d = dotted(tgt)
+                env.pop(d, None)
+        else:
+            for expr in stmt_exprs(stmt):
+                self._tag(expr, env)
+
+    def _lookup(self, node: ast.AST, env: Dict[str, int]) -> int:
+        d = dotted(node)
+        return env.get(d, STATIC) if d else STATIC
+
+    def _bind(self, target: ast.AST, mask: int, env: Dict[str, int],
+              value: Optional[ast.AST] = None) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = mask
+        elif isinstance(target, ast.Attribute):
+            d = dotted(target)
+            if d:
+                env[d] = mask
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = getattr(value, "elts", None) \
+                if isinstance(value, (ast.Tuple, ast.List)) else None
+            if elts is not None and len(elts) == len(target.elts):
+                for t, v in zip(target.elts, elts):
+                    self._bind(t, self.expr_tags.get(id(v), mask), env, v)
+            else:
+                for t in target.elts:
+                    self._bind(t, mask, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, mask, env)
+        elif isinstance(target, ast.Subscript):
+            d = dotted(target.value)
+            if d:                          # write INTO a container: join
+                env[d] = join(env.get(d, STATIC), mask)
+
+    # -- expression tagging ---------------------------------------------
+
+    def _tag(self, e: ast.AST, env: Dict[str, int]) -> int:
+        mask = self._tag_inner(e, env)
+        self.expr_tags[id(e)] = mask
+        return mask
+
+    def _tag_inner(self, e: ast.AST, env: Dict[str, int]) -> int:
+        if isinstance(e, ast.Constant):
+            return STATIC
+        if isinstance(e, ast.Name):
+            return env.get(e.id, STATIC)
+        if isinstance(e, ast.Attribute):
+            d = dotted(e)
+            if d and d in env:
+                return env[d]
+            base = self._tag(e.value, env)
+            if e.attr in _SHAPE_ATTRS:
+                # Shape/dtype of a device (or device-derived host) value
+                # is static under trace — but remembering the derivation
+                # is what catches shape→static_argnums feedback.
+                if tag_of(base) != STATIC:
+                    return STATIC | SHAPE
+                return STATIC | (base & SHAPE)
+            return base                    # x.T, x.at, x.real, ...
+        if isinstance(e, ast.Call):
+            return self._tag_call(e, env)
+        if isinstance(e, ast.BinOp):
+            return join(self._tag(e.left, env), self._tag(e.right, env))
+        if isinstance(e, ast.UnaryOp):
+            return self._tag(e.operand, env)
+        if isinstance(e, ast.BoolOp):
+            mask = STATIC
+            for v in e.values:
+                mask = join(mask, self._tag(v, env))
+            return mask
+        if isinstance(e, ast.Compare):
+            mask = self._tag(e.left, env)
+            for c in e.comparators:
+                mask = join(mask, self._tag(c, env))
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in e.ops) \
+                    and isinstance(e.left, ast.Constant) \
+                    and isinstance(e.left.value, str):
+                # `"kl" in metrics` on a traced pytree is dict-KEY
+                # membership — decided by Python at trace time, never a
+                # tracer.  (A traced left operand stays traced.)
+                return STATIC | (mask & SHAPE)
+            return mask
+        if isinstance(e, ast.Subscript):
+            base = self._tag(e.value, env)
+            self._tag(e.slice, env)
+            return base                    # traced[i] traced; shape[0]
+            # keeps the SHAPE bit through the subscript
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            mask = STATIC
+            for v in e.elts:
+                mask = join(mask, self._tag(v, env))
+            return mask
+        if isinstance(e, ast.Dict):
+            mask = STATIC
+            for v in e.values:
+                if v is not None:
+                    mask = join(mask, self._tag(v, env))
+            for k in e.keys:
+                if k is not None:
+                    self._tag(k, env)
+            return mask
+        if isinstance(e, ast.IfExp):
+            self._tag(e.test, env)
+            return join(self._tag(e.body, env), self._tag(e.orelse, env))
+        if isinstance(e, ast.Starred):
+            return self._tag(e.value, env)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            return self._tag_comprehension(e, env)
+        if isinstance(e, ast.Lambda):
+            return STATIC                  # a closure object, not a value
+        if isinstance(e, (ast.JoinedStr, ast.FormattedValue)):
+            for sub in ast.iter_child_nodes(e):
+                if isinstance(sub, ast.expr):
+                    self._tag(sub, env)
+            return STATIC
+        if isinstance(e, ast.NamedExpr):
+            mask = self._tag(e.value, env)
+            self._bind(e.target, mask, env)
+            return mask
+        if isinstance(e, ast.Await):
+            return self._tag(e.value, env)
+        if isinstance(e, ast.Slice):
+            for sub in (e.lower, e.upper, e.step):
+                if sub is not None:
+                    self._tag(sub, env)
+            return STATIC
+        return STATIC
+
+    def _tag_comprehension(self, e: ast.AST, env: Dict[str, int]) -> int:
+        # A comprehension IS a loop: bind its targets from the iterables
+        # in a scratch env; materializers inside run once per element.
+        scratch = dict(env)
+        self._comp_depth += 1
+        try:
+            for gen in e.generators:
+                mask = self._tag(gen.iter, scratch)
+                self._bind(gen.target, mask, scratch)
+                for cond in gen.ifs:
+                    self._tag(cond, scratch)
+            if isinstance(e, ast.DictComp):
+                self._tag(e.key, scratch)
+                return self._tag(e.value, scratch)
+            return self._tag(e.elt, scratch)
+        finally:
+            self._comp_depth -= 1
+
+    def _tag_call(self, call: ast.Call, env: Dict[str, int]) -> int:
+        func = call.func
+        fname = dotted(func)
+        seg = last_segment(fname) if fname else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+
+        # -- host materializers: the sync points ------------------------
+        inner: Optional[ast.AST] = None
+        kind: Optional[str] = None
+        if fname in _MAT_NP and call.args:
+            inner, kind = call.args[0], seg
+        elif seg == "device_get" and call.args:
+            inner, kind = call.args[0], "device_get"
+        elif isinstance(func, ast.Name) and func.id in _MAT_BUILTINS \
+                and len(call.args) == 1:
+            inner, kind = call.args[0], func.id
+        elif isinstance(func, ast.Attribute) and \
+                func.attr in _MAT_METHODS and not call.args:
+            inner, kind = func.value, "." + func.attr
+        if inner is not None:
+            mask = self._tag(inner, env)
+            for extra in call.args[1:]:
+                self._tag(extra, env)
+            if is_traced(mask):
+                self._events[id(call)] = Materialization(
+                    node=call, stmt=self._cur_stmt, kind=kind,
+                    in_comprehension=self._comp_depth > 0)
+                return HOST | (mask & SHAPE)
+            return mask                    # int(static)/int(host): no sync
+
+        # block_until_ready: a sync, but the value stays on device.
+        if seg == "block_until_ready":
+            recv = func.value if isinstance(func, ast.Attribute) else (
+                call.args[0] if call.args else None)
+            mask = self._tag(recv, env) if recv is not None else STATIC
+            for a in call.args:
+                if a is not recv:
+                    self._tag(a, env)
+            if is_traced(mask):
+                self._events[id(call)] = Materialization(
+                    node=call, stmt=self._cur_stmt,
+                    kind="block_until_ready",
+                    in_comprehension=self._comp_depth > 0)
+            return mask
+
+        # -- evaluate arguments (always, for events inside them) --------
+        arg_mask = STATIC
+        for a in call.args:
+            arg_mask = join(arg_mask, self._tag(a, env))
+        for kw in call.keywords:
+            arg_mask = join(arg_mask, self._tag(kw.value, env))
+
+        # len(traced) is static shape metadata.
+        if isinstance(func, ast.Name) and func.id == "len" \
+                and len(call.args) == 1:
+            m = self.expr_tags.get(id(call.args[0]), STATIC)
+            return STATIC | (SHAPE if is_traced(m) else m & SHAPE)
+
+        # -- device-value producers -------------------------------------
+        if fname:
+            if fname in _JAX_HOST_UTILS:
+                return STATIC
+            if fname.startswith(_DEVICE_PREFIXES) or fname.startswith(
+                    "jax.") and not fname.startswith("jax.sharding."):
+                return TRACED
+            # A call to a known jitted callable returns device values.
+            if fname in env and is_traced(env[fname]):
+                return TRACED
+            if fname in self.device_callables:
+                return TRACED
+        # Dispatch-wrapper idiom: `self._call("decode", self._decode_fn,
+        # ...)` — a call handed a jitted callable runs it.
+        for a in call.args:
+            d = dotted(a)
+            if d and d in self.device_callables:
+                return TRACED
+
+        # Receiver methods on traced values stay traced (x.sum(), .astype).
+        recv_mask = STATIC
+        if isinstance(func, ast.Attribute):
+            recv_mask = self._tag(func.value, env)
+        if isinstance(func, ast.Call):      # jax.grad(f)(x) and friends
+            recv_mask = join(recv_mask, self._tag(func, env))
+        return join(arg_mask, recv_mask)
+
+
+# ----------------------------------------------------- read/write queries
+
+
+def reads_name(stmt: ast.stmt, name: str) -> Optional[ast.AST]:
+    """The first Load of dotted `name` among the statement's own
+    expressions (assign targets and nested bodies excluded)."""
+    for expr in stmt_exprs(stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load) and \
+                    dotted(node) == name:
+                return node
+    return None
+
+
+def writes_name(stmt: ast.stmt, name: str) -> bool:
+    """Whether the statement rebinds dotted `name` (plain or tuple
+    target, with-as, for-target, aug-assign, del)."""
+
+    def target_hits(tgt: ast.AST) -> bool:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            return any(target_hits(t) for t in tgt.elts)
+        if isinstance(tgt, ast.Starred):
+            return target_hits(tgt.value)
+        return dotted(tgt) == name
+
+    if isinstance(stmt, ast.Assign):
+        return any(target_hits(t) for t in stmt.targets)
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return target_hits(stmt.target)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return target_hits(stmt.target)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return any(item.optional_vars is not None
+                   and target_hits(item.optional_vars)
+                   for item in stmt.items)
+    if isinstance(stmt, ast.Delete):
+        return any(dotted(t) == name for t in stmt.targets)
+    return False
+
+
+def first_read_after(cfg: CFG, start: ast.stmt,
+                     name: str) -> Optional[Tuple[ast.stmt, ast.AST]]:
+    """BFS the CFG from `start`'s successors: the first statement on any
+    path that READS dotted `name` before anything rebinds it.  Returns
+    (statement, offending node) or None.  A statement that both reads
+    and writes (``x = f(x)``) counts as a read."""
+    from collections import deque
+
+    seen = set()
+    queue = deque(cfg.successors(start))
+    while queue:
+        stmt = queue.popleft()
+        if id(stmt) in seen:
+            continue
+        seen.add(id(stmt))
+        node = reads_name(stmt, name)
+        if node is not None:
+            return stmt, node
+        if writes_name(stmt, name):
+            continue                       # rebound: this path is safe
+        queue.extend(cfg.successors(stmt))
+    return None
+
+
+# ------------------------------------------------------------ jax extract
+#
+# The per-file contribution RL023 joins across the package: declared
+# mesh axis names vs PartitionSpec literals.  Cached with the summary
+# (the cache fingerprint hashes this module, so editing the extractor
+# invalidates stale entries automatically).
+
+_MESH_CTORS = {"Mesh", "make_mesh", "AbstractMesh"}
+_SPEC_CTORS = {"PartitionSpec"}
+
+
+def _spec_aliases(ctx: FileContext) -> set:
+    """Local names bound to PartitionSpec (`as P` being the idiom)."""
+    names = set(_SPEC_CTORS)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec" and alias.asname:
+                    names.add(alias.asname)
+        elif isinstance(node, ast.Assign) and \
+                last_segment(dotted(node.value)) == "PartitionSpec":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _axes_from_node(node: ast.AST) -> List[str]:
+    """Literal axis names in a Mesh axis tuple/list/str."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def jax_extract(ctx: FileContext) -> dict:
+    """JSON-serializable mesh/spec extract for the project graph."""
+    out = {"mesh_axes": [], "specs": []}
+    if "jax" not in ctx.source and "PartitionSpec" not in ctx.source:
+        return out
+    spec_names = _spec_aliases(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = last_segment(dotted(node.func))
+        if seg in _MESH_CTORS:
+            axes_node = node.args[1] if len(node.args) > 1 else \
+                _kwarg(node, "axis_names")
+            axes = _axes_from_node(axes_node) if axes_node is not None \
+                else []
+            if axes:
+                out["mesh_axes"].append(
+                    {"axes": axes, "line": node.lineno})
+        elif seg == "MeshSpec" and node.args and \
+                isinstance(node.args[0], ast.Dict):
+            axes = [k.value for k in node.args[0].keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if axes:
+                out["mesh_axes"].append(
+                    {"axes": axes, "line": node.lineno})
+        elif seg in spec_names and isinstance(node.func, (ast.Name,
+                                                          ast.Attribute)):
+            dims: List[object] = []
+            literal = True
+            for a in node.args:
+                if isinstance(a, ast.Constant) and a.value is None:
+                    dims.append(None)
+                elif isinstance(a, ast.Constant) and \
+                        isinstance(a.value, str):
+                    dims.append(a.value)
+                elif isinstance(a, (ast.Tuple, ast.List)):
+                    sub = _axes_from_node(a)
+                    if len(sub) == len(a.elts):
+                        dims.append(sub)
+                    else:
+                        dims.append("?")
+                        literal = False
+                else:
+                    dims.append("?")
+                    literal = False
+            if not node.args:
+                continue                   # P(): fully replicated, fine
+            out["specs"].append({
+                "dims": dims, "line": node.lineno, "literal": literal,
+                "trailing_none": dims[-1] is None})
+    return out

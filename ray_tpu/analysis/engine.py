@@ -64,22 +64,35 @@ RULES: Dict[str, tuple] = {}
 #: per invocation over the ProjectGraph, after every file is summarized.
 PROJECT_RULES: Dict[str, tuple] = {}
 
+#: rule id -> human-readable file-set scope, shown by `--list-rules`.
+#: Kept separate from the (fn, desc) tuples so their shape — unpacked
+#: at every call site — stays stable.
+RULE_SCOPES: Dict[str, str] = {}
 
-def rule(rule_id: str, description: str):
+#: Retired rule ids -> the rule that superseded them. Selecting one via
+#: `--rules` is a loud error (exit 2 with the pointer), never a silent
+#: no-op: a CI invocation pinned to a retired id must fail, not pass
+#: with zero findings.
+RETIRED_RULES: Dict[str, str] = {"RL006": "RL020"}
+
+
+def rule(rule_id: str, description: str, scope: str = "all files"):
     """Register a rule checker under `rule_id` (e.g. "RL002")."""
 
     def deco(fn: Callable[["FileContext"], Iterable[Finding]]):
         RULES[rule_id] = (fn, description)
+        RULE_SCOPES[rule_id] = scope
         return fn
 
     return deco
 
 
-def project_rule(rule_id: str, description: str):
+def project_rule(rule_id: str, description: str, scope: str = "whole program"):
     """Register a whole-program rule checker under `rule_id`."""
 
     def deco(fn):
         PROJECT_RULES[rule_id] = (fn, description)
+        RULE_SCOPES[rule_id] = scope
         return fn
 
     return deco

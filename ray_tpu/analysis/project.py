@@ -48,6 +48,7 @@ from ray_tpu.analysis.engine import (
     last_segment,
     project_rule,
 )
+from ray_tpu.analysis import dataflow
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -74,7 +75,8 @@ _EXECUTORISH = re.compile(r"executor|pool", re.I)
 def empty_summary() -> dict:
     return {"registrations": [], "calls": [], "knob_decls": [],
             "knob_reads": [], "knob_writes": [], "str_literals": {},
-            "handle_refs": [], "classes": {}}
+            "handle_refs": [], "classes": {},
+            "jax_extract": {"mesh_axes": [], "specs": []}}
 
 
 # ----------------------------------------------------------- summarize
@@ -381,6 +383,7 @@ def summarize(ctx: FileContext) -> dict:
             out["classes"][node.name] = _summarize_class(ctx, node)
 
     out["handle_refs"] = sorted(handle_refs)
+    out["jax_extract"] = dataflow.jax_extract(ctx)
     return out
 
 
@@ -405,6 +408,11 @@ class ProjectGraph:
         self.literal_counts: Dict[str, int] = {}
         self.handle_refs: Set[str] = set()
         self.classes: List[Tuple[str, str, dict]] = []  # (display, cls, data)
+        #: mesh declarations / PartitionSpec literals from the per-file
+        #: `jax_extract` sections (dataflow.jax_extract), each dict with
+        #: file= attached — RL023's whole-program join.
+        self.mesh_axes: List[dict] = []
+        self.specs: List[dict] = []
         self._config_files: List[str] = []
 
         for abspath, s in summaries.items():
@@ -431,6 +439,11 @@ class ProjectGraph:
             self.handle_refs.update(s.get("handle_refs", ()))
             for cname, cdata in s.get("classes", {}).items():
                 self.classes.append((display, cname, cdata))
+            jx = s.get("jax_extract") or {}
+            for m in jx.get("mesh_axes", ()):
+                self.mesh_axes.append(dict(m, file=display))
+            for sp in jx.get("specs", ()):
+                self.specs.append(dict(sp, file=display))
 
     def abspath_for(self, display: str) -> Optional[str]:
         return self._abspath_by_display.get(display)

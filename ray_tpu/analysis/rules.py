@@ -9,7 +9,6 @@ for the catalog with real before/after examples):
 - RL003 raw-buffer-leak        — put_raw segments freed on every path
 - RL004 swallowed-exception    — broad excepts must log or re-raise
 - RL005 thread-leak            — threads daemonized or joined
-- RL006 jit-retrace-hazard     — XLA programs compiled once, cached
 - RL007 static-lock-order      — lock acquisition graph is acyclic
 - RL008 span-leak              — tracing spans always end()ed
 - RL009 gang-without-death-hook — placement-grouped gangs abort cleanly
@@ -48,7 +47,9 @@ for the catalog with real before/after examples):
 
 (RL014 rpc-contract, RL015 config-knob-drift and RL016
 loop-confined-escape are whole-program rules — they live in
-:mod:`ray_tpu.analysis.project` on top of the ProjectGraph.)
+:mod:`ray_tpu.analysis.project` on top of the ProjectGraph.  RL006
+jit-retrace-hazard is retired: RL020-RL024, the dataflow-powered JAX
+accelerator-hazard family, live in :mod:`ray_tpu.analysis.jaxrules`.)
 """
 
 from __future__ import annotations
@@ -598,72 +599,11 @@ def check_thread_leak(ctx: FileContext) -> Iterable[Finding]:
                 "and join it")
 
 
-# =====================================================================
-# RL006 jit-retrace-hazard
-# =====================================================================
-#
-# `jax.jit(fn)` builds a fresh cache; constructing it inside a loop or a
-# per-step method compiles a new XLA program every call — the exact
-# failure the inference engine's compile-once counters guard at runtime.
-# jit objects belong at module scope, factory scope, or cached on self
-# behind an `is None` check.
-
-_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
-_FACTORY_PREFIXES = ("make", "build", "create", "get", "init", "setup",
-                     "compile", "_make", "_build", "_create", "_get",
-                     "_init", "_setup", "_compile", "__init__")
-_PERSTEP_NAMES = {"forward", "decode", "prefill", "generate", "sample"}
-
-
-def _is_jit_call(call: ast.Call) -> bool:
-    name = dotted(call.func)
-    return name in _JIT_NAMES or last_segment(name) in ("jit", "pjit")
-
-
-def _cached_behind_none_check(ctx: FileContext, call: ast.Call) -> bool:
-    for anc in ctx.ancestors(call):
-        if isinstance(anc, _FUNC_NODES):
-            return False
-        if isinstance(anc, ast.If):
-            test = ast.unparse(anc.test)
-            if "is None" in test or "not " in test:
-                return True
-    return False
-
-
-@rule("RL006", "jit-retrace-hazard: jax.jit/pjit constructed per call "
-               "instead of cached")
-def check_jit_retrace(ctx: FileContext) -> Iterable[Finding]:
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
-            continue
-        in_loop = False
-        fn_name = None
-        for anc in ctx.ancestors(node):
-            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
-                in_loop = True
-            if isinstance(anc, _FUNC_NODES):
-                fn_name = anc.name
-                break
-        if in_loop and not _cached_behind_none_check(ctx, node):
-            yield ctx.finding(
-                node, "RL006",
-                "jax.jit constructed inside a loop — every iteration builds "
-                "a fresh trace cache and recompiles; hoist the jit to "
-                "module/factory scope")
-            continue
-        if fn_name is None:
-            continue
-        lowered = fn_name.lower()
-        if lowered.startswith(_FACTORY_PREFIXES):
-            continue
-        perstep = ("step" in lowered) or (lowered in _PERSTEP_NAMES)
-        if perstep and not _cached_behind_none_check(ctx, node):
-            yield ctx.finding(
-                node, "RL006",
-                f"jax.jit constructed inside per-step method '{fn_name}' — "
-                "each call recompiles; cache the jitted callable at "
-                "factory scope or on self behind an `is None` check")
+# RL006 jit-retrace-hazard RETIRED: superseded by RL020 (jaxrules.py),
+# which keeps these lexical checks and adds dataflow-powered ones
+# (traced-value control flow, trace-time host materialization,
+# shape→static feedback).  engine.RETIRED_RULES makes `--rules RL006`
+# fail loudly with the pointer.
 
 
 # =====================================================================

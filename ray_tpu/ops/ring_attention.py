@@ -163,7 +163,10 @@ def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
 
         return flash_attention(q, k, v, causal, scale)
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
-    spec = P(data_axes, None, sp_axis, None)
+    # No trailing None for head_dim: unspecified trailing dims are
+    # replicated anyway, and a trailing-None spec produces a different
+    # jit cache key than the normalized one (RL023; the PR-8 recompile).
+    spec = P(data_axes, None, sp_axis)
     body = partial(ring_attention, axis_name=sp_axis, causal=causal,
                    scale=scale)
     try:

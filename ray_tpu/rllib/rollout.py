@@ -82,15 +82,19 @@ class RolloutWorker:
         for t in range(num_steps):
             self._rng, key = jax.random.split(self._rng)
             out = self.module.forward_exploration(self.params, obs, key)
-            actions = np.asarray(out["actions"])
+            # The env needs host actions every step — this sync IS the
+            # rollout contract; one device_get moves the whole step
+            # output in a single transfer instead of three round-trips.
+            host = jax.device_get(out)  # raylint: disable=RL021 — per-step sync is the env-step contract
+            actions = host["actions"]
             next_obs, rewards, dones, infos = self.env.step(actions)
             obs_buf[t] = obs
             act_buf[t] = actions
             rew_buf[t] = rewards
             done_buf[t] = dones
             trunc_buf[t] = infos.get("truncated", np.zeros(n, dtype=bool))
-            logp_buf[t] = np.asarray(out["logp"])
-            vf_buf[t] = np.asarray(out["vf"])
+            logp_buf[t] = host["logp"]
+            vf_buf[t] = host["vf"]
             self._ep_returns += rewards
             self._ep_lens += 1
             done_rows = np.nonzero(dones)[0]

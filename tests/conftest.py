@@ -114,6 +114,29 @@ def ray_start_regular():
         ray_tpu.shutdown()
 
 
+def assert_compiles_once(source, *counters, context=None):
+    """The compile-once discipline, shared across the JAX test surface
+    (the dynamic complement of raylint's RL020/RL024 static checks).
+
+    Two forms:
+
+    - ``assert_compiles_once(jitted_fn)`` — the callable's trace cache
+      holds exactly ONE compiled program (``_cache_size()``);
+    - ``assert_compiles_once(stats, "prefill_compiles", ...)`` — each
+      named counter in a stats/metrics dict is exactly 1.
+
+    `context` is included in the failure message (engine name, arm
+    label) so parametrized sweeps stay diagnosable.
+    """
+    if not isinstance(source, dict):
+        n = source._cache_size()
+        assert n == 1, (context, "trace cache holds", n, "programs")
+        return
+    assert counters, "name the counters to check on a stats dict"
+    for key in counters:
+        assert source.get(key) == 1, (context, key, source)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import assert_compiles_once
 from ray_tpu.models.llama import (
     Llama,
     LlamaConfig,
@@ -152,7 +153,7 @@ def test_decode_parity_and_compile_once(n_kv_head):
                                    np.asarray(full[:, t], np.float32),
                                    atol=0.06, rtol=0.05)
     # Shape-stable decode: one XLA program served every step.
-    assert decode_step._cache_size() == 1
+    assert_compiles_once(decode_step)
 
 
 def test_paged_decode_matches_dense(tiny_model):

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import pytest
 
 import ray_tpu
+from conftest import assert_compiles_once
 from ray_tpu import serve
 from ray_tpu.inference import AdapterLoadError, EngineConfig, InferenceEngine
 from ray_tpu.models.llama import Llama, LlamaConfig, make_adapter_weights
@@ -64,9 +65,8 @@ def test_multiplexed_parity_and_zero_new_programs(tiny_model):
         None: eng.add_request([7, 8, 9], 8),
     }
     eng.run_until_idle()
-    stats = eng.stats()
-    assert stats["prefill_compiles"] == 1, stats
-    assert stats["decode_compiles"] == 1, stats
+    assert_compiles_once(eng.stats(), "prefill_compiles",
+                         "decode_compiles")
     eng.check_no_leaks()
     outs = {mid: list(r.generated) for mid, r in reqs.items()}
     # Adapters actually steer generation (not identity deltas).
@@ -102,9 +102,8 @@ def test_lru_eviction_and_deterministic_reload(tiny_model):
     again = eng.add_request([1, 2, 3, 4, 5], 10, model_id="m-a")
     eng.run_until_idle()
     assert list(again.generated) == baseline
-    stats = eng.stats()
-    assert stats["prefill_compiles"] == 1
-    assert stats["decode_compiles"] == 1
+    assert_compiles_once(eng.stats(), "prefill_compiles",
+                         "decode_compiles")
     eng.check_no_leaks()
 
 
@@ -153,7 +152,7 @@ def test_cross_adapter_prefix_hits_with_parity(tiny_model):
         outs[mid] = list(r.generated)
     st = eng.stats()
     assert st["prefix_cache"]["hits"] >= 2, st["prefix_cache"]
-    assert st["prefill_compiles"] == 1 and st["decode_compiles"] == 1
+    assert_compiles_once(st, "prefill_compiles", "decode_compiles")
     eng.check_no_leaks()
     assert outs["m-a"] != outs["m-b"]  # adapters still steer generation
     # Cold engines (no warm cache) reproduce every warm-path output.
@@ -179,9 +178,8 @@ def test_tp2_multiplexed_parity(multi_device_workers, tiny_model):
               eng.add_request([9, 8, 7], 8, model_id="m-b")]
         eng.run_until_idle()
         outs[name] = [list(r.generated) for r in rs]
-        stats = eng.stats()
-        assert stats["prefill_compiles"] == 1, (name, stats)
-        assert stats["decode_compiles"] == 1, (name, stats)
+        assert_compiles_once(eng.stats(), "prefill_compiles",
+                             "decode_compiles", context=name)
         eng.check_no_leaks()
     assert outs["single"] == outs["tp2"]
 
@@ -214,9 +212,9 @@ def test_tp2_prefix_cache_and_spec_decode_parity(multi_device_workers,
         assert hit.cached_tokens == 16, (name, hit.cached_tokens)
         assert st["prefix_cache"]["hits"] >= 1, (name, st["prefix_cache"])
         assert st["spec_decode"]["accept_rate"] == 1.0, (name, st)
-        assert st["spec_decode"]["propose_compiles"] == 1, (name, st)
-        assert st["spec_decode"]["verify_compiles"] == 1, (name, st)
-        assert st["prefill_compiles"] == 1, (name, st)
+        assert_compiles_once(st["spec_decode"], "propose_compiles",
+                             "verify_compiles", context=name)
+        assert_compiles_once(st, "prefill_compiles", context=name)
         eng.check_no_leaks()
     assert outs["single"] == outs["tp2"]
 

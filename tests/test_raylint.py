@@ -485,9 +485,10 @@ def test_rl005_flags_explicit_daemon_false(tmp_path):
     assert rule_ids(findings) == ["RL005"]
 
 
-# ------------------------------------------------------------------ RL006
+# ------------------------------------------------------------------ RL020
+# (absorbs the retired RL006's lexical fixtures, then the dataflow ones)
 
-RL006_BAD = """
+RL020_BAD = """
     import jax
 
     class Engine:
@@ -496,7 +497,7 @@ RL006_BAD = """
             return fn(params, tokens)
 """
 
-RL006_GOOD = """
+RL020_GOOD = """
     import jax
 
     class Engine:
@@ -507,7 +508,7 @@ RL006_GOOD = """
             return self._step(params, tokens)
 """
 
-RL006_BAD_LOOP = """
+RL020_BAD_LOOP = """
     import jax
 
     def sweep(fns, x):
@@ -518,23 +519,23 @@ RL006_BAD_LOOP = """
 """
 
 
-def test_rl006_flags_jit_in_per_step_method(tmp_path):
-    findings = lint_src(tmp_path, RL006_BAD, rules=["RL006"])
-    assert rule_ids(findings) == ["RL006"]
+def test_rl020_flags_jit_in_per_step_method(tmp_path):
+    findings = lint_src(tmp_path, RL020_BAD, rules=["RL020"])
+    assert rule_ids(findings) == ["RL020"]
     assert "decode_step" in findings[0].message
 
 
-def test_rl006_quiet_on_factory_scope(tmp_path):
-    assert lint_src(tmp_path, RL006_GOOD, rules=["RL006"]) == []
+def test_rl020_quiet_on_factory_scope(tmp_path):
+    assert lint_src(tmp_path, RL020_GOOD, rules=["RL020"]) == []
 
 
-def test_rl006_flags_jit_in_loop(tmp_path):
-    findings = lint_src(tmp_path, RL006_BAD_LOOP, rules=["RL006"])
-    assert rule_ids(findings) == ["RL006"]
+def test_rl020_flags_jit_in_loop(tmp_path):
+    findings = lint_src(tmp_path, RL020_BAD_LOOP, rules=["RL020"])
+    assert rule_ids(findings) == ["RL020"]
     assert "loop" in findings[0].message
 
 
-def test_rl006_quiet_on_cached_behind_none_check(tmp_path):
+def test_rl020_quiet_on_cached_behind_none_check(tmp_path):
     src = """
         import jax
 
@@ -544,7 +545,274 @@ def test_rl006_quiet_on_cached_behind_none_check(tmp_path):
                     self._step = jax.jit(self._decode)
                 return self._step(params, tokens)
     """
-    assert lint_src(tmp_path, src, rules=["RL006"]) == []
+    assert lint_src(tmp_path, src, rules=["RL020"]) == []
+
+
+RL020_TRACED_IF = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        if x.sum() > 0:
+            return x * 2
+        return x
+"""
+
+RL020_GOOD_SHAPE_IF = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        if x.shape[0] > 1:
+            return jnp.where(x > 0, x * 2, x)
+        return x
+"""
+
+RL020_HOST_IN_JIT = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        return np.asarray(x) + 1
+"""
+
+RL020_SHAPE_TO_STATIC = """
+    import jax
+    import jax.numpy as jnp
+
+    pad = jax.jit(lambda x, n: jnp.pad(x, n), static_argnums=(1,))
+    embed = jax.jit(lambda x: x * 2)
+
+    def run(x):
+        h = embed(x)
+        return pad(h, h.shape[0] * 2)
+"""
+
+
+def test_rl020_flags_python_if_on_traced_value(tmp_path):
+    findings = lint_src(tmp_path, RL020_TRACED_IF, rules=["RL020"])
+    assert rule_ids(findings) == ["RL020"]
+    assert "traced" in findings[0].message
+
+
+def test_rl020_quiet_on_shape_based_if(tmp_path):
+    # x.shape is static at trace time — branching on it is the
+    # supported specialize-per-shape idiom, not a hazard.
+    assert lint_src(tmp_path, RL020_GOOD_SHAPE_IF, rules=["RL020"]) == []
+
+
+def test_rl020_flags_host_materialization_inside_jit(tmp_path):
+    findings = lint_src(tmp_path, RL020_HOST_IN_JIT, rules=["RL020"])
+    assert rule_ids(findings) == ["RL020"]
+    assert "materialization" in findings[0].message
+
+
+def test_rl020_flags_shape_fed_into_static_arg(tmp_path):
+    findings = lint_src(tmp_path, RL020_SHAPE_TO_STATIC, rules=["RL020"])
+    assert rule_ids(findings) == ["RL020"]
+    assert "static" in findings[0].message
+
+
+def test_rl020_quiet_on_config_static_arg(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        pad = jax.jit(lambda x, n: jnp.pad(x, n), static_argnums=(1,))
+
+        def run(x, cfg_n):
+            return pad(x, cfg_n)
+    """
+    assert lint_src(tmp_path, src, rules=["RL020"]) == []
+
+
+# ------------------------------------------------------------------ RL021
+
+RL021_BAD = """
+    import jax
+
+    step = jax.jit(lambda p, t: t)
+
+    class Engine:
+        def decode_step(self, params, tokens, reqs):
+            nxt = step(params, tokens)
+            for r in reqs:
+                r.out.append(int(nxt[r.slot]))
+"""
+
+RL021_GOOD = """
+    import jax
+    import numpy as np
+
+    step = jax.jit(lambda p, t: t)
+
+    class Engine:
+        def decode_step(self, params, tokens, reqs):
+            nxt = step(params, tokens)
+            host = np.asarray(nxt)
+            for r in reqs:
+                r.out.append(int(host[r.slot]))
+"""
+
+
+def test_rl021_flags_device_sync_in_hot_loop(tmp_path):
+    findings = lint_src(tmp_path, RL021_BAD, rules=["RL021"])
+    assert rule_ids(findings) == ["RL021"]
+    assert "decode_step" in findings[0].message
+
+
+def test_rl021_quiet_on_hoisted_post_step_sync(tmp_path):
+    # The engine idiom: ONE np.asarray before the loop, the loop reads
+    # the host copy — provenance keeps this silent where a lexical rule
+    # would flag the int() calls.
+    assert lint_src(tmp_path, RL021_GOOD, rules=["RL021"]) == []
+
+
+def test_rl021_quiet_in_cold_methods(tmp_path):
+    # Same sync-in-loop shape, but not a per-step method: checkpoint
+    # save paths may sync per tensor.
+    src = """
+        import jax
+
+        step = jax.jit(lambda p, t: t)
+
+        class Engine:
+            def save_checkpoint(self, params, tokens, reqs):
+                nxt = step(params, tokens)
+                for r in reqs:
+                    r.out.append(int(nxt[r.slot]))
+    """
+    assert lint_src(tmp_path, src, rules=["RL021"]) == []
+
+
+# ------------------------------------------------------------------ RL022
+
+RL022_BAD = """
+    import jax
+
+    decode = jax.jit(lambda params, arena: (1, arena),
+                     donate_argnums=(1,))
+
+    class Engine:
+        def run(self, params):
+            out, _ = decode(params, self._arena)
+            return self._arena
+"""
+
+RL022_GOOD = """
+    import jax
+
+    decode = jax.jit(lambda params, arena: (1, arena),
+                     donate_argnums=(1,))
+
+    class Engine:
+        def run(self, params):
+            nxt, self._arena = decode(params, self._arena)
+            return nxt
+"""
+
+
+def test_rl022_flags_read_after_donate(tmp_path):
+    findings = lint_src(tmp_path, RL022_BAD, rules=["RL022"])
+    assert rule_ids(findings) == ["RL022"]
+    assert "donate" in findings[0].message
+
+
+def test_rl022_quiet_on_rebind_from_result(tmp_path):
+    assert lint_src(tmp_path, RL022_GOOD, rules=["RL022"]) == []
+
+
+def test_rl022_flags_read_on_one_cfg_branch(tmp_path):
+    src = """
+        import jax
+
+        decode = jax.jit(lambda params, arena: (1, arena),
+                         donate_argnums=(1,))
+
+        class Engine:
+            def run(self, params, flaky):
+                nxt, arenas = decode(params, self._arenas)
+                if flaky:
+                    return self._arenas
+                self._arenas = arenas
+                return nxt
+    """
+    findings = lint_src(tmp_path, src, rules=["RL022"])
+    assert rule_ids(findings) == ["RL022"]
+
+
+def test_rl022_quiet_when_rebuilt_before_read(tmp_path):
+    # The engine's fail_all path: the arenas are rebuilt from scratch
+    # before anything reads them again.
+    src = """
+        import jax
+
+        decode = jax.jit(lambda params, arena: (1, arena),
+                         donate_argnums=(1,))
+
+        class Engine:
+            def run(self, params):
+                out, _ = decode(params, self._arenas)
+                self._arenas = self._build_arenas()
+                return self._arenas
+    """
+    assert lint_src(tmp_path, src, rules=["RL022"]) == []
+
+
+# ------------------------------------------------------------------ RL024
+
+RL024_BAD = """
+    import jax
+
+    class Model:
+        def build(self):
+            def fwd(x):
+                return x * self._scale
+            self._fn = jax.jit(fwd)
+
+        def set_scale(self, s):
+            self._scale = s
+"""
+
+RL024_GOOD = """
+    import jax
+
+    class Model:
+        def build(self):
+            def fwd(x, scale):
+                return x * scale
+            self._fn = jax.jit(fwd)
+
+        def set_scale(self, s):
+            self._scale = s
+"""
+
+
+def test_rl024_flags_jitted_closure_over_mutable_attr(tmp_path):
+    findings = lint_src(tmp_path, RL024_BAD, rules=["RL024"])
+    assert rule_ids(findings) == ["RL024"]
+    assert "_scale" in findings[0].message
+    assert "set_scale" in findings[0].message
+
+
+def test_rl024_quiet_when_value_is_an_argument(tmp_path):
+    assert lint_src(tmp_path, RL024_GOOD, rules=["RL024"]) == []
+
+
+def test_rl024_quiet_when_attr_only_set_in_init(tmp_path):
+    src = """
+        import jax
+
+        class Model:
+            def __init__(self, scale):
+                self._scale = scale
+                def fwd(x):
+                    return x * self._scale
+                self._fn = jax.jit(fwd)
+    """
+    assert lint_src(tmp_path, src, rules=["RL024"]) == []
 
 
 # ------------------------------------------------------------------ RL007

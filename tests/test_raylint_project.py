@@ -570,6 +570,73 @@ def test_rl017_handoff_counts_only_for_the_connection(tmp_path):
     assert lint_file(str(path), rule_ids=["RL017"]) == []
 
 
+# ------------------------------------------------------------------ RL023
+# (whole-program: PartitionSpec literals vs the union of declared mesh
+# axes, joined over the per-file jax_extract summaries)
+
+RL023_MESH = """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    def build(devices):
+        return Mesh(np.asarray(devices).reshape(2, 4), ("dp", "tp"))
+"""
+
+
+def test_rl023_flags_undeclared_axis(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "pkg/mesh.py": RL023_MESH,
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("dp", "model")
+        """,
+    }, rules=["RL023"])
+    assert rule_ids(findings) == ["RL023"]
+    assert "'model'" in findings[0].message
+    assert findings[0].path.endswith("model.py")
+
+
+def test_rl023_flags_trailing_none_spec(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "pkg/mesh.py": RL023_MESH,
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("dp", None)
+        """,
+    }, rules=["RL023"])
+    assert rule_ids(findings) == ["RL023"]
+    assert "trailing" in findings[0].message
+
+
+def test_rl023_quiet_on_declared_axes(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "pkg/mesh.py": RL023_MESH,
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            ROWS = P("dp", "tp")
+            INNER = P(None, "tp")
+            PAIR = P(("dp", "tp"))
+        """,
+    }, rules=["RL023"])
+    assert findings == []
+
+
+def test_rl023_axis_check_needs_a_declared_mesh(tmp_path):
+    # With no mesh declaration anywhere in the tree there is nothing to
+    # check axis names against; only the trailing-None check stays live.
+    findings = lint_tree(tmp_path, {
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("anything")
+        """,
+    }, rules=["RL023"])
+    assert findings == []
+
+
 # ------------------------------------------- mutation negative-controls
 
 
@@ -624,6 +691,80 @@ def test_mutation_removing_confine_annotation_fires_rl016(tmp_path):
     findings = [f for f in lint_paths_full([root], ["RL016"]).findings
                 if "_queues" in f.message]
     assert findings, "RL016 did not notice the dropped annotation"
+
+
+def test_mutation_traced_branch_in_jitted_epoch_fires_rl020(tmp_path):
+    root = copy_package(tmp_path)
+    # The KL tail-pick in the jitted scan epoch is dict-KEY membership
+    # (static); branching on the traced KL VALUE instead is the classic
+    # retrace hazard.
+    mutate(root, "rllib/learner.py",
+           'if "kl" in metrics:',
+           'if metrics["kl"].mean() > 0:')
+    findings = [f for f in lint_paths_full([root], ["RL020"]).findings
+                if "traced" in f.message]
+    assert findings, "RL020 did not notice the traced-value branch"
+
+
+def test_mutation_dropping_sync_suppression_fires_rl021(tmp_path):
+    root = copy_package(tmp_path)
+    # The rollout loop's per-step device_get is the env-step contract
+    # and carries a reasoned suppression; deleting the comment proves
+    # RL021 resolves the live loop, not just fixtures.
+    mutate(root, "rllib/rollout.py",
+           "host = jax.device_get(out)  # raylint: disable=RL021 — "
+           "per-step sync is the env-step contract",
+           "host = jax.device_get(out)")
+    findings = [f for f in lint_paths_full([root], ["RL021"]).findings
+                if "sample" in f.message]
+    assert findings, "RL021 did not notice the unsuppressed loop sync"
+
+
+def test_mutation_removing_donate_rebind_guard_fires_rl022(tmp_path):
+    root = copy_package(tmp_path)
+    # The draft-prefill lockstep rebinds the donated draft arenas in
+    # the same statement — the RL022 guard. Bind the result to a temp
+    # and keep an alias read of the donated name instead.
+    mutate(root, "inference/engine.py",
+           "            self._draft_arenas = self._call(\n"
+           '                "draft_prefill", self._draft_prefill_fn,\n'
+           "                self._draft_params, self._draft_arenas, "
+           "*args[:4])",
+           "            fresh = self._call(\n"
+           '                "draft_prefill", self._draft_prefill_fn,\n'
+           "                self._draft_params, self._draft_arenas, "
+           "*args[:4])\n"
+           "            self._draft_sync = self._draft_arenas\n"
+           "            self._draft_arenas = fresh")
+    findings = [f for f in lint_paths_full([root], ["RL022"]).findings
+                if "_draft_arenas" in f.message]
+    assert findings, "RL022 did not notice the read of the donated arenas"
+
+
+def test_mutation_adding_trailing_none_spec_fires_rl023(tmp_path):
+    root = copy_package(tmp_path)
+    # Reintroduce the PR-8 bug shape: a trailing literal None on the
+    # ring-attention shard_map spec.
+    mutate(root, "ops/ring_attention.py",
+           "spec = P(data_axes, None, sp_axis)",
+           "spec = P(data_axes, None, sp_axis, None)")
+    findings = [f for f in lint_paths_full([root], ["RL023"]).findings
+                if "trailing" in f.message
+                and f.path.endswith("ring_attention.py")]
+    assert findings, "RL023 did not notice the trailing-None spec"
+
+
+def test_mutation_steady_state_write_to_captured_attr_fires_rl024(tmp_path):
+    root = copy_package(tmp_path)
+    # LlamaSampler's jitted decode_step closure captures self._max_seq;
+    # rebinding it per batch makes the capture stale (jit burned the
+    # first-trace value in).
+    mutate(root, "serve/examples.py",
+           "pad = min(pad, self._max_seq)",
+           "pad = min(pad, self._max_seq)\n        self._max_seq = pad")
+    findings = [f for f in lint_paths_full([root], ["RL024"]).findings
+                if "_max_seq" in f.message]
+    assert findings, "RL024 did not notice the stale jit capture"
 
 
 def test_project_rules_see_whole_package_from_subset_paths():
@@ -703,6 +844,26 @@ def test_incremental_detects_edit_and_reanalyzes_one_file(tmp_path):
     assert any("get_thingg" in f.message for f in warm.findings)
 
 
+def test_incremental_jax_extract_only_change_updates_rl023(tmp_path):
+    """An edit that only changes a file's `jax_extract` section (one
+    PartitionSpec axis literal — no per-file rule cares) must flow
+    through the cached summaries into the RL023 project join."""
+    cache_dir = str(tmp_path / "cache")
+    model = ('from jax.sharding import PartitionSpec as P\n\n'
+             'SPEC = P("dp", "{}")\n')
+    root = write_tree(tmp_path, {"pkg/mesh.py": RL023_MESH})
+    (root / "pkg" / "model.py").write_text(model.format("tp"))
+    cold = lint_paths_full([str(root)], incremental=True,
+                           cache_dir=cache_dir)
+    assert cold.findings == [] and cold.cache_misses == 2
+    (root / "pkg" / "model.py").write_text(model.format("model"))
+    warm = lint_paths_full([str(root)], incremental=True,
+                           cache_dir=cache_dir)
+    assert warm.cache_misses == 1 and warm.cache_hits == 1
+    assert any(f.rule == "RL023" and "'model'" in f.message
+               for f in warm.findings)
+
+
 def test_incremental_cache_invalidates_on_rule_change(tmp_path, monkeypatch):
     from ray_tpu.analysis import engine
 
@@ -747,12 +908,43 @@ def test_cli_sarif_output_and_exit_codes(tmp_path):
     assert loc["region"]["startLine"] == 5
     assert loc["artifactLocation"]["uri"].endswith("bad.py")
     rules_meta = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"RL001", "RL014", "RL017"} <= rules_meta
+    assert {"RL001", "RL014", "RL017",
+            "RL020", "RL021", "RL022", "RL023", "RL024"} <= rules_meta
 
     good = tmp_path / "good.py"
     good.write_text("x = 1\n")
     assert run_cli([str(good), "--format", "sarif"]).returncode == 0  # clean
     assert run_cli([str(good), "--rules", "RL999"]).returncode == 2  # usage
+
+
+def test_cli_retired_rl006_errors_with_pointer(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = run_cli([str(good), "--rules", "RL006"])
+    assert proc.returncode == 2
+    assert "retired" in proc.stderr and "RL020" in proc.stderr
+
+
+def test_cli_unknown_rule_hints_at_catalog(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = run_cli([str(good), "--rules", "RL999"])
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+    assert "--list-rules" in proc.stderr
+
+
+def test_cli_list_rules_catalog():
+    proc = run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    for rid in ("RL001", "RL014", "RL020", "RL021",
+                "RL022", "RL023", "RL024"):
+        assert rid in proc.stdout, rid
+    assert "scope:" in proc.stdout
+    assert "[file]" in proc.stdout and "[project]" in proc.stdout
+    # The retired alias stays documented in the catalog.
+    assert "RL006" in proc.stdout
+    assert "superseded by RL020" in proc.stdout
 
 
 def test_cli_unused_suppression_report(tmp_path):

@@ -17,6 +17,7 @@ import urllib.request
 import pytest
 
 import ray_tpu
+from conftest import assert_compiles_once
 from ray_tpu import serve, shardgroup
 
 
@@ -103,9 +104,8 @@ def test_engine_tp_decode_parity_and_compile_once(multi_device_workers):
         engine.run_until_idle()
         outs[name] = [list(r.generated) for r in reqs]
         engine.check_no_leaks()
-        stats = engine.stats()
-        assert stats["prefill_compiles"] == 1, (name, stats)
-        assert stats["decode_compiles"] == 1, (name, stats)
+        assert_compiles_once(engine.stats(), "prefill_compiles",
+                             "decode_compiles", context=name)
     assert outs["single"] == outs["tp2"]
     # The arena really is sharded on its kv-head dim.
     engine_tp = InferenceEngine(cfg, mesh=mesh)
