@@ -787,6 +787,9 @@ def first_read_after(cfg: CFG, start: ast.stmt,
 
 _MESH_CTORS = {"Mesh", "make_mesh", "AbstractMesh"}
 _SPEC_CTORS = {"PartitionSpec"}
+# ShardSpec's multi-axis kwargs each declare one mesh axis of the same
+# name when sized > 1 (shardgroup/spec.py mesh_axes drops size-1 axes).
+_SHARDSPEC_AXIS_KWARGS = ("tp", "pp", "sp")
 
 
 def _spec_aliases(ctx: FileContext) -> set:
@@ -816,12 +819,36 @@ def _axes_from_node(node: ast.AST) -> List[str]:
     return []
 
 
+def _rule_table_specs(ctx: FileContext, spec_names: set) -> Dict[int, str]:
+    """Map id(PartitionSpec call) -> regex pattern for every spec that
+    sits in a `match_partition_rules`-style table: a tuple/list whose
+    entries are ("pattern", P(...)) pairs. RL023 cites the owning rule
+    pattern in its findings so a hit inside a 30-row table is
+    attributable without counting lines."""
+    owners: Dict[int, str] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            continue
+        for entry in node.elts:
+            if not (isinstance(entry, ast.Tuple) and len(entry.elts) == 2):
+                continue
+            pattern, spec = entry.elts
+            if isinstance(pattern, ast.Constant) \
+                    and isinstance(pattern.value, str) \
+                    and isinstance(spec, ast.Call) \
+                    and last_segment(dotted(spec.func)) in spec_names:
+                owners[id(spec)] = pattern.value
+    return owners
+
+
 def jax_extract(ctx: FileContext) -> dict:
     """JSON-serializable mesh/spec extract for the project graph."""
     out = {"mesh_axes": [], "specs": []}
-    if "jax" not in ctx.source and "PartitionSpec" not in ctx.source:
+    if "jax" not in ctx.source and "PartitionSpec" not in ctx.source \
+            and "ShardSpec" not in ctx.source:
         return out
     spec_names = _spec_aliases(ctx)
+    rule_owners = _rule_table_specs(ctx, spec_names)
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -834,11 +861,27 @@ def jax_extract(ctx: FileContext) -> dict:
             if axes:
                 out["mesh_axes"].append(
                     {"axes": axes, "line": node.lineno})
-        elif seg == "MeshSpec" and node.args and \
-                isinstance(node.args[0], ast.Dict):
-            axes = [k.value for k in node.args[0].keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)]
+        elif seg == "MeshSpec":
+            axes_node = node.args[0] if node.args else _kwarg(node, "axes")
+            if isinstance(axes_node, ast.Dict):
+                axes = [k.value for k in axes_node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if axes:
+                    out["mesh_axes"].append(
+                        {"axes": axes, "line": node.lineno})
+        elif seg == "ShardSpec":
+            # Multi-axis gang spec: tp=/pp=/sp= kwargs declare the
+            # stage-mesh axes. A literal 1 is dropped (size-1 axes never
+            # reach the mesh); a non-literal size MAY be > 1, so the
+            # axis counts as declared — RL023 must not flag specs
+            # against a width only known at runtime.
+            axes = []
+            for kw in node.keywords:
+                if kw.arg in _SHARDSPEC_AXIS_KWARGS and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value == 1):
+                    axes.append(kw.arg)
             if axes:
                 out["mesh_axes"].append(
                     {"axes": axes, "line": node.lineno})
@@ -864,7 +907,11 @@ def jax_extract(ctx: FileContext) -> dict:
                     literal = False
             if not node.args:
                 continue                   # P(): fully replicated, fine
-            out["specs"].append({
+            spec = {
                 "dims": dims, "line": node.lineno, "literal": literal,
-                "trailing_none": dims[-1] is None})
+                "trailing_none": dims[-1] is None}
+            rule = rule_owners.get(id(node))
+            if rule is not None:
+                spec["rule"] = rule
+            out["specs"].append(spec)
     return out

@@ -422,19 +422,21 @@ def rl023_sharding_spec_hygiene(graph) -> Iterable[Finding]:
         if not declared:
             continue                       # no mesh in the tree: nothing
             # to check axes against (fixture files)
+        where = (f" (partition rule {s['rule']!r})"
+                 if s.get("rule") else "")
         for dim in s["dims"]:
             axes = dim if isinstance(dim, list) else [dim]
             for a in axes:
                 if isinstance(a, str) and a != "?" and a not in declared:
                     yield Finding(
                         s["file"], s["line"], "RL023",
-                        f"PartitionSpec names mesh axis '{a}' but no "
-                        "mesh in the package declares it (declared: "
-                        f"{', '.join(sorted(declared))}) — placement "
-                        "fails at runtime with an unknown-axis error, "
-                        "or silently replicates if the spec is "
-                        "filtered; fix the axis name or declare the "
-                        "mesh")
+                        f"PartitionSpec{where} names mesh axis '{a}' "
+                        "but no mesh in the package declares it "
+                        f"(declared: {', '.join(sorted(declared))}) — "
+                        "placement fails at runtime with an "
+                        "unknown-axis error, or silently replicates if "
+                        "the spec is filtered; fix the axis name or "
+                        "declare the mesh")
 
 
 # =====================================================================

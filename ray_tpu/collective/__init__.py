@@ -75,6 +75,18 @@ def barrier(group_name: str = "default") -> None:
     get_group(group_name).barrier()
 
 
+def send(value: Any, dst: int, group_name: str = "default",
+         tag: str = "p2p") -> None:
+    """Point-to-point post to `dst` (ordered per (src, dst, tag)
+    channel; outside the bulk-synchronous collective op sequence)."""
+    get_group(group_name).send(value, dst, tag=tag)
+
+
+def recv(src: int, group_name: str = "default", tag: str = "p2p"):
+    """Blocking take of the next message `src` sent on `tag`."""
+    return get_group(group_name).recv(src, tag=tag)
+
+
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
         group = _groups.pop(group_name, None)

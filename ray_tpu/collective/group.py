@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
@@ -326,6 +327,190 @@ class CollectiveGroup:
     def _take_value(self, key: str) -> Any:
         return serialization.loads(bytes(self._take(key)))
 
+    # ------------------------------------------------- point-to-point
+
+    # P2P rides the same mailbox as the collectives but OUTSIDE the
+    # bulk-synchronous op sequence: each (src, dst, tag) channel numbers
+    # its own messages, so a pipeline stage pair can stream activations
+    # while the group's collectives (barrier at a checkpoint, a grad
+    # allreduce) interleave freely — the key namespaces never collide.
+    # Object lifetime cannot ride the free-on-next-op rule (there is no
+    # group barrier between p2p messages): object-path sends stay held
+    # until the receiver's windowed drain ack
+    # (collective_p2p_ack_window), inline sends hold nothing.
+
+    def _p2p_state(self):
+        if not hasattr(self, "_p2p_lock"):
+            self._p2p_lock = threading.Lock()
+            self._p2p_cv = threading.Condition(self._p2p_lock)
+            self._p2p_send_seq: Dict[tuple, int] = {}
+            self._p2p_recv_seq: Dict[tuple, int] = {}
+            # (dst, tag) -> next seq allowed to POST on the channel
+            self._p2p_post_turn: Dict[tuple, int] = {}
+            # (dst, tag) -> [(seq, oid)] object-path sends not yet acked
+            self._p2p_pending: Dict[tuple, List] = {}
+
+    def _p2p_reserve(self, dst: int, tag: str) -> int:
+        """Claim the next seq on the (self, dst, tag) channel. Done in
+        the CALLER's thread (send and isend both) so message order on a
+        channel is the order of the send calls, never the scheduling of
+        isend's background threads."""
+        if self._broken is not None:
+            raise self._broken
+        if not 0 <= dst < self.world_size or dst == self.rank:
+            raise ValueError(f"bad p2p destination {dst} "
+                             f"(rank {self.rank} of {self.world_size})")
+        self._p2p_state()
+        chan = (dst, tag)
+        with self._p2p_lock:
+            self._p2p_send_seq[chan] = seq = \
+                self._p2p_send_seq.get(chan, 0) + 1
+        return seq
+
+    def send(self, value: Any, dst: int, tag: str = "p2p") -> None:
+        """Post one message to `dst` on channel `tag` (any picklable
+        pytree). Returns once the payload is visible to the receiver;
+        blocks only when the per-peer ack window is full (receiver more
+        than `collective_p2p_ack_window` object-path messages behind)."""
+        self._send_seq(value, dst, tag, self._p2p_reserve(dst, tag))
+
+    def _send_seq(self, value: Any, dst: int, tag: str, seq: int) -> None:
+        chan = (dst, tag)
+        window = max(1, GLOBAL_CONFIG.collective_p2p_ack_window)
+        key = f"p2p:{self.rank}>{dst}:{tag}:{seq}"
+        # Serialize + store-write FIRST, unordered: this is the bulk of
+        # an isend and overlaps fine across racing background threads.
+        blob = serialization.dumps_ctrl(value)
+        oid = None
+        if len(blob) <= GLOBAL_CONFIG.collective_inline_max_bytes:
+            payload = {"k": "i", "v": bytes(blob)}
+        else:
+            oid = self.transport.put_bytes([blob])
+            payload = {"k": "o", "v": oid.binary()}
+        # POSTS must leave in seq order. Not for delivery (the receiver
+        # takes by seq key) but for the ack window: if seq k posts while
+        # seq k-1 is still parked in the window drain below, a thread
+        # can block on the drain ack of a LATER message than the
+        # receiver — who drains strictly in order — can ever reach, and
+        # the channel deadlocks (isend threads race; seen in tests).
+        deadline = time.monotonic() + self._stall
+        with self._p2p_cv:
+            while self._p2p_post_turn.get(chan, 1) != seq:
+                if not self._p2p_cv.wait(deadline - time.monotonic()):
+                    raise self._abort_from_state(
+                        f"isend turn {key}",
+                        TimeoutError(f"post turn for seq {seq} never came "
+                                     f"(channel head still "
+                                     f"{self._p2p_post_turn.get(chan, 1)})"))
+        try:
+            # Window drain: free the oldest in-flight payload once the
+            # receiver acks having drained it. The blocking ack take
+            # runs OUTSIDE the p2p lock — a stage thread parked here
+            # must not wedge the same handle's recv of the opposite-
+            # direction channel (1F1B sends activations forward while
+            # grads stream back).
+            while True:
+                with self._p2p_lock:
+                    pending = self._p2p_pending.setdefault(chan, [])
+                    if len(pending) < window:
+                        if oid is not None:
+                            pending.append((seq, oid))
+                        break
+                    old_seq, old_oid = pending.pop(0)
+                self._take(f"p2pa:{self.rank}>{dst}:{tag}:{old_seq}")
+                self.transport.free([old_oid])
+            with self._op_span("collective.send", seq, dst=dst, tag=tag,
+                               nbytes=len(blob)):
+                self._call("collective_post",
+                           {"key": key, "value": payload, "consumers": 1},
+                           f"send {key}", self._stall)
+        finally:
+            # Always hand the turn on — a failed post must not hang the
+            # channel's later sends on the condition (they surface their
+            # own errors against the now-broken group).
+            with self._p2p_cv:
+                self._p2p_post_turn[chan] = seq + 1
+                self._p2p_cv.notify_all()
+
+    def isend(self, value: Any, dst: int, tag: str = "p2p"):
+        """`send` posted on a background thread so the store write + GCS
+        round trip overlap the caller's compute (the 1F1B steady state
+        posts each stage boundary while the next microbatch runs). The
+        channel seq is reserved HERE, in the caller — two isends on one
+        channel deliver in call order even when their threads race.
+        Returns a handle; `.wait()` joins and re-raises any send error."""
+        seq = self._p2p_reserve(dst, tag)
+        err: List[BaseException] = []
+
+        def run():
+            try:
+                self._send_seq(value, dst, tag, seq)
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait
+                err.append(e)
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+
+        class _Handle:
+            def wait(self, timeout: Optional[float] = None):
+                thread.join(timeout)
+                if err:
+                    raise err[0]
+                if thread.is_alive():
+                    raise TimeoutError(f"isend to {dst} still in flight")
+
+        return _Handle()
+
+    def recv(self, src: int, tag: str = "p2p") -> Any:
+        """Take the next message from `src` on channel `tag` (blocking,
+        `collective_stall_timeout_s` abort horizon). Messages on one
+        channel arrive in send order; object payloads are drained and
+        acked so the sender's window can advance."""
+        if self._broken is not None:
+            raise self._broken
+        if not 0 <= src < self.world_size or src == self.rank:
+            raise ValueError(f"bad p2p source {src} "
+                             f"(rank {self.rank} of {self.world_size})")
+        self._p2p_state()
+        chan = (src, tag)
+        with self._p2p_lock:
+            self._p2p_recv_seq[chan] = seq = \
+                self._p2p_recv_seq.get(chan, 0) + 1
+        key = f"p2p:{src}>{self.rank}:{tag}:{seq}"
+        with self._op_span("collective.recv", seq, src=src, tag=tag):
+            resp = self._call("collective_take", {"key": key},
+                              f"recv {key}", self._stall)
+            value = resp["value"]
+            if value["k"] == "i":
+                return serialization.loads(bytes(value["v"]))
+            oid = ObjectID(value["v"])
+            try:
+                view = self.transport.get_bytes(oid, self._stall)
+            except (GetTimeoutError, ObjectLostError, RaySystemError) as e:
+                raise self._abort_from_state(f"pull of {key}", e)
+            out = serialization.loads(bytes(view))
+            self.transport.release([oid])
+            # Drain ack: the sender frees this payload and advances its
+            # window once it takes this.
+            self._call("collective_post",
+                       {"key": f"p2pa:{src}>{self.rank}:{tag}:{seq}",
+                        "value": {"k": "i", "v": b"1"}, "consumers": 1},
+                       f"ack {key}", self._stall)
+            return out
+
+    def _release_p2p(self):
+        if not hasattr(self, "_p2p_lock"):
+            return
+        with self._p2p_lock:
+            pending = [oid for chan in self._p2p_pending.values()
+                       for _, oid in chan]
+            self._p2p_pending.clear()
+        if pending:
+            try:
+                self.transport.free(pending)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
     # ------------------------------------------------------------- the ops
 
     def allreduce(self, value: Any, op: str = "sum") -> Any:
@@ -502,6 +687,7 @@ class CollectiveGroup:
             pass
 
     def _release_objects(self):
+        self._release_p2p()
         taken, self._taken = self._taken, []
         if taken:
             self.transport.release(taken)

@@ -229,6 +229,12 @@ _flag("collective_stall_timeout_s", float, 60.0,
 _flag("collective_inline_max_bytes", int, 64 * 1024,
       "Collective payloads at or below this size ride the GCS mailbox "
       "inline instead of the object-transfer plane")
+_flag("collective_p2p_ack_window", int, 8,
+      "Point-to-point flow control: object-path sends to one peer kept "
+      "in flight before the sender blocks on the receiver's drain ack "
+      "and frees the oldest payload. Bounds store bytes a pipeline "
+      "stage pair can pin at (window x activation size); inline "
+      "payloads (<= collective_inline_max_bytes) never ack")
 _flag("collective_ring_min_bytes", int, 256 * 1024,
       "Flat buffers below this total size allreduce via direct fan-in "
       "(latency-bound regime); at or above, the bandwidth-optimal ring "

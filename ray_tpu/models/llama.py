@@ -48,6 +48,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     use_flash: bool = True
     remat: bool = False
+    # Sequence parallelism: a mesh with an "sp" axis routes the training
+    # forward's attention through the ring (ops/ring_attention) — each
+    # device holds a sequence shard, K/V rotate over ppermute. None (or
+    # a mesh without "sp") keeps the flash/reference path.
+    sp_mesh: Any = None
 
     @staticmethod
     def llama7b() -> "LlamaConfig":
@@ -159,7 +164,15 @@ class LlamaBlock(nn.Module):
         if cache is None:
             kf = jnp.repeat(k, groups, axis=1)
             vf = jnp.repeat(v, groups, axis=1)
-            if cfg.use_flash:
+            if cfg.sp_mesh is not None:
+                from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+                # GQA repeat happens BEFORE the ring so every sequence
+                # shard rotates full-head K/V chunks — same tensors the
+                # flash path sees, so sp on/off is a pure schedule change.
+                attn = ring_attention_sharded(q, kf, vf, cfg.sp_mesh,
+                                              causal=True)
+            elif cfg.use_flash:
                 attn = flash_attention(q, kf, vf, True)
             else:
                 attn = mha_reference(q, kf, vf, causal=True)
@@ -352,6 +365,140 @@ class Llama(nn.Module):
             x = x + side_sum.astype(x.dtype)
         x = self.final_norm(x)
         return self.lm_head(x), new_arenas
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline stages: the model partitioned by layer for cross-process pp
+# --------------------------------------------------------------------------- #
+
+
+def stage_layer_ranges(cfg: LlamaConfig, pp: int):
+    """[start, end) layer range per pipeline stage: near-even split, the
+    remainder to the EARLIER stages (the last stage already carries the
+    final norm + vocab-wide lm_head matmul)."""
+    if not 1 <= pp <= cfg.n_layer:
+        raise ValueError(f"pp={pp} must be in [1, n_layer={cfg.n_layer}]")
+    base, rem = divmod(cfg.n_layer, pp)
+    ranges, start = [], 0
+    for s in range(pp):
+        end = start + base + (1 if s < rem else 0)
+        ranges.append((start, end))
+        start = end
+    return ranges
+
+
+class LlamaStage(nn.Module):
+    """One pipeline stage of :class:`Llama`: stage 0 owns the embedding
+    + its layer range, the last stage its range + final norm + lm_head.
+    Param names match the monolithic model exactly (``layer_{i}`` keeps
+    the GLOBAL layer index), so a full checkpoint splits into stage
+    trees — and re-groups across pp widths — by top-level key alone."""
+
+    cfg: LlamaConfig
+    stage: int
+    pp: int
+
+    def setup(self):
+        cfg = self.cfg
+        start, end = stage_layer_ranges(cfg, self.pp)[self.stage]
+        if self.stage == 0:
+            self.embed = self.param(
+                "embed",
+                nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                             ("vocab", "embed")),
+                (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        self.blocks = [block(cfg, name=f"layer_{i}")
+                       for i in range(start, end)]
+        if self.stage == self.pp - 1:
+            self.final_norm = RMSNorm(cfg, name="final_norm")
+            self.lm_head = _dense(cfg.vocab_size, ("embed", "vocab"), cfg,
+                                  "lm_head")
+
+    def __call__(self, x):
+        """Stage 0 takes token ids [b, s]; later stages take the
+        previous stage's activations [b, s, embd]. The last stage
+        returns logits, every other stage its boundary activations."""
+        cfg = self.cfg
+        if self.stage == 0:
+            x = self.embed.astype(cfg.dtype)[x]
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        positions = jnp.arange(x.shape[1])
+        for blk in self.blocks:
+            x, _, _ = blk(x, positions)
+        if self.stage == self.pp - 1:
+            x = self.final_norm(x)
+            logits = self.lm_head(x)
+            return nn.with_logical_constraint(logits,
+                                              ("batch", "seq", "vocab"))
+        return x
+
+
+def split_stage_params(params, cfg: LlamaConfig, pp: int):
+    """Full param dict (``embed``/``layer_i``/``final_norm``/``lm_head``
+    at top level) -> one per-stage dict per stage. Pure re-grouping:
+    leaves are shared, never copied, and keys keep their global names —
+    the inverse of :func:`merge_stage_params` at ANY pp width."""
+    inner = params.get("params", params) if isinstance(params, dict) \
+        else params
+    out = []
+    for s, (start, end) in enumerate(stage_layer_ranges(cfg, pp)):
+        tree = {}
+        if s == 0:
+            tree["embed"] = inner["embed"]
+        for i in range(start, end):
+            tree[f"layer_{i}"] = inner[f"layer_{i}"]
+        if s == pp - 1:
+            tree["final_norm"] = inner["final_norm"]
+            tree["lm_head"] = inner["lm_head"]
+        out.append(tree)
+    return out
+
+
+def merge_stage_params(stage_trees):
+    """Union of per-stage param dicts back into the full model tree
+    (global key names make this a plain dict merge)."""
+    out = {}
+    for tree in stage_trees:
+        dup = set(out) & set(tree)
+        if dup:
+            raise ValueError(f"stage trees overlap on {sorted(dup)} — "
+                             "these are not disjoint stage splits")
+        out.update(tree)
+    return out
+
+
+def _partition_rules():
+    """The ``match_partition_rules`` regex table for llama params over a
+    ("sp", "tp") stage mesh: column-parallel qkv/gate/up (output dim over
+    tp), row-parallel wo/w_down (input dim over tp), vocab-sharded embed
+    and lm_head, replicated norms. One table serves every stage subtree
+    at every (tp, pp) width — rule paths are global param names."""
+    from jax.sharding import PartitionSpec
+
+    return (
+        (r"embed$", PartitionSpec("tp")),
+        (r"(wq|wk|wv)/kernel$", PartitionSpec(None, "tp")),
+        (r"wo/kernel$", PartitionSpec("tp")),
+        (r"(w_gate|w_up)/kernel$", PartitionSpec(None, "tp")),
+        (r"w_down/kernel$", PartitionSpec("tp")),
+        (r"lm_head/kernel$", PartitionSpec(None, "tp")),
+        (r"(attn_norm|mlp_norm|final_norm)/scale$", PartitionSpec()),
+    )
+
+
+LLAMA_PARTITION_RULES = _partition_rules()
+
+
+def shard_stage_params(stage_tree, mesh):
+    """Place one stage's param subtree on its ("sp", "tp") stage mesh
+    via the rule table (axes absent from the mesh prune to replicated,
+    so tp=1 stage meshes work unchanged)."""
+    from ray_tpu.parallel.sharding import shard_params_by_rules
+
+    return shard_params_by_rules(stage_tree, mesh, LLAMA_PARTITION_RULES)
 
 
 def make_paged_arena(cfg: LlamaConfig, num_blocks: int, block_size: int,

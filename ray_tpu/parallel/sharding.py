@@ -114,6 +114,77 @@ def shard_params(params, mesh, param_logical_axes,
                         is_leaf=lambda x: x is None)
 
 
+def match_partition_rules(rules, params):
+    """Map every param leaf to a PartitionSpec by regex over its
+    "/"-joined tree path (the t5x/EasyLM idiom, the complement of the
+    logical-axis rules above for trees whose modules carry no
+    annotations — e.g. a checkpoint-restored stage subtree).
+
+    `rules` is an ordered sequence of (regex, PartitionSpec); the FIRST
+    pattern that `re.search`-matches a leaf's path wins. Scalars (ndim
+    0) always replicate. A leaf no rule matches raises — a silent
+    fall-through to replicated would quietly undo tp sharding on a
+    renamed param."""
+    import re
+
+    import jax
+    from jax.sharding import PartitionSpec
+
+    def assign(path, leaf):
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "name"):
+                # flax boxed params (LogicallyPartitioned etc.) insert a
+                # GetAttrKey('value') hop — transparent to rule paths,
+                # so "embed$" matches boxed and unboxed trees alike.
+                if k.name != "value":
+                    parts.append(str(k.name))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        name = "/".join(parts)
+        if getattr(leaf, "ndim", 0) == 0:
+            return PartitionSpec()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(f"no partition rule matches param '{name}' — "
+                         "add a rule (or an explicit catch-all) so the "
+                         "placement stays deliberate")
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def shard_params_by_rules(params, mesh, rules):
+    """device_put a param pytree into the layout `rules` assigns on
+    `mesh` (specs whose axes the mesh lacks are pruned to replicated on
+    those dims, so one rule table serves every (tp, sp) submesh)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    specs = match_partition_rules(rules, params)
+
+    def place(leaf, spec):
+        dims = []
+        for dim in spec:
+            axes = dim if isinstance(dim, tuple) else (dim,)
+            kept = tuple(a for a in axes
+                         if a is None or a in mesh.axis_names)
+            kept = tuple(a for a in kept if a is not None)
+            dims.append(kept if len(kept) > 1
+                        else (kept[0] if kept else None))
+        while dims and dims[-1] is None:
+            dims.pop()                     # no trailing None (RL023)
+        return jax.device_put(leaf, NamedSharding(mesh,
+                                                  PartitionSpec(*dims)))
+
+    return jax.tree.map(place, params, specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
 def replicated(mesh):
     import jax
 

@@ -176,6 +176,7 @@ def create_replica_group(
     def rank_args(rank: int):
         ctx = {"group_id": group_id, "rank": rank,
                "world_size": spec.world_size, "tp": spec.tp,
+               "pp": spec.pp, "sp": spec.sp,
                "spmd": spec.world_size > 1}
         return ((deployment_name, user_cls, init_args, init_kwargs or {},
                  f"{group_id}#r{rank}"), {"shard_ctx": ctx})

@@ -44,15 +44,24 @@ class ShardContext:
     world_size: int
     tp: int
     spmd: bool             # cross-process XLA active (jax.distributed)
+    pp: int = 1            # pipeline stages (ranks, not mesh columns)
+    sp: int = 1            # sequence-parallel mesh axis width
 
     @property
     def is_coordinator(self) -> bool:
         return self.rank == 0
 
+    @property
+    def stage(self) -> int:
+        """This rank's pipeline stage (ranks are laid out stage-major:
+        rank // ranks_per_stage)."""
+        per = max(1, self.world_size // max(1, self.pp))
+        return self.rank // per
+
     def as_dict(self) -> Dict[str, Any]:
         return {"group_id": self.group_id, "rank": self.rank,
                 "world_size": self.world_size, "tp": self.tp,
-                "spmd": self.spmd}
+                "pp": self.pp, "sp": self.sp, "spmd": self.spmd}
 
 
 _current: Optional[ShardContext] = None
@@ -153,19 +162,27 @@ def activate(ctx: Any, rendezvous_timeout_s: float = 30.0) -> ShardContext:
         publish_coordinator(ctx.group_id, "local")
 
     ctx = ShardContext(group_id=ctx.group_id, rank=ctx.rank,
-                       world_size=ctx.world_size, tp=ctx.tp, spmd=spmd)
-    _mesh = _build_tp_mesh(ctx)
+                       world_size=ctx.world_size, tp=ctx.tp, spmd=spmd,
+                       pp=ctx.pp, sp=ctx.sp)
+    _mesh = _build_stage_mesh(ctx)
     _current = ctx
-    logger.info("shardgroup: rank %d/%d of %s active (tp=%d, spmd=%s)",
-                ctx.rank, ctx.world_size, ctx.group_id, ctx.tp, spmd)
+    logger.info("shardgroup: rank %d/%d of %s active (tp=%d, pp=%d, "
+                "sp=%d, spmd=%s)", ctx.rank, ctx.world_size, ctx.group_id,
+                ctx.tp, ctx.pp, ctx.sp, spmd)
     return ctx
 
 
-def _build_tp_mesh(ctx: ShardContext):
-    """The gang's mesh: a single "tp" axis over the first `tp` (global)
-    devices. Every rank of an SPMD gang computes the identical mesh —
-    `jax.devices()` is globally ordered after `jax.distributed` init."""
-    if ctx.tp <= 1:
+def _build_stage_mesh(ctx: ShardContext):
+    """The gang's per-stage device mesh: ("sp", "tp") axes over the
+    first `sp*tp` (global) devices — "pp" is realized as stage PROCESSES
+    exchanging activations over the collective plane, never as an
+    in-program mesh axis. Every rank of an SPMD gang computes the
+    identical mesh — `jax.devices()` is globally ordered after
+    `jax.distributed` init. Size-1 axes are dropped (a tp-only gang gets
+    the same single-axis mesh as before)."""
+    axes = {name: size for name, size in (("sp", ctx.sp), ("tp", ctx.tp))
+            if size > 1}
+    if not axes:
         return None
     import jax
 
@@ -174,14 +191,27 @@ def _build_tp_mesh(ctx: ShardContext):
 
     apply_jax_platform_env()
     devices = jax.devices()
-    want = ctx.tp if ctx.spmd or ctx.world_size == 1 else min(
-        ctx.tp, len(devices))
-    if len(devices) < want:
+    need = 1
+    for size in axes.values():
+        need *= size
+    if not (ctx.spmd or ctx.world_size == 1) and len(devices) < need:
+        # CPU degraded mode: shrink the tp axis to what this process can
+        # see (sp must fit — ring attention cannot run on a partial ring).
+        tp_fit = max(1, len(devices) // max(1, ctx.sp))
+        axes = {name: (min(size, tp_fit) if name == "tp" else size)
+                for name, size in axes.items()}
+        axes = {name: size for name, size in axes.items() if size > 1}
+        if not axes:
+            return None
+        need = 1
+        for size in axes.values():
+            need *= size
+    if len(devices) < need:
         raise RuntimeError(
-            f"shard group {ctx.group_id}: tp={ctx.tp} needs {want} "
+            f"shard group {ctx.group_id}: mesh axes {axes} need {need} "
             f"devices, only {len(devices)} visible (set "
             "--xla_force_host_platform_device_count on CPU)")
-    return build_mesh(MeshSpec({"tp": want}), devices=devices[:want])
+    return build_mesh(MeshSpec(axes), devices=devices[:need])
 
 
 def current() -> Optional[ShardContext]:

@@ -45,6 +45,14 @@ class ShardSpec:
     tp          tensor-parallel width: the size of the mesh's "tp" axis
                 (attention heads / MLP hidden / vocab shard over it, the
                 paged KV arena shards its kv-head dim with it).
+    pp          pipeline-parallel width: the number of STAGE ranks of a
+                pipelined train gang. Stages are whole processes (one
+                rank per stage), activations/grads move over the host
+                collective plane — so pp multiplies world_size rather
+                than the per-rank device mesh.
+    sp          sequence-parallel width: the size of the mesh's "sp"
+                axis (ring attention shards the sequence dim over it
+                for long contexts).
     world_size  number of rank ACTORS (processes/hosts) in the gang.
     strategy    placement-group strategy for the gang's bundles
                 ("PACK" for single-host tests, "STRICT_SPREAD" for one
@@ -57,21 +65,54 @@ class ShardSpec:
     world_size: int = 1
     strategy: str = "PACK"
     bundle: Dict[str, float] = field(default_factory=dict)
+    pp: int = 1
+    sp: int = 1
 
     def __post_init__(self):
-        if self.tp < 1 or self.world_size < 1:
+        if self.tp < 1 or self.world_size < 1 or self.pp < 1 or self.sp < 1:
             raise ValueError(
-                f"ShardSpec needs tp >= 1 and world_size >= 1, got "
-                f"tp={self.tp} world_size={self.world_size}")
-        if self.tp > 1 and self.tp % self.world_size:
+                f"ShardSpec needs tp/pp/sp >= 1 and world_size >= 1, got "
+                f"tp={self.tp} pp={self.pp} sp={self.sp} "
+                f"world_size={self.world_size}")
+        if self.pp > 1 and self.world_size % self.pp:
             raise ValueError(
-                f"tp={self.tp} must be divisible by world_size="
-                f"{self.world_size} (every rank hosts tp/world_size "
-                "contiguous mesh columns)")
+                f"pp={self.pp} must divide world_size={self.world_size} "
+                "(each pipeline stage is a contiguous block of ranks)")
+        if self.tp > 1 and self.tp % self.ranks_per_stage:
+            raise ValueError(
+                f"tp={self.tp} must be divisible by the "
+                f"{self.ranks_per_stage} ranks of each stage (every rank "
+                "hosts tp/ranks contiguous mesh columns)")
+
+    @property
+    def ranks_per_stage(self) -> int:
+        return max(1, self.world_size // self.pp)
 
     @property
     def tp_per_rank(self) -> int:
-        return max(1, self.tp // self.world_size)
+        return max(1, self.tp // self.ranks_per_stage)
+
+    def mesh_axes(self) -> Dict[str, int]:
+        """The logical device grid this spec spans, as MeshSpec axes
+        (size-1 axes dropped; ("pp", "sp", "tp") in AXIS_ORDER). On a
+        real multi-host bring-up this is the global mesh; on the CPU
+        backend each stage rank builds :meth:`stage_mesh_axes` locally
+        and "pp" lives across processes, not inside the mesh."""
+        return {name: size
+                for name, size in (("pp", self.pp), ("sp", self.sp),
+                                   ("tp", self.tp))
+                if size > 1}
+
+    def stage_mesh_axes(self) -> Dict[str, int]:
+        """The per-stage device mesh: ("sp", "tp") only — the pp axis is
+        realized as separate stage processes exchanging activations over
+        the collective plane, never as an in-program mesh axis."""
+        return {name: size for name, size in (("sp", self.sp),
+                                              ("tp", self.tp)) if size > 1}
+
+    @property
+    def devices_per_stage(self) -> int:
+        return self.sp * self.tp
 
     def rank_bundle(self, actor_options: Optional[Dict] = None
                     ) -> Dict[str, float]:

@@ -261,14 +261,24 @@ def _sanitize(key: str) -> str:
 
 def save_sharded_pytree(path: str, tree: Any, process_index: int = 0,
                         process_count: int = 1,
-                        meta: Optional[Dict[str, Any]] = None) -> str:
+                        meta: Optional[Dict[str, Any]] = None,
+                        own_replicated: Optional[bool] = None) -> str:
     """Save this process's shards of `tree` under `path`. Single-process
     saves are complete immediately; multi-process saves need every rank
     to call this, then rank 0 to call `merge_sharded_manifest` (after a
-    barrier) to write the unified index."""
+    barrier) to write the unified index.
+
+    `own_replicated` controls who writes fully-replicated (and plain
+    host) leaves. Default (None -> rank 0 only) fits SPMD saves where
+    every rank holds the same tree. Pipeline-stage saves hold DISJOINT
+    subtrees per rank — no other rank has this rank's keys — so they
+    pass True and each rank writes its own replicated leaves; the merge
+    dedupes any key two ranks both wrote by shard index, so mixed modes
+    stay safe."""
     import jax
     import numpy as np
 
+    owns = process_index == 0 if own_replicated is None else own_replicated
     os.makedirs(path, exist_ok=True)
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     entries: Dict[str, Any] = {}
@@ -281,8 +291,8 @@ def save_sharded_pytree(path: str, tree: Any, process_index: int = 0,
             dtype = arr.dtype.name
             seen = set()
             fully_replicated = arr.sharding.is_fully_replicated
-            if fully_replicated and process_index != 0:
-                # Every rank holds the whole value; rank 0's copy wins.
+            if fully_replicated and not owns:
+                # Every rank holds the whole value; the owner's copy wins.
                 entries[key] = {"shape": list(shape), "dtype": dtype,
                                 "shards": []}
                 continue
@@ -301,8 +311,8 @@ def save_sharded_pytree(path: str, tree: Any, process_index: int = 0,
         else:
             data = np.ascontiguousarray(np.asarray(leaf))
             shape, dtype = tuple(data.shape), data.dtype.name
-            if process_index == 0:
-                fname = f"{_sanitize(key)}.p0.s0.bin"
+            if owns:
+                fname = f"{_sanitize(key)}.p{process_index}.s0.bin"
                 with open(os.path.join(path, fname), "wb") as f:
                     f.write(data.tobytes())
                 shards.append({"file": fname,

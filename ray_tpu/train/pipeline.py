@@ -1,0 +1,770 @@
+"""Pipeline-parallel training: stage programs, 1F1B schedule, transports.
+
+The multi-axis training fast path (ISSUE 20). A llama is partitioned
+into `pp` stage submodules (`ray_tpu.models.llama.LlamaStage`) and each
+stage compiles THREE small programs instead of one monolithic step:
+
+- `fwd(params, x) -> y` — forward to the stage boundary;
+- `bwd(params, x, gy) -> (gparams[, gx])` — VJP with recompute-in-
+  backward (the forward is re-traced INSIDE the backward jit, so no
+  residual tensors cross the stage boundary — only activations forward
+  and activation-grads backward);
+- the LAST stage fuses loss forward + backward into one
+  `fwdbwd(params, x, targets) -> (loss, gparams[, gx])`.
+
+Composition is bitwise-exact in f32: splitting the model across jit
+boundaries and chaining per-stage VJPs reproduces the monolithic
+`jax.value_and_grad` bit for bit (tests/test_train_pipeline.py proves
+it), so a pipeline run IS the single-chip run, reordered.
+
+Two schedules drive the stages over `m` microbatches:
+
+- `"1f1b"` — one-forward-one-backward: stage `s` runs
+  `min(pp - 1 - s, m)` warmup forwards, then alternates fwd/bwd in the
+  steady state, then drains. Analytic bubble `(pp-1)/(m+pp-1)`.
+- `"sequential"` — each microbatch round-trips the whole pipe before
+  the next starts (the A/B baseline: same arithmetic, maximal bubble).
+
+Both accumulate gradients in MICROBATCH order on every stage, so their
+results are bitwise-identical — the schedule changes only the overlap.
+
+Stage boundaries move over a transport: `LocalPipeTransport` (queues,
+one process, threads — the test/bench harness) or
+`CollectivePipeTransport` (the collective plane's p2p send/recv — one
+worker process per stage, posts overlapped via `isend` on background
+threads). Per-stage busy/wall accounting reports the measured
+`bubble_frac` next to the analytic bound.
+
+`make_pipeline_train_fn` packages the whole thing as a
+`train_loop_per_worker` for a WorkerGroup run: world_size == pp, each
+rank drives one stage, every step checkpoints the stage's disjoint
+subtree (`save_sharded_pytree(own_replicated=True)`), and a gang
+restart at a DIFFERENT world size restores bit-exact from the merged
+manifest at the new (tp, pp) width — elastic resharded training.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "StagePrograms",
+    "StageStats",
+    "StageRunResult",
+    "LocalPipeTransport",
+    "CollectivePipeTransport",
+    "token_xent",
+    "tiny_pipeline_config",
+    "build_stage_programs",
+    "split_microbatches",
+    "seeded_batch",
+    "run_stage",
+    "run_pipeline_step",
+    "LocalPipelineTrainer",
+    "analytic_bubble",
+    "stage_state_template",
+    "save_pipeline_stage",
+    "restore_pipeline_stage",
+    "make_pipeline_train_fn",
+]
+
+SCHEDULES = ("1f1b", "sequential")
+
+
+def token_xent(logits, targets):
+    """Mean next-token cross entropy (log-softmax in f32)."""
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def tiny_pipeline_config(**overrides):
+    """f32 toy llama for parity tests/benches: f32 end to end because
+    bf16 breaks the bitwise stage-composition guarantee (cross-boundary
+    fusion changes rounding); big enough for 2 stages."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    kw = dict(vocab_size=64, n_embd=32, n_layer=2, n_head=4, n_kv_head=2,
+              intermediate=64, n_positions=64, dtype=jnp.float32,
+              param_dtype=jnp.float32, use_flash=False)
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def analytic_bubble(pp: int, m: int) -> float:
+    """Ideal 1F1B pipeline bubble fraction: (p-1)/(m+p-1)."""
+    return (pp - 1) / (m + pp - 1) if pp > 1 else 0.0
+
+
+# --------------------------------------------------------------------------- #
+# Stage programs
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class StagePrograms:
+    """The jitted programs one pipeline stage runs.
+
+    Exactly one of {fwd+bwd, fwdbwd} is populated per position: non-last
+    stages get the split pair, the last stage gets the fused
+    loss-forward+backward (pp == 1 is first AND last: a single fused
+    program over the whole model). `accum`/`scale` are the shared
+    microbatch gradient-accumulation jits — leafwise, so the SAME
+    arithmetic lands on every (tp, pp) regrouping of the tree."""
+
+    cfg: Any
+    stage: int
+    pp: int
+    module: Any
+    fwd: Optional[Callable] = None
+    bwd: Optional[Callable] = None
+    fwdbwd: Optional[Callable] = None
+    accum: Callable = None
+    scale: Callable = None
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage == self.pp - 1
+
+    def compile_counters(self) -> Dict[str, Any]:
+        """Named jitted fns for tests/conftest.assert_compiles_once —
+        the zero-per-step-recompile acceptance check."""
+        out = {}
+        for name in ("fwd", "bwd", "fwdbwd", "accum", "scale"):
+            fn = getattr(self, name)
+            if fn is not None:
+                out[f"s{self.stage}.{name}"] = fn
+        return out
+
+
+def build_stage_programs(cfg, stage: int, pp: int) -> StagePrograms:
+    """Compile-on-first-call programs for `stage` of a `pp`-deep llama
+    pipeline. Recompute-in-backward: `bwd`/`fwdbwd` re-run the forward
+    inside their own jit via `jax.vjp`, so the only tensors crossing
+    stage boundaries are activations (forward) and their grads
+    (backward) — nothing else is stashed between programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaStage
+
+    module = LlamaStage(cfg, stage=stage, pp=pp)
+
+    def apply(p, x):
+        return module.apply({"params": p}, x)
+
+    progs = StagePrograms(cfg=cfg, stage=stage, pp=pp, module=module)
+    first, last = stage == 0, stage == pp - 1
+
+    if last:
+        if first:  # pp == 1: whole model, ids in, no gx out
+            def fwdbwd(p, ids, targets):
+                def lf(pp_):
+                    return token_xent(apply(pp_, ids), targets)
+                loss, vjp = jax.vjp(lf, p)
+                (gp,) = vjp(jnp.ones_like(loss))
+                return loss, gp
+        else:
+            def fwdbwd(p, x, targets):
+                def lf(pp_, xx):
+                    return token_xent(apply(pp_, xx), targets)
+                loss, vjp = jax.vjp(lf, p, x)
+                gp, gx = vjp(jnp.ones_like(loss))
+                return loss, gp, gx
+        progs.fwdbwd = jax.jit(fwdbwd)
+    else:
+        progs.fwd = jax.jit(apply)
+        if first:  # ids are integer — non-differentiable input, no gx
+            def bwd(p, ids, gy):
+                _, vjp = jax.vjp(lambda pp_: apply(pp_, ids), p)
+                (gp,) = vjp(gy)
+                return gp
+        else:
+            def bwd(p, x, gy):
+                _, vjp = jax.vjp(apply, p, x)
+                return vjp(gy)  # (gparams, gx)
+        progs.bwd = jax.jit(bwd)
+
+    progs.accum = jax.jit(
+        lambda a, b: jax.tree.map(jnp.add, a, b))
+    progs.scale = jax.jit(
+        lambda t, c: jax.tree.map(lambda x: x * c, t))
+    return progs
+
+
+def split_microbatches(batch, m: int) -> List[Any]:
+    """Split the leading (batch) dim into `m` equal microbatches."""
+    n = batch.shape[0]
+    if m < 1 or n % m:
+        raise ValueError(f"batch dim {n} not divisible into {m} microbatches")
+    k = n // m
+    return [batch[i * k:(i + 1) * k] for i in range(m)]
+
+
+def seeded_batch(seed: int, step: int, batch: int, seq: int, vocab: int):
+    """Deterministic (ids, targets) for a step — both sides of an
+    elastic-restart A/B and every rank of a gang derive the SAME data
+    from (seed, step), so resumes stay bit-comparable without shipping
+    batches around."""
+    import numpy as np
+
+    rng = np.random.default_rng(np.uint64((seed + 1) * 1_000_003 + step))
+    ids = rng.integers(0, vocab, (batch, seq), dtype=np.int64).astype("int32")
+    tg = rng.integers(0, vocab, (batch, seq), dtype=np.int64).astype("int32")
+    return ids, tg
+
+
+# --------------------------------------------------------------------------- #
+# Transports
+# --------------------------------------------------------------------------- #
+
+
+class LocalPipeTransport:
+    """In-process stage links: one FIFO per directed edge and kind
+    ("act" forward, "grad" backward). The thread-driver harness."""
+
+    def __init__(self, pp: int, timeout_s: float = 300.0):
+        self._timeout = timeout_s
+        self._q: Dict[tuple, "queue.Queue"] = {}
+        for s in range(pp - 1):
+            self._q[(s, s + 1, "act")] = queue.Queue()
+            self._q[(s + 1, s, "grad")] = queue.Queue()
+
+    def send(self, src: int, dst: int, kind: str, value) -> None:
+        self._q[(src, dst, kind)].put(value)
+
+    def recv(self, src: int, dst: int, kind: str):
+        try:
+            return self._q[(src, dst, kind)].get(timeout=self._timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"pipeline edge {src}->{dst} [{kind}] starved for "
+                f"{self._timeout}s — peer stage died or deadlocked")
+
+    def flush(self) -> None:
+        pass
+
+
+class CollectivePipeTransport:
+    """Stage links over the collective plane's p2p channels: stage index
+    == group rank, kinds map to tags. Sends go out as `isend` so the
+    store write + GCS post overlap the next microbatch's compute; the
+    p2p ack window (collective_p2p_ack_window) is the flow control.
+    `flush()` joins every outstanding post and re-raises the first
+    error — call it at step boundaries."""
+
+    def __init__(self, group):
+        self.group = group
+        self._handles: List[Any] = []
+
+    def send(self, src: int, dst: int, kind: str, value) -> None:
+        import numpy as np
+
+        assert src == self.group.rank, (src, self.group.rank)
+        # Host copy: stage boundaries serialize as plain numpy (jit on
+        # the far side re-ingests without retracing).
+        payload = np.asarray(value)
+        self._handles.append(self.group.isend(payload, dst, tag=kind))
+        if len(self._handles) >= 32:  # bound handle growth mid-step
+            self._handles.pop(0).wait()
+
+    def recv(self, src: int, dst: int, kind: str):
+        assert dst == self.group.rank, (dst, self.group.rank)
+        return self.group.recv(src, tag=kind)
+
+    def flush(self) -> None:
+        handles, self._handles = self._handles, []
+        for h in handles:
+            h.wait()
+
+
+# --------------------------------------------------------------------------- #
+# Schedules
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class StageStats:
+    """Busy-vs-wall accounting for one stage over one step. `busy_s` is
+    time inside jitted programs (device compute, blocked to
+    completion); everything else in `wall_s` is bubble + transport."""
+
+    stage: int
+    pp: int
+    m: int
+    schedule: str
+    fwd_calls: int = 0
+    bwd_calls: int = 0
+    busy_s: float = 0.0
+    wall_s: float = 0.0
+
+    @property
+    def bubble_frac(self) -> float:
+        return max(0.0, 1.0 - self.busy_s / self.wall_s) if self.wall_s \
+            else 0.0
+
+    @property
+    def analytic_bubble_frac(self) -> float:
+        return analytic_bubble(self.pp, self.m)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"stage": self.stage, "pp": self.pp, "m": self.m,
+                "schedule": self.schedule, "fwd_calls": self.fwd_calls,
+                "bwd_calls": self.bwd_calls,
+                "busy_s": round(self.busy_s, 6),
+                "wall_s": round(self.wall_s, 6),
+                "bubble_frac": round(self.bubble_frac, 4),
+                "analytic_bubble_frac": round(self.analytic_bubble_frac, 4)}
+
+
+@dataclass
+class StageRunResult:
+    gsum: Any                      # microbatch-summed grads (NOT yet /m)
+    loss_sum: Any                  # last stage only (jnp scalar), else None
+    stats: StageStats = None
+
+
+def run_stage(programs: StagePrograms, params, transport, m: int,
+              inputs: Optional[Sequence] = None,
+              targets: Optional[Sequence] = None,
+              schedule: str = "1f1b") -> StageRunResult:
+    """Drive ONE stage through one step of `m` microbatches.
+
+    The same loop implements both schedules — only the warmup depth
+    differs. With `warmup = min(pp-1-stage, m)` forwards in flight
+    before the first backward, the steady state is one-forward-one-
+    backward (1F1B); with `warmup = 0` every iteration forwards one
+    microbatch and then BLOCKS on its gradient, which serializes the
+    whole pipe per microbatch (the sequential A/B). Backward order is
+    microbatch order either way, so gradients are bitwise-identical
+    across schedules.
+
+    `inputs` (stage 0) and `targets` (last stage) are per-microbatch
+    lists; interior boundaries arrive over `transport`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown schedule {schedule!r} (use {SCHEDULES})")
+    s, pp = programs.stage, programs.pp
+    first, last = programs.is_first, programs.is_last
+    if first and (inputs is None or len(inputs) != m):
+        raise ValueError(f"stage 0 needs {m} input microbatches")
+    if last and (targets is None or len(targets) != m):
+        raise ValueError(f"last stage needs {m} target microbatches")
+
+    stats = StageStats(stage=s, pp=pp, m=m, schedule=schedule)
+    state = {"gsum": None, "loss": None, "fwd": 0, "bwd": 0}
+    stash: deque = deque()          # stage INPUTS awaiting their backward
+
+    def timed(fn, *args):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        stats.busy_s += time.perf_counter() - t0
+        return out
+
+    def accumulate(g, loss=None):
+        state["gsum"] = g if state["gsum"] is None \
+            else timed(programs.accum, state["gsum"], g)
+        if loss is not None:
+            # Scalar add stays OUT of the accum jit: a second tree
+            # structure would hold a second cached program and break the
+            # one-program-per-counter compile discipline. A lone f32 add
+            # is bitwise-identical eager or jitted.
+            state["loss"] = loss if state["loss"] is None \
+                else state["loss"] + loss
+
+    t_wall = time.perf_counter()
+    if last:
+        # The last stage is 1F1B by construction: each microbatch fuses
+        # its forward and backward, grads stream out immediately.
+        for k in range(m):
+            if first:               # pp == 1
+                loss, gp = timed(programs.fwdbwd, params, inputs[k],
+                                 targets[k])
+            else:
+                x = transport.recv(s - 1, s, "act")
+                loss, gp, gx = timed(programs.fwdbwd, params, x, targets[k])
+                transport.send(s, s - 1, "grad", gx)
+            accumulate(gp, loss)
+            state["fwd"] += 1
+            state["bwd"] += 1
+    else:
+        warmup = 0 if schedule == "sequential" else min(pp - 1 - s, m)
+
+        def forward_one():
+            k = state["fwd"]
+            x = inputs[k] if first else transport.recv(s - 1, s, "act")
+            y = timed(programs.fwd, params, x)
+            transport.send(s, s + 1, "act", y)
+            stash.append(x)
+            state["fwd"] += 1
+
+        def backward_one():
+            gy = transport.recv(s + 1, s, "grad")
+            x = stash.popleft()
+            if first:
+                gp = timed(programs.bwd, params, x, gy)
+            else:
+                gp, gx = timed(programs.bwd, params, x, gy)
+                transport.send(s, s - 1, "grad", gx)
+            accumulate(gp)
+            state["bwd"] += 1
+
+        for _ in range(warmup):
+            forward_one()
+        while state["bwd"] < m:
+            if state["fwd"] < m:
+                forward_one()
+            backward_one()
+
+    stats.wall_s = time.perf_counter() - t_wall
+    stats.fwd_calls, stats.bwd_calls = state["fwd"], state["bwd"]
+    return StageRunResult(gsum=state["gsum"], loss_sum=state["loss"],
+                          stats=stats)
+
+
+@dataclass
+class PipelineStepResult:
+    loss: float
+    grads: List[Any]               # per-stage mean grads
+    stage_stats: List[StageStats]
+    makespan_s: float = 0.0
+
+    @property
+    def bubble_frac(self) -> float:
+        """Pipeline-level bubble over the step makespan: idle area /
+        total stage-time area. Per-stage `wall_s` ends when the stage
+        drains, so the makespan (slowest stage) is the denominator —
+        a stage that finishes early is idle for the remainder."""
+        if not self.makespan_s:
+            return 0.0
+        pp = len(self.stage_stats)
+        busy = sum(st.busy_s for st in self.stage_stats)
+        return max(0.0, 1.0 - busy / (pp * self.makespan_s))
+
+
+def run_pipeline_step(programs_list: Sequence[StagePrograms],
+                      params_list: Sequence, ids, targets, m: int,
+                      schedule: str = "1f1b",
+                      transport: Optional[LocalPipeTransport] = None
+                      ) -> PipelineStepResult:
+    """One training step through an in-process pipeline: `pp` stage
+    threads over queue links. XLA releases the GIL inside compute, so
+    stage threads genuinely overlap — this is the measurement (and
+    test) harness for the schedules; cross-process runs use
+    `make_pipeline_train_fn`."""
+    import jax.numpy as jnp
+
+    pp = len(programs_list)
+    inputs = split_microbatches(ids, m)
+    tgts = split_microbatches(targets, m)
+
+    if pp == 1:
+        t0 = time.perf_counter()
+        res = run_stage(programs_list[0], params_list[0], None, m,
+                        inputs=inputs, targets=tgts, schedule=schedule)
+        makespan = time.perf_counter() - t0
+        inv_m = jnp.float32(1.0 / m)
+        grads = [programs_list[0].scale(res.gsum, inv_m)]
+        return PipelineStepResult(
+            loss=float(res.loss_sum) / m, grads=grads,
+            stage_stats=[res.stats], makespan_s=makespan)
+
+    transport = transport or LocalPipeTransport(pp)
+    results: List[Optional[StageRunResult]] = [None] * pp
+    errors: List[BaseException] = []
+    start = threading.Barrier(pp + 1)
+
+    def drive(si: int):
+        try:
+            start.wait()
+            results[si] = run_stage(
+                programs_list[si], params_list[si], transport, m,
+                inputs=inputs if si == 0 else None,
+                targets=tgts if si == pp - 1 else None,
+                schedule=schedule)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=drive, args=(si,), daemon=True,
+                                name=f"pipe-stage-{si}")
+               for si in range(pp)]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=600)
+    makespan = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    if any(r is None for r in results):
+        raise TimeoutError("pipeline stage thread never finished")
+
+    inv_m = jnp.float32(1.0 / m)
+    grads = [programs_list[si].scale(results[si].gsum, inv_m)
+             for si in range(pp)]
+    return PipelineStepResult(
+        loss=float(results[pp - 1].loss_sum) / m, grads=grads,
+        stage_stats=[r.stats for r in results], makespan_s=makespan)
+
+
+# --------------------------------------------------------------------------- #
+# In-process trainer (tests / bench)
+# --------------------------------------------------------------------------- #
+
+
+class LocalPipelineTrainer:
+    """pp-stage llama training in one process: monolithic-seeded init
+    (identical initial weights at EVERY pp), per-stage adam (leafwise,
+    so updates are bitwise width-invariant), threads + queues for the
+    schedule. The A/B harness behind the parity tests and
+    bench_sharded's pipeline legs."""
+
+    def __init__(self, cfg, pp: int = 1, num_microbatches: int = 2,
+                 lr: float = 1e-2, seed: int = 0, schedule: str = "1f1b",
+                 batch: int = 4, seq: int = 16):
+        import jax
+        import optax
+
+        from ray_tpu.models.llama import Llama, split_stage_params
+
+        self.cfg, self.pp, self.m = cfg, pp, num_microbatches
+        self.schedule = schedule
+        self.batch, self.seq = batch, seq
+        self.optimizer = optax.adam(lr)
+        sample = seeded_batch(seed, 0, batch // num_microbatches, seq,
+                              cfg.vocab_size)[0]
+        full = Llama(cfg).init(jax.random.PRNGKey(seed), sample)["params"]
+        self.params = list(split_stage_params(full, cfg, pp))
+        self.programs = [build_stage_programs(cfg, s, pp) for s in range(pp)]
+        self.opt_states = [self.optimizer.init(p) for p in self.params]
+        # One update jit PER STAGE: stage trees are different structures,
+        # and one shared jit would cache pp programs — opaque to the
+        # one-program-per-counter compile accounting.
+        self._updates = [jax.jit(self._update_impl) for _ in range(pp)]
+        self.step_count = 0
+        self.last_result: Optional[PipelineStepResult] = None
+
+    def _update_impl(self, params, opt_state, grads):
+        import optax
+
+        updates, new_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def train_step(self, ids, targets) -> Dict[str, Any]:
+        res = run_pipeline_step(self.programs, self.params, ids, targets,
+                                self.m, schedule=self.schedule)
+        for s in range(self.pp):
+            self.params[s], self.opt_states[s] = self._updates[s](
+                self.params[s], self.opt_states[s], res.grads[s])
+        self.step_count += 1
+        self.last_result = res
+        return {"loss": res.loss, "step": self.step_count,
+                "bubble_frac": res.bubble_frac,
+                "makespan_s": res.makespan_s}
+
+    def merged_params(self):
+        from ray_tpu.models.llama import merge_stage_params
+
+        return merge_stage_params(self.params)
+
+    def compile_counters(self) -> Dict[str, Any]:
+        out = {f"s{s}.update": u for s, u in enumerate(self._updates)}
+        for p in self.programs:
+            out.update(p.compile_counters())
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# Stage checkpoints (elastic resharding)
+# --------------------------------------------------------------------------- #
+
+
+def stage_state_template(cfg, stage: int, pp: int, optimizer, sample_ids):
+    """Shape/dtype template of one stage's {"params", "opt"} subtree —
+    built under `jax.eval_shape` (no FLOPs, no memory) at ANY (pp)
+    width, which is what lets a restore re-split a checkpoint saved at
+    a different width: leaf paths are GLOBAL (layer index, not
+    stage-local), so the manifest keys match regardless of pp."""
+    import jax
+
+    from ray_tpu.models.llama import Llama, split_stage_params
+
+    model = Llama(cfg)
+    full = jax.eval_shape(
+        lambda r: model.init(r, sample_ids)["params"], jax.random.PRNGKey(0))
+    stage_params = split_stage_params(full, cfg, pp)[stage]
+    opt_tpl = jax.eval_shape(optimizer.init, stage_params)
+    return {"params": stage_params, "opt": opt_tpl}
+
+
+def save_pipeline_stage(path: str, stage_state, stage: int, pp: int,
+                        step: Optional[int] = None) -> str:
+    """Save one stage's disjoint subtree. `own_replicated=True` because
+    NO other rank holds this stage's keys — rank 0 owning replicated
+    leaves (the SPMD default) would leave interior stages' norm scales
+    and adam counts with zero coverage and fail the merge."""
+    from ray_tpu.train.checkpoint import save_sharded_pytree
+
+    return save_sharded_pytree(path, stage_state, process_index=stage,
+                               process_count=pp,
+                               meta={"step": step, "pp": pp},
+                               own_replicated=True)
+
+
+def restore_pipeline_stage(path: str, cfg, stage: int, pp: int, optimizer,
+                           sample_ids, mesh=None):
+    """Restore ONE stage's subtree at the CURRENT (possibly different)
+    width from a merged stage checkpoint — raw-byte assembly, so the
+    round trip is bitwise at any (tp, pp) -> (tp', pp'). With a stage
+    `mesh`, params land sharded by the llama partition-rule table."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import shard_stage_params
+    from ray_tpu.train.checkpoint import restore_sharded_pytree
+
+    tpl = stage_state_template(cfg, stage, pp, optimizer, sample_ids)
+    state = restore_sharded_pytree(path, target=tpl)
+    state = jax.tree.map(jnp.asarray, state)
+    if mesh is not None:
+        state["params"] = shard_stage_params(state["params"], mesh)
+    return state
+
+
+# --------------------------------------------------------------------------- #
+# WorkerGroup train fn (one rank per stage, elastic across restarts)
+# --------------------------------------------------------------------------- #
+
+
+def make_pipeline_train_fn(steps: int = 6, microbatches: int = 2,
+                           batch: int = 4, seq: int = 16, lr: float = 1e-2,
+                           seed: int = 0, ckpt_dir: Optional[str] = None,
+                           tp: int = 1, schedule: str = "1f1b",
+                           cfg_overrides: Optional[Dict[str, Any]] = None):
+    """A train_loop_per_worker where pp == session.get_world_size():
+    rank r drives stage r over the collective p2p plane, data comes
+    deterministically from (seed, step), and EVERY step checkpoints the
+    stage subtree + merges on rank 0 — so when the gang restarts at a
+    different world size (a killed stage, an elastic shrink), the loop
+    resumes from the merged manifest re-split at the NEW pp, bit-exact.
+
+    tp > 1 additionally shards each stage's params over an in-process
+    ("tp",) mesh by the llama partition-rule table (the multi-axis
+    (tp, pp) layout; restore re-shards to whatever tp the new
+    incarnation asks for)."""
+    if ckpt_dir is None:
+        raise ValueError("make_pipeline_train_fn needs a ckpt_dir")
+    overrides = dict(cfg_overrides or {})
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.train import session
+        from ray_tpu.train.checkpoint import (
+            Checkpoint,
+            merge_sharded_manifest,
+        )
+
+        world = session.get_world_size()
+        rank = session.get_world_rank()
+        pp, stage = world, rank
+        cfg = tiny_pipeline_config(**overrides)
+        optimizer = optax.adam(lr)
+        mb = batch // microbatches
+        sample = seeded_batch(seed, 0, mb, seq, cfg.vocab_size)[0]
+
+        mesh = None
+        if tp > 1:
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+            devices = jax.devices()
+            if len(devices) >= tp:
+                mesh = build_mesh(MeshSpec({"tp": tp}),
+                                  devices=devices[:tp])
+
+        programs = build_stage_programs(cfg, stage, pp)
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            d = ckpt.to_dict()
+            start = int(d["step"]) + 1
+            state = restore_pipeline_stage(d["path"], cfg, stage, pp,
+                                           optimizer, sample, mesh=mesh)
+            params, opt_state = state["params"], state["opt"]
+        else:
+            start = 0
+            from ray_tpu.models.llama import (
+                Llama,
+                shard_stage_params,
+                split_stage_params,
+            )
+
+            full = Llama(cfg).init(jax.random.PRNGKey(seed),
+                                   sample)["params"]
+            params = split_stage_params(full, cfg, pp)[stage]
+            if mesh is not None:
+                params = shard_stage_params(params, mesh)
+            opt_state = optimizer.init(params)
+
+        group = session.get_collective() if world > 1 else None
+        transport = CollectivePipeTransport(group) if group is not None \
+            else None
+
+        @jax.jit
+        def update(p, o, g):
+            updates, new_o = optimizer.update(g, o, p)
+            return optax.apply_updates(p, updates), new_o
+
+        inv_m = jnp.float32(1.0 / microbatches)
+        for step in range(start, steps):
+            ids, tg = seeded_batch(seed, step, batch, seq, cfg.vocab_size)
+            inputs = split_microbatches(ids, microbatches) if stage == 0 \
+                else None
+            tgts = split_microbatches(tg, microbatches) \
+                if stage == pp - 1 else None
+            res = run_stage(programs, params, transport, microbatches,
+                            inputs=inputs, targets=tgts, schedule=schedule)
+            grads = programs.scale(res.gsum, inv_m)
+            params, opt_state = update(params, opt_state, grads)
+            if transport is not None:
+                transport.flush()
+
+            path = os.path.join(ckpt_dir, f"step_{step:05d}_w{world}")
+            save_pipeline_stage(path, {"params": params, "opt": opt_state},
+                                stage, pp, step=step)
+            if group is not None:
+                group.barrier()     # every stage saved before the merge
+            metrics = {"step": step, "world": world, **res.stats.as_dict()}
+            if res.loss_sum is not None:
+                metrics["loss"] = float(res.loss_sum) / microbatches
+            if rank == 0:
+                if world > 1:
+                    merge_sharded_manifest(path, world)
+                session.report(metrics, checkpoint=Checkpoint.from_dict(
+                    {"path": path, "step": step, "pp": world}))
+            else:
+                session.report(metrics)
+        return {"final_step": steps - 1, "stage": stage, "world": world}
+
+    return train_fn

@@ -86,6 +86,17 @@ step "query smoke (exchange operators + locality A/B)" \
 # diff) and num_unsealed 0 (exit nonzero on any breach).
 step "jobs smoke (submission plane + env forge + tenants)" \
   env JAX_PLATFORMS=cpu python bench.py --jobs-smoke
+# Sharded smoke: pp=2 pipeline parity + seeded kill-a-stage resume, <60s —
+# hard asserts on step-for-step BITWISE parity with pp=1 (zero per-step
+# recompiles via compile counters), the 1F1B bubble fraction strictly
+# below the sequential schedule's, an ingest-fed run with bounded
+# stall_frac, and a checkpoint-gated stage kill whose elastic resharded
+# resume is bitwise-equal to the unkilled run at the same step (exit
+# nonzero on any invariant breach). Makespan speedup stays a soft flag
+# (`sharded_regressed`) — on small hosts XLA intra-op threading hands the
+# sequential schedule every core per op, so wall-clock is noise-bound.
+step "sharded smoke (pp=2 parity + kill-a-stage resume)" \
+  env JAX_PLATFORMS=cpu python bench.py --sharded-smoke
 # 100-node envelope smoke: placement at width + one seeded node kill with
 # AUTOSCALER-driven replacement, bounded — zero hangs, zero lost tasks,
 # lease-cache invalidation asserted (no stale-lease double execution).

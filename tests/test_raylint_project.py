@@ -637,6 +637,96 @@ def test_rl023_axis_check_needs_a_declared_mesh(tmp_path):
     assert findings == []
 
 
+def test_rl023_shardspec_kwargs_declare_multi_axes(tmp_path):
+    # A multi-axis gang ShardSpec(tp=, pp=, sp=) is a mesh declaration:
+    # specs over those axes are quiet, a name no spec anywhere declares
+    # still fires.
+    findings = lint_tree(tmp_path, {
+        "pkg/gang.py": """
+            from ray_tpu.shardgroup import ShardSpec
+
+            SPEC = ShardSpec(tp=4, pp=2)
+        """,
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            STAGE = P("pp", "tp")
+            BAD = P("pp", "sp")
+        """,
+    }, rules=["RL023"])
+    assert rule_ids(findings) == ["RL023"]
+    assert "'sp'" in findings[0].message
+
+
+def test_rl023_shardspec_size_one_axis_is_not_declared(tmp_path):
+    # shardgroup's mesh_axes drops size-1 axes, so a literal pp=1 must
+    # not license P("pp") — but a RUNTIME width (pp=n) may be > 1 and
+    # counts as declared.
+    base = {
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            STAGE = P("pp")
+        """,
+    }
+    findings = lint_tree(tmp_path, {
+        **base,
+        "pkg/gang.py": """
+            from ray_tpu.shardgroup import ShardSpec
+
+            SPEC = ShardSpec(tp=2, pp=1)
+        """,
+    }, rules=["RL023"])
+    assert rule_ids(findings) == ["RL023"]
+    assert "'pp'" in findings[0].message
+
+    findings = lint_tree(tmp_path, {
+        **base,
+        "pkg/gang.py": """
+            from ray_tpu.shardgroup import ShardSpec
+
+            def spec(n):
+                return ShardSpec(tp=2, pp=n)
+        """,
+    }, rules=["RL023"])
+    assert findings == []
+
+
+def test_rl023_meshspec_axes_kwarg_declares(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "pkg/mesh.py": """
+            from ray_tpu.parallel.mesh import MeshSpec
+
+            SPEC = MeshSpec(axes={"dp": 2, "tp": 4})
+        """,
+        "pkg/model.py": """
+            from jax.sharding import PartitionSpec as P
+
+            ROWS = P("dp", "tp")
+        """,
+    }, rules=["RL023"])
+    assert findings == []
+
+
+def test_rl023_finding_cites_the_owning_rule_pattern(tmp_path):
+    # A hit inside a match_partition_rules table names the rule's regex,
+    # so a bad axis in a 30-row table is attributable at a glance.
+    findings = lint_tree(tmp_path, {
+        "pkg/mesh.py": RL023_MESH,
+        "pkg/rules.py": """
+            from jax.sharding import PartitionSpec as P
+
+            RULES = (
+                (r"embed$", P("tp")),
+                (r"wq/kernel$", P(None, "model")),
+            )
+        """,
+    }, rules=["RL023"])
+    assert rule_ids(findings) == ["RL023"]
+    assert "wq/kernel$" in findings[0].message
+    assert "'model'" in findings[0].message
+
+
 # ------------------------------------------- mutation negative-controls
 
 
